@@ -1,0 +1,19 @@
+"""skellysim_tpu: TPU-native cytoskeletal hydrodynamics framework.
+
+A ground-up JAX/XLA re-design of the capabilities of SkellySim
+(flatironinstitute/SkellySim): flexible fibers (slender-body theory),
+rigid bodies, a confining periphery, and point/background flow sources
+coupled through zero-Reynolds-number Stokes hydrodynamics, solved each
+timestep with matrix-free preconditioned GMRES.
+
+Design stance (see SURVEY.md §7): pure-functional state pytrees + jit'd
+operators instead of the reference's object-soup + MPI. Fibers are a
+dense batched tensor [n_fib, n_nodes, ...]; the N-body Stokes kernel
+evaluations run as blocked dense contractions on the MXU; multi-chip
+scaling uses jax.sharding.Mesh + shard_map with ICI collectives instead
+of MPI.
+"""
+
+__version__ = "0.1.0"
+
+TRAJECTORY_VERSION = 1

@@ -1,0 +1,1 @@
+from . import kernels, finite_diff  # noqa: F401

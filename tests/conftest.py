@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU platform with float64.
+
+Mirrors the reference's multi-rank-without-a-cluster strategy
+(`/root/reference/tests/core/unit_tests/CMakeLists.txt:12-19`: ctest under
+`mpiexec -n 2`): sharding correctness is exercised on a virtual device mesh, and
+physics accuracy gates run in float64 on CPU. Must set env vars before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,62 @@
+"""Finite-difference weight and barycentric-resampling tests.
+
+Oracle strategy: differentiate/resample known polynomials exactly (the FD matrices
+of order 4 with the reference's stencil sizes are exact on low-degree polynomials),
+rather than comparing against golden outputs.
+"""
+
+import numpy as np
+
+from skellysim_tpu.ops.finite_diff import barycentric_matrix, finite_diff
+
+
+def test_finite_diff_exact_on_polynomials():
+    n = 32
+    s = np.linspace(-1, 1, n)
+    # reference uses n_s = 4 + order (compute_matrices_finitediff,
+    # /root/reference/src/core/fiber_finite_difference.cpp:537-540)
+    for order, n_s in [(1, 5), (2, 6), (3, 7), (4, 8)]:
+        D = finite_diff(s, order, n_s)
+        for deg in range(order, 5):
+            p = np.polynomial.Polynomial(np.arange(1.0, deg + 2))
+            want = p.deriv(order)(s)
+            got = D @ p(s)
+            np.testing.assert_allclose(got, want, rtol=1e-8, atol=1e-8)
+
+
+def test_finite_diff_rows_sum_zero():
+    s = np.linspace(-1, 1, 16)
+    for order in (1, 2, 3, 4):
+        D = finite_diff(s, order, 4 + order)
+        np.testing.assert_allclose(D @ np.ones(16), 0.0, atol=1e-9)
+
+
+def test_barycentric_resamples_polynomials_on_chebyshev_nodes():
+    # The reference's weights ([0.5, -1, 1, ..., +-0.5], src/core/utils.cpp:16-20)
+    # are the barycentric weights of Chebyshev points of the 2nd kind, so the
+    # resampling is an exact polynomial interpolant on that grid.
+    n, m = 24, 20
+    x = -np.cos(np.pi * np.arange(n) / (n - 1))
+    y = 2 * (0.5 + np.arange(m)) / m - 1
+    P = barycentric_matrix(x, y)
+    for deg in range(6):
+        p = np.polynomial.Polynomial(np.ones(deg + 1))
+        np.testing.assert_allclose(P @ p(x), p(y), rtol=1e-9, atol=1e-9)
+
+
+def test_barycentric_partition_of_unity_equispaced():
+    # On the equispaced grids the fibers actually use, the operator still
+    # reproduces constants exactly (terms/S sums to 1 per row).
+    x = np.linspace(-1, 1, 24)
+    y = 2 * (0.5 + np.arange(20)) / 20 - 1
+    P = barycentric_matrix(x, y)
+    np.testing.assert_allclose(P @ np.ones(24), 1.0, atol=1e-12)
+
+
+def test_barycentric_handles_coincident_points():
+    x = np.linspace(-1, 1, 9)
+    y = np.array([x[3]])
+    P = barycentric_matrix(x, y)
+    e = np.zeros(9)
+    e[3] = 1.0
+    np.testing.assert_allclose(P[0], e, atol=1e-12)
