@@ -1,0 +1,78 @@
+"""Benchmark: mobility-matvec throughput (source-target pairs/sec/chip).
+
+Per BASELINE.md, the reference publishes no numbers, so the baseline is
+self-measured: the reference's ground-truth backend is the single-threaded
+direct CPU kernel (`tests/core/kernel_test.cpp` uses it as the oracle;
+`performance_hydrodynamics_combined.cpp` times it). We measure the same
+quantity here: pairwise Stokeslet evaluations per second, on the default
+device (TPU under axon; CPU in dev runs), at the 10k-fiber scale's kernel
+shape (N = 65536 sources == targets, f32), against a single-core NumPy
+direct evaluation measured on this host and extrapolated per-pair.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _numpy_pairs_per_s(n=1024, trials=3):
+    """Single-core direct CPU evaluation rate (the reference oracle backend)."""
+    rng = np.random.default_rng(0)
+    r = rng.uniform(-1, 1, size=(n, 3))
+    f = rng.standard_normal((n, 3))
+
+    def direct(r_src, r_trg, f_src):
+        d = r_trg[:, None, :] - r_src[None, :, :]
+        r2 = np.sum(d * d, axis=-1)
+        np.fill_diagonal(r2, np.inf)
+        rinv = 1.0 / np.sqrt(r2)
+        df = np.einsum("tsk,sk->ts", d, f_src)
+        u = np.einsum("ts,sk->tk", rinv, f_src) + np.einsum("ts,tsk->tk", df * rinv**3, d)
+        return u / (8 * np.pi)
+
+    direct(r, r, f)  # warm caches
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        direct(r, r, f)
+    dt = (time.perf_counter() - t0) / trials
+    return n * n / dt
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from skellysim_tpu.ops import kernels
+
+    # full 10k-fiber kernel shape on an accelerator; small smoke size on CPU
+    n = 65536 if jax.default_backend() != "cpu" else 8192
+    rng = np.random.default_rng(1)
+    r = jnp.asarray(rng.uniform(-5, 5, size=(n, 3)), dtype=jnp.float32)
+    f = jnp.asarray(rng.standard_normal((n, 3)), dtype=jnp.float32)
+
+    u = kernels.stokeslet_direct(r, r, f, 1.0)
+    u.block_until_ready()  # compile + warm
+    trials = 3
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        u = kernels.stokeslet_direct(r, r, f, 1.0)
+        u.block_until_ready()
+    dt = (time.perf_counter() - t0) / trials
+    pairs_per_s = n * n / dt
+
+    baseline = _numpy_pairs_per_s()
+    print(json.dumps({
+        "metric": f"stokeslet_mobility_matvec_throughput_n{n}",
+        "value": round(pairs_per_s / 1e9, 4),
+        "unit": "Gpairs/s/chip",
+        "vs_baseline": round(pairs_per_s / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from .matrices import FibMats, get_mats, VALID_NODE_COUNTS  # noqa: F401

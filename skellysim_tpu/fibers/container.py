@@ -1,0 +1,243 @@
+"""Batched fiber state + vmapped operator assembly.
+
+TPU-native replacement for `FiberContainerFiniteDifference`
+(`/root/reference/src/core/fiber_container_finite_difference.cpp`): instead of a
+`std::list<FiberFiniteDifference>` with per-fiber loops and MPI round-robin
+distribution, all fibers of one resolution live in dense batched arrays
+([n_fib, n_nodes, ...]) and every per-fiber operation is a `jax.vmap` of the
+single-fiber functions in `fd_fiber`. The fiber batch axis is the data-parallel
+axis to shard over a device mesh (the analogue of the reference's rank
+decomposition, `fiber_container_finite_difference.cpp:98-121`).
+
+An `active` mask supports dynamic instability (nucleation/catastrophe changes
+the live fiber count without reshaping the arrays): inactive slots contribute
+zero flow/force/error and solve an identity system.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import kernels
+from . import fd_fiber
+from .fd_fiber import FiberScalars
+from .matrices import FibMats, get_mats
+
+
+class FiberGroup(NamedTuple):
+    """State of a batch of same-resolution fibers (a pytree; [nf] leading axis)."""
+
+    x: jnp.ndarray             # [nf, n, 3] node positions
+    tension: jnp.ndarray       # [nf, n]
+    length: jnp.ndarray        # [nf] target length
+    length_prev: jnp.ndarray   # [nf] last accepted length
+    bending_rigidity: jnp.ndarray
+    radius: jnp.ndarray
+    penalty: jnp.ndarray
+    beta_tstep: jnp.ndarray
+    force_scale: jnp.ndarray
+    v_growth: jnp.ndarray
+    minus_clamped: jnp.ndarray  # bool [nf]
+    plus_pinned: jnp.ndarray    # bool [nf]
+    binding_body: jnp.ndarray   # int32 [nf], -1 = unbound
+    binding_site: jnp.ndarray   # int32 [nf]
+    active: jnp.ndarray         # bool [nf]
+
+    @property
+    def n_fibers(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def n_nodes(self) -> int:
+        return self.x.shape[1]
+
+    @property
+    def mats(self) -> FibMats:
+        return get_mats(self.n_nodes)
+
+    def scalars(self) -> FiberScalars:
+        return FiberScalars(self.length, self.length_prev, self.bending_rigidity,
+                            self.radius, self.penalty, self.beta_tstep, self.v_growth)
+
+
+class FiberCaches(NamedTuple):
+    """Per-step derived quantities (`update_cache_variables` + BC application)."""
+
+    xs: jnp.ndarray         # [nf, n, 3]
+    xss: jnp.ndarray
+    xsss: jnp.ndarray
+    xssss: jnp.ndarray
+    stokeslet: jnp.ndarray  # [nf, n, 3, n, 3] dense self-mobility
+    force_op: jnp.ndarray   # [nf, 3n, 4n]
+    A_bc: jnp.ndarray       # [nf, 4n, 4n] (BC-applied)
+    RHS: jnp.ndarray        # [nf, 4n] (BC-applied)
+    lu: jnp.ndarray         # batched LU factors of A_bc
+    piv: jnp.ndarray
+
+
+def make_group(x, lengths, bending_rigidity, radius, *, eta=None,
+               penalty=fd_fiber.DEFAULT_PENALTY, beta_tstep=fd_fiber.DEFAULT_BETA_TSTEP,
+               force_scale=0.0, v_growth=0.0, minus_clamped=False,
+               binding_body=None, binding_site=None, dtype=jnp.float64) -> FiberGroup:
+    """Build a FiberGroup from [nf, n, 3] positions and broadcastable per-fiber params."""
+    x = jnp.asarray(x, dtype=dtype)
+    nf, n = x.shape[0], x.shape[1]
+    get_mats(n)  # validate resolution
+
+    def vec(v, d=dtype):
+        return jnp.broadcast_to(jnp.asarray(v, dtype=d), (nf,))
+
+    return FiberGroup(
+        x=x,
+        tension=jnp.zeros((nf, n), dtype=dtype),
+        length=vec(lengths), length_prev=vec(lengths),
+        bending_rigidity=vec(bending_rigidity), radius=vec(radius),
+        penalty=vec(penalty), beta_tstep=vec(beta_tstep),
+        force_scale=vec(force_scale), v_growth=vec(v_growth),
+        minus_clamped=vec(minus_clamped, jnp.bool_),
+        plus_pinned=jnp.zeros(nf, dtype=jnp.bool_),
+        binding_body=vec(-1 if binding_body is None else binding_body, jnp.int32),
+        binding_site=vec(-1 if binding_site is None else binding_site, jnp.int32),
+        active=jnp.ones(nf, dtype=jnp.bool_),
+    )
+
+
+def node_positions(group: FiberGroup) -> jnp.ndarray:
+    """[nf * n, 3] flattened node positions (`get_local_node_positions`)."""
+    return group.x.reshape(-1, 3)
+
+
+def update_cache(group: FiberGroup, dt, eta) -> FiberCaches:
+    """Derivatives, self-mobility, pre-BC operator, force operator (vmapped).
+
+    Mirror of `update_cache_variables` (`fiber_container_finite_difference.cpp:147-157`)
+    minus the BC/RHS stage, which needs the explicit flow field (see
+    `update_rhs_and_bc`).
+    """
+    mats = group.mats
+    sc = group.scalars()
+
+    xs, xss, xsss, xssss = jax.vmap(
+        lambda x, lp: fd_fiber.derivatives(x, lp, mats))(group.x, group.length_prev)
+
+    stokeslet = jax.vmap(lambda x: kernels.oseen_tensor(x, x, eta))(group.x)
+    force_op = jax.vmap(
+        lambda a, b, s: fd_fiber.force_operator(a, b, eta, s, mats))(xs, xss, sc)
+
+    zeros44 = jnp.zeros((group.n_fibers, 4 * group.n_nodes, 4 * group.n_nodes), dtype=group.x.dtype)
+    zeros4 = jnp.zeros((group.n_fibers, 4 * group.n_nodes), dtype=group.x.dtype)
+    return FiberCaches(xs=xs, xss=xss, xsss=xsss, xssss=xssss, stokeslet=stokeslet,
+                       force_op=force_op, A_bc=zeros44, RHS=zeros4,
+                       lu=zeros44, piv=jnp.zeros((group.n_fibers, 4 * group.n_nodes), dtype=jnp.int32))
+
+
+def update_rhs_and_bc(group: FiberGroup, caches: FiberCaches, dt, eta,
+                      v_on_fibers, f_total, f_ext) -> FiberCaches:
+    """Assemble BC-applied A/RHS and the batched LU preconditioner.
+
+    Mirrors the prep sequence of `System::prep_state_for_solver`
+    (`system.cpp:448-453`): RHS uses the total force (motor + external), the BC
+    rows use only the external force.
+    """
+    mats = group.mats
+    sc = group.scalars()
+
+    def one(x, xs, xss, xsss, s, mc, pp, v, ft, fe):
+        A = fd_fiber.build_A(xs, xss, xsss, dt, eta, s, mats)
+        RHS = fd_fiber.build_RHS(x, xs, xss, dt, eta, s, mats, flow=v, f_external=ft)
+        A_bc, RHS_bc = fd_fiber.apply_bc_rectangular(
+            A, RHS, x, xs, xss, dt, eta, s, mats, mc, pp, v_on_fiber=v, f_on_fiber=fe)
+        # inactive slots solve the identity so the LU stays well-posed
+        eye = jnp.eye(A_bc.shape[0], dtype=A_bc.dtype)
+        return A_bc, RHS_bc, eye
+
+    A_bc, RHS_bc, eye = jax.vmap(one)(
+        group.x, caches.xs, caches.xss, caches.xsss, sc,
+        group.minus_clamped, group.plus_pinned, v_on_fibers, f_total, f_ext)
+    act = group.active[:, None, None]
+    A_bc = jnp.where(act, A_bc, eye)
+    RHS_bc = jnp.where(group.active[:, None], RHS_bc, 0.0)
+
+    lu, piv = jax.vmap(jax.scipy.linalg.lu_factor)(A_bc)
+    return caches._replace(A_bc=A_bc, RHS=RHS_bc, lu=lu, piv=piv)
+
+
+def weighted_forces(group: FiberGroup, forces) -> jnp.ndarray:
+    """Quadrature-weighted node forces for the all-to-all flow: 0.5 * L * w0 * f.
+
+    (`fiber_container_finite_difference.cpp:185-192`); inactive fibers weigh zero.
+    """
+    w0 = jnp.asarray(group.mats.weights0, dtype=group.x.dtype)
+    w = 0.5 * group.length[:, None] * w0[None, :]
+    w = jnp.where(group.active[:, None], w, 0.0)
+    return w[:, :, None] * forces
+
+
+def flow(group: FiberGroup, caches: FiberCaches, r_trg, forces, eta,
+         subtract_self: bool = True) -> jnp.ndarray:
+    """Velocity at targets from all fiber nodes (`flow`, `:172-214`).
+
+    ``forces`` is [nf, n, 3]; when ``subtract_self`` the first nf*n targets are
+    assumed to be the fiber nodes themselves and each fiber's dense
+    self-interaction is subtracted (it is handled by the SBT mobility instead).
+    """
+    wf = weighted_forces(group, forces)
+    vel = kernels.stokeslet_direct(node_positions(group), r_trg, wf.reshape(-1, 3), eta)
+    if subtract_self:
+        self_vel = jnp.einsum("fiajb,fjb->fia", caches.stokeslet, wf)
+        nfn = group.n_fibers * group.n_nodes
+        vel = vel.at[:nfn].add(-self_vel.reshape(-1, 3))
+    return vel
+
+
+def apply_fiber_force(group: FiberGroup, caches: FiberCaches, x_all) -> jnp.ndarray:
+    """Solution -> force density on nodes, [nf, n, 3] (`apply_fiber_force`, `:272-287`)."""
+    f = jnp.einsum("fij,fj->fi", caches.force_op, x_all)  # [nf, 3n]
+    n = group.n_nodes
+    return jnp.stack([f[:, :n], f[:, n:2 * n], f[:, 2 * n:]], axis=-1)
+
+
+def matvec(group: FiberGroup, caches: FiberCaches, x_all, v_fib, v_boundary) -> jnp.ndarray:
+    """Block-diagonal fiber matvec [nf, 4n] (`matvec`, `:216-234`)."""
+    mats = group.mats
+    sc = group.scalars()
+    res = jax.vmap(
+        lambda A, xv, v, vb, xs, s, pp: fd_fiber.matvec(A, xv, v, vb, xs, s, mats, pp)
+    )(caches.A_bc, x_all, v_fib, v_boundary, caches.xs, sc, group.plus_pinned)
+    return jnp.where(group.active[:, None], res, x_all)
+
+
+def apply_preconditioner(group: FiberGroup, caches: FiberCaches, x_all) -> jnp.ndarray:
+    """Batched LU solves, [nf, 4n] (`apply_preconditioner`, `:331-339`)."""
+    return jax.vmap(lambda lu, piv, b: jax.scipy.linalg.lu_solve((lu, piv), b))(
+        caches.lu, caches.piv, x_all)
+
+
+def step(group: FiberGroup, fiber_sol) -> FiberGroup:
+    """Advance positions/tension from the solution [nf, 4n] (`step`, `:292-302`)."""
+    n = group.n_nodes
+    x_new = jnp.stack([fiber_sol[:, :n], fiber_sol[:, n:2 * n], fiber_sol[:, 2 * n:3 * n]], axis=-1)
+    t_new = fiber_sol[:, 3 * n:]
+    x_new = jnp.where(group.active[:, None, None], x_new, group.x)
+    t_new = jnp.where(group.active[:, None], t_new, group.tension)
+    return group._replace(x=x_new, tension=t_new, length_prev=group.length)
+
+
+def generate_constant_force(group: FiberGroup, caches: FiberCaches) -> jnp.ndarray:
+    """Implicit motor force f = force_scale * xs [nf, n, 3] (`generate_constant_force`)."""
+    return group.force_scale[:, None, None] * caches.xs
+
+
+def fiber_error(group: FiberGroup) -> jnp.ndarray:
+    """Max inextensibility violation over active fibers (`fiber_error_local`)."""
+    mats = group.mats
+    errs = jax.vmap(lambda x, L: fd_fiber.fiber_error(x, L, mats))(group.x, group.length)
+    return jnp.max(jnp.where(group.active, errs, 0.0))
+
+
+def solution_size(group: FiberGroup) -> int:
+    return group.n_fibers * 4 * group.n_nodes

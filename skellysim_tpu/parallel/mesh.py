@@ -1,0 +1,40 @@
+"""Device mesh + sharding helpers.
+
+TPU-native replacement for the reference's MPI domain decomposition
+(SURVEY.md §2.3): the fiber batch axis is sharded over a 1-D mesh (the analogue
+of the round-robin fiber distribution, `fiber_container_finite_difference.cpp:98-121`);
+small replicated state (bodies, time, dt) stays replicated (the analogue of the
+reference's rank-0 body ownership + Bcast). XLA GSPMD inserts the all-gathers /
+psums that the reference issued explicitly through MPI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+FIBER_AXIS = "fib"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (FIBER_AXIS,))
+
+
+def shard_state(state, mesh: Mesh):
+    """Place a SimState: fiber-batch leaves sharded over the mesh, rest replicated."""
+    fib_sharding = NamedSharding(mesh, P(FIBER_AXIS))
+    rep_sharding = NamedSharding(mesh, P())
+
+    nf = state.fibers.n_fibers if state.fibers is not None else 0
+
+    def place(leaf):
+        leaf = jax.numpy.asarray(leaf)
+        if leaf.ndim >= 1 and nf > 0 and leaf.shape[0] == nf and nf % mesh.size == 0:
+            return jax.device_put(leaf, fib_sharding)
+        return jax.device_put(leaf, rep_sharding)
+
+    return jax.tree_util.tree_map(place, state)

@@ -1,0 +1,64 @@
+"""System parameters.
+
+Mirror of the reference `Params` struct and its TOML defaults
+(`/root/reference/include/params.hpp:7-67`, `src/core/params.cpp:3-80`). These are
+static (hashable) configuration — they select compiled programs; the dynamic
+simulation state lives in `system.SimState`.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class DynamicInstability:
+    n_nodes: int = 0
+    v_growth: float = 0.0
+    f_catastrophe: float = 0.0
+    v_grow_collision_scale: float = 0.5
+    f_catastrophe_collision_scale: float = 2.0
+    nucleation_rate: float = 0.0
+    min_length: float = 0.5
+    radius: float = 0.025
+    bending_rigidity: float = 2.5e-3
+    min_separation: float = 0.1
+
+
+@dataclass(frozen=True)
+class PeripheryBinding:
+    active: bool = False
+    polar_angle_start: float = 0.0
+    polar_angle_end: float = math.pi
+    threshold: float = 0.75
+
+
+@dataclass(frozen=True)
+class FiberPeripheryInteraction:
+    f_0: float = 20.0
+    l_0: float = 0.05
+
+
+@dataclass(frozen=True)
+class Params:
+    eta: float = 1.0
+    dt_initial: float = 1e-2
+    dt_min: float = 1e-4
+    dt_max: float = 2.0
+    beta_up: float = 1.2
+    beta_down: float = 0.5
+    adaptive_timestep_flag: bool = True
+    dt_write: float = 0.25
+    t_final: float = 1.0
+    gmres_tol: float = 1e-10
+    gmres_restart: int = 100
+    gmres_maxiter: int = 1000
+    fiber_error_tol: float = 1e-1
+    seed: int = 1
+    implicit_motor_activation_delay: float = 0.0
+    periphery_interaction_flag: bool = False
+    dynamic_instability: DynamicInstability = field(default_factory=DynamicInstability)
+    periphery_binding: PeripheryBinding = field(default_factory=PeripheryBinding)
+    fiber_periphery_interaction: FiberPeripheryInteraction = field(
+        default_factory=FiberPeripheryInteraction)

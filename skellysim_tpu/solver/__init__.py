@@ -1,0 +1,1 @@
+from .gmres import gmres, GmresResult  # noqa: F401

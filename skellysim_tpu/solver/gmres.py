@@ -1,0 +1,147 @@
+"""Matrix-free right-preconditioned GMRES, jit-able and mesh-shardable.
+
+Replaces the reference's Trilinos Belos PseudoBlockGmresSolMgr wrapper
+(`/root/reference/src/core/solver_hydro.cpp:63-95`, `include/solver.hpp:10-49`)
+with a pure-JAX implementation:
+
+* right preconditioning (``A M^-1 (M x) = b``), matching
+  `problem.setRightPrec(preconditioner_)` (`solver_hydro.cpp:66`)
+* ICGS orthogonalization (two rounds of classical Gram-Schmidt), matching
+  `belosList.set("Orthogonalization", "ICGS")` (`solver_hydro.cpp:72`)
+* convergence on the implicit (Givens) residual relative to ||b||, matching
+  Belos' relative convergence tolerance with the reference's zero initial guess
+* fixed-size Krylov basis + `lax.while_loop` so the whole solve stays inside one
+  XLA program; dot products are plain jnp reductions, so under pjit sharding the
+  compiler inserts the psum collectives the reference got from Tpetra/MPI.
+
+The solver runs entirely on device; the per-step "rebuild the Belos problem"
+host round-trip of the reference (`system.cpp:467`) has no analogue here.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class GmresResult(NamedTuple):
+    x: jnp.ndarray          # solution
+    iters: jnp.ndarray      # int32, total inner iterations
+    residual: jnp.ndarray   # implicit relative residual at exit
+    converged: jnp.ndarray  # bool
+
+
+def _icgs(V, w, k, n_restart):
+    """Two-pass classical Gram-Schmidt of w against V[:k+1] (rows are basis vectors).
+
+    Uses a mask over the fixed-size basis so the loop stays shape-static.
+    """
+    mask = (jnp.arange(n_restart + 1) <= k).astype(w.dtype)
+    h = jnp.zeros(n_restart + 1, dtype=w.dtype)
+    for _ in range(2):
+        proj = mask * (V @ w)            # [m+1] masked dots  <v_i, w>
+        w = w - proj @ V
+        h = h + proj
+    return w, h
+
+
+@partial(jax.jit, static_argnames=("matvec", "precond", "restart", "maxiter"))
+def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
+          tol: float = 1e-10, restart: int = 100, maxiter: int = 1000) -> GmresResult:
+    """Solve ``matvec(x) = b`` with right-preconditioned restarted GMRES.
+
+    ``precond`` approximates A^-1 (applied on the right). Initial guess is zero,
+    like the reference's freshly constructed solution vector each step.
+    """
+    n = b.shape[0]
+    dtype = b.dtype
+    m = min(restart, maxiter)
+    M = precond if precond is not None else (lambda v: v)
+
+    b_norm = jnp.linalg.norm(b)
+    # all-zero RHS -> solution zero, declare converged immediately
+    safe_b_norm = jnp.where(b_norm > 0.0, b_norm, 1.0)
+    tol_abs = tol * safe_b_norm
+
+    def arnoldi_cycle(x0):
+        """One restart cycle starting from x0; returns (x, resid, inner_iters)."""
+        r0 = b - matvec(x0)
+        beta = jnp.linalg.norm(r0)
+        safe_beta = jnp.where(beta > 0.0, beta, 1.0)
+
+        V0 = jnp.zeros((m + 1, n), dtype=dtype).at[0].set(r0 / safe_beta)
+        H0 = jnp.zeros((m + 1, m), dtype=dtype)
+        cs0 = jnp.zeros(m, dtype=dtype)
+        sn0 = jnp.zeros(m, dtype=dtype)
+        g0 = jnp.zeros(m + 1, dtype=dtype).at[0].set(beta)
+
+        def cond(state):
+            k, _, _, _, _, _, done = state
+            return (k < m) & ~done
+
+        def body(state):
+            k, V, H, cs, sn, g, done = state
+            w = matvec(M(V[k]))
+            w, h = _icgs(V, w, k, m)
+            h_norm = jnp.linalg.norm(w)
+            h = h.at[k + 1].set(h_norm)
+            V = V.at[k + 1].set(w / jnp.where(h_norm > 0.0, h_norm, 1.0))
+
+            # apply accumulated Givens rotations to the new column
+            def rot(i, hcol):
+                hi, hip = hcol[i], hcol[i + 1]
+                return hcol.at[i].set(cs[i] * hi + sn[i] * hip).at[i + 1].set(-sn[i] * hi + cs[i] * hip)
+
+            h = lax.fori_loop(0, k, rot, h)
+            # new rotation to zero h[k+1]
+            denom = jnp.sqrt(h[k] ** 2 + h[k + 1] ** 2)
+            denom_safe = jnp.where(denom > 0.0, denom, 1.0)
+            c_new = jnp.where(denom > 0.0, h[k] / denom_safe, 1.0)
+            s_new = jnp.where(denom > 0.0, h[k + 1] / denom_safe, 0.0)
+            h = h.at[k].set(denom).at[k + 1].set(0.0)
+            cs = cs.at[k].set(c_new)
+            sn = sn.at[k].set(s_new)
+            g = g.at[k + 1].set(-s_new * g[k]).at[k].set(c_new * g[k])
+            H = H.at[:, k].set(h)
+
+            done = jnp.abs(g[k + 1]) <= tol_abs
+            return k + 1, V, H, cs, sn, g, done
+
+        k, V, H, cs, sn, g, done = lax.while_loop(
+            cond, body, (jnp.int32(0), V0, H0, cs0, sn0, g0, beta <= tol_abs))
+
+        # solve the k x k triangular system via masked back-substitution
+        idx = jnp.arange(m)
+        active = idx < k
+
+        def back_sub(i, y):
+            j = m - 1 - i
+            hjj = H[j, j]
+            rhs = g[j] - jnp.dot(H[j, :], y)
+            yj = jnp.where(active[j], rhs / jnp.where(hjj != 0.0, hjj, 1.0), 0.0)
+            return y.at[j].set(yj)
+
+        y = lax.fori_loop(0, m, back_sub, jnp.zeros(m, dtype=dtype))
+        dx = M(y @ V[:m])
+        resid = jnp.abs(g[jnp.minimum(k, m)]) / safe_b_norm
+        return x0 + dx, resid, k
+
+    def outer_cond(state):
+        x, resid, total_iters, cycles = state
+        del x
+        return (resid > tol) & (total_iters < maxiter)
+
+    def outer_body(state):
+        x, _, total_iters, cycles = state
+        x, resid, k = arnoldi_cycle(x)
+        return x, resid, total_iters + k, cycles + 1
+
+    x0 = jnp.zeros_like(b)
+    init_resid = jnp.where(b_norm > 0.0, jnp.array(jnp.inf, dtype=dtype), jnp.array(0.0, dtype=dtype))
+    x, resid, iters, _ = lax.while_loop(
+        outer_cond, outer_body, (x0, init_resid, jnp.int32(0), jnp.int32(0)))
+    return GmresResult(x=x, iters=iters, residual=resid, converged=resid <= tol)

@@ -1,0 +1,2 @@
+from .sources import PointSources, BackgroundFlow  # noqa: F401
+from .system import SimState, System  # noqa: F401

@@ -1,0 +1,67 @@
+"""Point force/torque sources and background flow.
+
+Mirrors `PointSourceContainer` (`/root/reference/src/core/point_source.cpp:16-53`)
+and `BackgroundSource` (`src/core/background_source.cpp:15-23`) as stateless
+batched pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..ops import kernels
+
+
+class PointSources(NamedTuple):
+    """Batched point sources; time_to_live == 0 means always alive."""
+
+    position: jnp.ndarray      # [np, 3]
+    force: jnp.ndarray         # [np, 3]
+    torque: jnp.ndarray        # [np, 3]
+    time_to_live: jnp.ndarray  # [np]
+
+    @staticmethod
+    def make(position, force=None, torque=None, time_to_live=0.0, dtype=jnp.float64):
+        position = jnp.asarray(position, dtype=dtype).reshape(-1, 3)
+        n = position.shape[0]
+        z = jnp.zeros((n, 3), dtype=dtype)
+        return PointSources(
+            position=position,
+            force=z if force is None else jnp.asarray(force, dtype=dtype).reshape(-1, 3),
+            torque=z if torque is None else jnp.asarray(torque, dtype=dtype).reshape(-1, 3),
+            time_to_live=jnp.broadcast_to(jnp.asarray(time_to_live, dtype=dtype), (n,)),
+        )
+
+    def flow(self, r_trg, eta, time):
+        """Oseen + rotlet flow at targets; expired sources are masked to zero."""
+        alive = (self.time_to_live == 0.0) | (time < self.time_to_live)
+        f = jnp.where(alive[:, None], self.force, 0.0)
+        t = jnp.where(alive[:, None], self.torque, 0.0)
+        u = kernels.oseen_contract(self.position, r_trg, f, eta)
+        u = u + kernels.rotlet(self.position, r_trg, t, eta)
+        return u
+
+
+class BackgroundFlow(NamedTuple):
+    """v_j = uniform_j + r[components_j] * scale_j (`background_source.cpp:15-23`)."""
+
+    uniform: jnp.ndarray     # [3]
+    components: jnp.ndarray  # [3] int
+    scale: jnp.ndarray       # [3]
+
+    @staticmethod
+    def make(uniform=(0.0, 0.0, 0.0), components=(0, 1, 2), scale=(0.0, 0.0, 0.0),
+             dtype=jnp.float64):
+        return BackgroundFlow(
+            uniform=jnp.asarray(uniform, dtype=dtype),
+            components=jnp.asarray(components, dtype=jnp.int32),
+            scale=jnp.asarray(scale, dtype=dtype),
+        )
+
+    def flow(self, r_trg, eta):
+        return self.uniform[None, :] + r_trg[:, self.components] * self.scale[None, :]
+
+    def is_active(self):
+        return bool(jnp.any(self.uniform != 0.0) | jnp.any(self.scale != 0.0))

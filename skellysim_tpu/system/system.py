@@ -1,0 +1,217 @@
+"""System orchestrator: state pytree, coupled matvec, solve, adaptive time loop.
+
+TPU-native replacement for the reference `System` namespace
+(`/root/reference/src/core/system.cpp`): instead of namespace-level singletons
+mutated in place, the whole simulation is one immutable `SimState` pytree and the
+per-step work (`prep_state_for_solver` -> GMRES -> component steps) is a jit'd
+pure function. Backup/restore for rejected adaptive steps
+(`system.cpp:495-513`) is free: keep the previous pytree.
+
+The solution vector layout matches the reference (`system.cpp:75-96`):
+[fibers (4n per fiber) | shell (3 per node) | bodies (3 per node + 6 per body)].
+Periphery and bodies plug into `_apply_matvec`/`_apply_precond`/`_prep` in the
+same seams as `system.cpp:269-324`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fibers import container as fc
+from ..params import Params
+from ..solver import gmres
+from .sources import BackgroundFlow, PointSources
+
+
+class SimState(NamedTuple):
+    """Complete simulation state (a pytree)."""
+
+    time: jnp.ndarray
+    dt: jnp.ndarray
+    fibers: Optional[fc.FiberGroup]
+    points: Optional[PointSources]
+    background: Optional[BackgroundFlow]
+    shell: Any = None    # periphery.PeripheryState once present
+    bodies: Any = None   # bodies.BodyState once present
+
+
+class StepInfo(NamedTuple):
+    converged: jnp.ndarray
+    iters: jnp.ndarray
+    residual: jnp.ndarray
+    fiber_error: jnp.ndarray
+
+
+class System:
+    """Holds static config; all dynamics flow through pure jit'd functions."""
+
+    def __init__(self, params: Params):
+        self.params = params
+        self._solve_jit = jax.jit(self._solve_impl)
+        self._fiber_error_jit = jax.jit(self._fiber_error)
+
+    # ------------------------------------------------------------- state setup
+
+    def make_state(self, fibers=None, points=None, background=None,
+                   shell=None, bodies=None) -> SimState:
+        dtype = fibers.x.dtype if fibers is not None else jnp.float64
+        return SimState(
+            time=jnp.asarray(0.0, dtype=dtype),
+            dt=jnp.asarray(self.params.dt_initial, dtype=dtype),
+            fibers=fibers, points=points, background=background,
+            shell=shell, bodies=bodies)
+
+    # ----------------------------------------------------------------- helpers
+
+    def _fiber_node_positions(self, state: SimState):
+        if state.fibers is None:
+            return jnp.zeros((0, 3), dtype=jnp.float64)
+        return fc.node_positions(state.fibers)
+
+    def _external_flows(self, state: SimState, r_trg):
+        """Point-source + background contributions (`system.cpp:445-446`)."""
+        v = jnp.zeros_like(r_trg)
+        if state.points is not None:
+            v = v + state.points.flow(r_trg, self.params.eta, state.time)
+        if state.background is not None:
+            v = v + state.background.flow(r_trg, self.params.eta)
+        return v
+
+    # ------------------------------------------------------------------- prep
+
+    def _prep(self, state: SimState):
+        """All velocities/forces/RHS/BC assembly (`prep_state_for_solver`,
+        `system.cpp:398-458`). Returns per-component caches."""
+        p = self.params
+        fibers = state.fibers
+        caches = None
+        if fibers is not None:
+            caches = fc.update_cache(fibers, state.dt, p.eta)
+
+            r_all = self._fiber_node_positions(state)
+
+            nf, n = fibers.n_fibers, fibers.n_nodes
+            zero_f = jnp.zeros((nf, n, 3), dtype=fibers.x.dtype)
+
+            # motor force activates after the configured delay (`system.cpp:417-419`)
+            motor = jnp.where(state.time >= p.implicit_motor_activation_delay,
+                              fc.generate_constant_force(fibers, caches), zero_f)
+            external = zero_f  # fiber-periphery steric force once shell exists
+
+            v_all = fc.flow(fibers, caches, r_all, external, p.eta)
+            v_all = v_all + self._external_flows(state, r_all)
+            v_fib = v_all.reshape(nf, n, 3)
+
+            caches = fc.update_rhs_and_bc(fibers, caches, state.dt, p.eta,
+                                          v_fib, motor + external, external)
+        return caches
+
+    # ------------------------------------------------------- operator closures
+
+    def _apply_matvec(self, state: SimState, caches, x_flat):
+        """Coupled operator A x (`apply_matvec`, `system.cpp:269-324`)."""
+        p = self.params
+        fibers = state.fibers
+        nf, n = fibers.n_fibers, fibers.n_nodes
+        x_fib = x_flat[:nf * 4 * n].reshape(nf, 4 * n)
+
+        r_all = self._fiber_node_positions(state)
+        fw = fc.apply_fiber_force(fibers, caches, x_fib)
+        v_all = fc.flow(fibers, caches, r_all, fw, p.eta, subtract_self=True)
+        v_fib = v_all[:nf * n].reshape(nf, n, 3)
+
+        v_boundary = jnp.zeros((nf, 7), dtype=x_flat.dtype)  # body links later
+        res_fib = fc.matvec(fibers, caches, x_fib, v_fib, v_boundary)
+        return res_fib.reshape(-1)
+
+    def _apply_precond(self, state: SimState, caches, x_flat):
+        """Block preconditioner P^-1 x (`apply_preconditioner`, `system.cpp:248-262`)."""
+        fibers = state.fibers
+        nf, n = fibers.n_fibers, fibers.n_nodes
+        x_fib = x_flat[:nf * 4 * n].reshape(nf, 4 * n)
+        y = fc.apply_preconditioner(fibers, caches, x_fib)
+        return y.reshape(-1)
+
+    # ------------------------------------------------------------------- solve
+
+    def _solve_impl(self, state: SimState):
+        p = self.params
+        caches = self._prep(state)
+        rhs = caches.RHS.reshape(-1)
+        result = gmres(
+            lambda v: self._apply_matvec(state, caches, v), rhs,
+            precond=lambda v: self._apply_precond(state, caches, v),
+            tol=p.gmres_tol, restart=p.gmres_restart, maxiter=p.gmres_maxiter)
+
+        fibers = state.fibers
+        nf, n = fibers.n_fibers, fibers.n_nodes
+        sol_fib = result.x[:nf * 4 * n].reshape(nf, 4 * n)
+        new_fibers = fc.step(fibers, sol_fib)
+        new_state = state._replace(fibers=new_fibers)
+        info = StepInfo(converged=result.converged, iters=result.iters,
+                        residual=result.residual,
+                        fiber_error=fc.fiber_error(new_fibers))
+        return new_state, result.x, info
+
+    def _fiber_error(self, state: SimState):
+        return fc.fiber_error(state.fibers)
+
+    # -------------------------------------------------------------- public API
+
+    def step(self, state: SimState):
+        """One trial step at state.dt: solve + advance components (`step`,
+        `system.cpp:482-492`). Returns (new_state, solution, info)."""
+        return self._solve_jit(state)
+
+    def run(self, state: SimState, *, writer=None, max_steps: int | None = None):
+        """Adaptive time loop (`run`, `system.cpp:516-571`).
+
+        Host-side control flow around the jit'd step: accept/reject on fiber
+        error, scale dt by beta_up/beta_down, keep the previous pytree as the
+        backup for rejected steps. ``writer`` is called with (state, solution)
+        after each accepted step that crosses a dt_write boundary.
+        """
+        p = self.params
+        n_steps = 0
+        while float(state.time) < p.t_final:
+            if max_steps is not None and n_steps >= max_steps:
+                break
+            backup = state
+            new_state, solution, info = self.step(state)
+            n_steps += 1
+            converged = bool(info.converged)
+            fiber_error = float(info.fiber_error)
+
+            dt = float(state.dt)
+            dt_new = dt
+            accept = True
+            if p.adaptive_timestep_flag:
+                if converged and fiber_error <= p.fiber_error_tol:
+                    accept = True
+                    if fiber_error <= 0.9 * p.fiber_error_tol:
+                        dt_new = min(p.dt_max, dt * p.beta_up)
+                else:
+                    dt_new = dt * p.beta_down
+                    accept = False
+
+                # collision gate (`system.cpp:542-546`) once shell/bodies exist
+
+                if dt_new < p.dt_min:
+                    raise RuntimeError("Timestep smaller than dt_min")
+
+            if accept:
+                t_new = float(state.time) + dt
+                state = new_state._replace(
+                    time=jnp.asarray(t_new, dtype=state.time.dtype),
+                    dt=jnp.asarray(dt_new, dtype=state.dt.dtype))
+                if writer is not None and (int(t_new / p.dt_write)
+                                           > int((t_new - dt) / p.dt_write)):
+                    writer(state, solution)
+            else:
+                state = backup._replace(dt=jnp.asarray(dt_new, dtype=state.dt.dtype))
+        return state

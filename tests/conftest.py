@@ -3,16 +3,33 @@
 Mirrors the reference's multi-rank-without-a-cluster strategy
 (`/root/reference/tests/core/unit_tests/CMakeLists.txt:12-19`: ctest under
 `mpiexec -n 2`): sharding correctness is exercised on a virtual device mesh, and
-physics accuracy gates run in float64 on CPU. Must set env vars before jax import.
+physics accuracy gates run in float64 on CPU.
+
+The session environment registers the experimental `axon` TPU platform via a
+sitecustomize hook; its client init goes through a tunnel that can block for
+minutes, so CPU test runs unregister it entirely before JAX initializes any
+backend.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override: the session env pins axon (TPU)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Unregister the axon factory outright: JAX_PLATFORMS=cpu alone was observed NOT
+# to prevent the axon client init (the sitecustomize hook routes get_backend
+# through backends(), which then initializes axon and can block on the tunnel).
+# Private API, so guard against jax-version drift.
+try:
+    import jax._src.xla_bridge as _xb  # noqa: E402
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

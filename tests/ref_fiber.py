@@ -1,0 +1,230 @@
+"""Literal NumPy transcription of the reference FiberFiniteDifference math.
+
+Test oracle only. Follows `/root/reference/src/core/fiber_finite_difference.cpp`
+statement-by-statement in the reference's columns-as-points [3, n] layout, so a
+discrepancy with the idiomatic JAX implementation indicates a transcription bug
+in one of the two. Not used by the framework itself.
+"""
+
+import numpy as np
+
+from skellysim_tpu.ops.finite_diff import barycentric_matrix, finite_diff
+
+
+class RefMats:
+    def __init__(self, n):
+        self.alpha = np.linspace(-1, 1, n)
+        nr = n - 4
+        self.alpha_roots = 2 * (0.5 + np.arange(nr)) / nr - 1
+        nt = n - 2
+        self.alpha_tension = 2 * (0.5 + np.arange(nt)) / nt - 1
+        # pre-transposed like the reference (D_1_0 etc.)
+        self.D_1_0 = finite_diff(self.alpha, 1, 5).T
+        self.D_2_0 = finite_diff(self.alpha, 2, 6).T
+        self.D_3_0 = finite_diff(self.alpha, 3, 7).T
+        self.D_4_0 = finite_diff(self.alpha, 4, 8).T
+        self.P_X = barycentric_matrix(self.alpha, self.alpha_roots)
+        self.P_T = barycentric_matrix(self.alpha, self.alpha_tension)
+        self.weights_0 = np.full(n, 2.0)
+        self.weights_0[[0, -1]] = 1.0
+        self.weights_0 /= n - 1
+        P = np.zeros((4 * n - 14, 4 * n))
+        P[0 * (n - 4):1 * (n - 4), 0 * n:1 * n] = self.P_X
+        P[1 * (n - 4):2 * (n - 4), 1 * n:2 * n] = self.P_X
+        P[2 * (n - 4):3 * (n - 4), 2 * n:3 * n] = self.P_X
+        P[3 * (n - 4):3 * (n - 4) + nt, 3 * n:4 * n] = self.P_T
+        self.P_downsample_bc = P
+
+
+class RefFiber:
+    """BCs: 'velocity' (clamped) or 'force' (free) minus end; plus end
+    'velocity' (hinged) or 'force'."""
+
+    def __init__(self, x, length, bending_rigidity, radius, eta,
+                 length_prev=None, penalty=500.0, beta_tstep=1.0, v_growth=0.0):
+        self.x = np.asarray(x)          # [3, n]
+        self.n = self.x.shape[1]
+        self.mats = RefMats(self.n)
+        self.L = length
+        self.L_prev = length if length_prev is None else length_prev
+        self.E = bending_rigidity
+        self.radius = radius
+        self.eta = eta
+        self.penalty = penalty
+        self.beta = beta_tstep
+        self.v_growth = v_growth
+        eps = radius / length
+        self.c0 = -np.log(np.e * eps**2) / (8 * np.pi * eta)
+        self.c1 = 2.0 / (8 * np.pi * eta)
+        self.update_derivatives()
+
+    def update_derivatives(self):
+        m = self.mats
+        self.xs = (2.0 / self.L_prev) * self.x @ m.D_1_0
+        self.xss = (2.0 / self.L_prev) ** 2 * self.x @ m.D_2_0
+        self.xsss = (2.0 / self.L_prev) ** 3 * self.x @ m.D_3_0
+        self.xssss = (2.0 / self.L_prev) ** 4 * self.x @ m.D_4_0
+
+    def update_linear_operator(self, dt):
+        n = self.n
+        m = self.mats
+        D1 = m.D_1_0.T * (2.0 / self.L)
+        D2 = m.D_2_0.T * (2.0 / self.L) ** 2
+        D3 = m.D_3_0.T * (2.0 / self.L) ** 3
+        D4 = m.D_4_0.T * (2.0 / self.L) ** 4
+        I = np.eye(n)
+        xs, xss, xsss = self.xs, self.xss, self.xsss
+        E, c0, c1 = self.E, self.c0, self.c1
+        A = np.zeros((4 * n, 4 * n))
+
+        def blk(i, j):
+            return A[i * n:(i + 1) * n, j * n:(j + 1) * n]
+
+        for i in range(3):
+            blk(i, i)[:] = self.beta / dt * I \
+                + E * c0 * ((1 + xs[i] ** 2)[:, None] * D4) \
+                + E * c1 * ((1 - xs[i] ** 2)[:, None] * D4)
+        for i, j in [(0, 1), (0, 2), (1, 2)]:
+            blk(i, j)[:] = E * (c0 - c1) * ((xs[i] * xs[j])[:, None] * D4)
+            blk(j, i)[:] = blk(i, j)
+        for i in range(3):
+            blk(i, 3)[:] = -(2 * c0) * (xs[i][:, None] * D1) - (c0 + c1) * np.diag(xss[i])
+            blk(3, i)[:] = -(c1 + 7 * c0) * E * (xss[i][:, None] * D4) \
+                - 6 * c0 * E * (xsss[i][:, None] * D3) \
+                - self.penalty * (xs[i][:, None] * D1)
+        blk(3, 3)[:] = -2 * c0 * D2 + (c0 + c1) * np.diag((xss ** 2).sum(axis=0))
+        self.A = A
+
+    def update_RHS(self, dt, flow, f_external):
+        n = self.n
+        m = self.mats
+        D_1 = m.D_1_0 * (2.0 / self.L)
+        xs = self.xs
+        s_dot = (1.0 + m.alpha) * 0.5 * self.v_growth
+        RHS = np.zeros(4 * n)
+        for i in range(3):
+            RHS[i * n:(i + 1) * n] = self.x[i] / dt + s_dot * xs[i]
+        RHS[3 * n:] = -self.penalty
+        if flow is not None:
+            for i in range(3):
+                RHS[i * n:(i + 1) * n] += flow[i]
+            RHS[3 * n:] += sum(xs[i] * (flow[i] @ D_1) for i in range(3))
+        if f_external is not None:
+            f = f_external
+            fs = f @ D_1
+            c0, c1 = self.c0, self.c1
+            xsf = sum(xs[i] * f[i] for i in range(3))
+            for i in range(3):
+                RHS[i * n:(i + 1) * n] += c0 * (f[i] + xs[i] * xsf) + c1 * (f[i] - xs[i] * xsf)
+            RHS[3 * n:] += 2 * c0 * sum(xs[i] * fs[i] for i in range(3))
+            RHS[3 * n:] += (c0 - c1) * sum(self.xss[i] * f[i] for i in range(3))
+        self.RHS = RHS
+
+    def apply_bc_rectangular(self, dt, v_on_fiber, f_on_fiber, bc_minus, bc_plus):
+        n = self.n
+        m = self.mats
+        D_1 = m.D_1_0.T * (2.0 / self.L)
+        D_2 = m.D_2_0.T * (2.0 / self.L) ** 2
+        D_3 = m.D_3_0.T * (2.0 / self.L) ** 3
+        E, c0 = self.E, self.c0
+        xs, xss = self.xs, self.xss
+
+        A = np.zeros_like(self.A)
+        A[:4 * n - 14] = m.P_downsample_bc @ self.A
+        RHS = np.zeros_like(self.RHS)
+        RHS[:4 * n - 14] = m.P_downsample_bc @ self.RHS
+        B = A[4 * n - 14:]
+        B_RHS = RHS[4 * n - 14:]
+
+        v0 = v_on_fiber[:, 0] if v_on_fiber is not None else np.zeros(3)
+        ve = v_on_fiber[:, -1] if v_on_fiber is not None else np.zeros(3)
+        f0 = f_on_fiber[:, 0] if f_on_fiber is not None else np.zeros(3)
+        fe = f_on_fiber[:, -1] if f_on_fiber is not None else np.zeros(3)
+
+        if bc_minus == "velocity":
+            B[0, 0 * n] = self.beta / dt
+            B[1, 1 * n] = self.beta / dt
+            B[2, 2 * n] = self.beta / dt
+            for i in range(3):
+                B[3, i * n:(i + 1) * n] = 6 * E * c0 * xss[i, 0] * D_3[0]
+            B[3, 3 * n:] = 2 * c0 * D_1[0]
+            B_RHS[0:3] = self.x[:, 0] / dt
+            B_RHS[3] = -xs[:, 0] @ v0 - 2 * c0 * (xs[:, 0] @ f0)
+        else:
+            for i in range(3):
+                B[i, i * n:(i + 1) * n] = E * D_3[0]
+                B[i, 3 * n] = -xs[i, 0]
+                B[3, i * n:(i + 1) * n] = -E * D_2[0] * xss[i, 0]
+            B[3, 3 * n] = -1.0
+            B_RHS[0:3] = f0
+            B_RHS[3] = f0 @ xs[:, 0]
+
+        if bc_minus == "velocity":  # AngularVelocity
+            for i in range(3):
+                B[4 + i, i * n:(i + 1) * n] = self.beta / dt * D_1[0]
+            B_RHS[4:7] = xs[:, 0] / dt
+        else:  # Torque
+            for i in range(3):
+                B[4 + i, i * n:(i + 1) * n] = D_2[0]
+            B_RHS[4:7] = 0.0
+
+        if bc_plus == "velocity":
+            B[7, 1 * n - 1] = self.beta / dt
+            B[8, 2 * n - 1] = self.beta / dt
+            B[9, 3 * n - 1] = self.beta / dt
+            for i in range(3):
+                B[10, i * n:(i + 1) * n] = 6 * E * c0 * D_3[-1] * xss[i, -1]
+            B[10, 3 * n:] = 2 * c0 * D_1[-1]
+            B_RHS[7:10] = self.x[:, -1] / dt
+            B_RHS[10] = -xs[:, -1] @ ve - 2 * c0 * (xs[:, -1] @ fe)
+        else:
+            for i in range(3):
+                B[7 + i, i * n:(i + 1) * n] = -E * D_3[-1]
+                B[7 + i, 4 * n - 1] = xs[i, -1]
+                B[10, i * n:(i + 1) * n] = E * D_2[-1] * xss[i, -1]
+            B[10, 4 * n - 1] = 1.0
+            B_RHS[7:10] = fe
+            B_RHS[10] = fe @ xs[:, -1]
+
+        for i in range(3):  # plus Torque (always)
+            B[11 + i, i * n:(i + 1) * n] = D_2[-1]
+        B_RHS[11:14] = 0.0
+
+        self.A_bc = A
+        self.RHS_bc = RHS
+
+    def update_force_operator(self):
+        n = self.n
+        m = self.mats
+        D_1 = m.D_1_0 * (2.0 / self.L)
+        D_4 = m.D_4_0 * (2.0 / self.L) ** 4
+        fo = np.zeros((3 * n, 4 * n))
+        for i in range(3):
+            fo[i * n:(i + 1) * n, i * n:(i + 1) * n] = -self.E * D_4.T
+            fo[i * n:(i + 1) * n, 3 * n:] += np.diag(self.xss[i])
+            fo[i * n:(i + 1) * n, 3 * n:] += (D_1 * self.xs[i][None, :]).T
+        self.force_operator = fo
+
+    def matvec(self, xvec, v, v_boundary, bc_plus):
+        n = self.n
+        m = self.mats
+        bc_start = 4 * n - 14
+        D_1 = m.D_1_0 * (2.0 / self.L_prev)
+        xsDs = (D_1 * self.xs[0][:, None]).T
+        ysDs = (D_1 * self.xs[1][:, None]).T
+        zsDs = (D_1 * self.xs[2][:, None]).T
+        vT = np.zeros(4 * n)
+        vT[0 * n:1 * n] = v[0]
+        vT[1 * n:2 * n] = v[1]
+        vT[2 * n:3 * n] = v[2]
+        vT[3 * n:] = xsDs @ v[0] + ysDs @ v[1] + zsDs @ v[2]
+        vT_in = np.zeros(4 * n)
+        vT_in[:bc_start] = m.P_downsample_bc @ vT
+        xs_vT = np.zeros(4 * n)
+        xs_vT[bc_start + 3] = v[:, 0] @ self.xs[:, 0]
+        if bc_plus == "velocity":
+            xs_vT[bc_start + 10] = v[:, -1] @ self.xs[:, -1]
+        y_BC = np.zeros(4 * n)
+        if v_boundary is not None:
+            y_BC[bc_start:bc_start + 7] = v_boundary
+        return self.A_bc @ xvec - vT_in + xs_vT + y_BC

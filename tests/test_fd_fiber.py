@@ -1,0 +1,132 @@
+"""Fiber operator assembly vs a literal NumPy transcription of the reference.
+
+Two independent transcriptions of `fiber_finite_difference.cpp` (the idiomatic
+JAX one in skellysim_tpu.fibers.fd_fiber, layout [n, 3]; the literal Eigen-layout
+one in tests/ref_fiber.py) must agree to roundoff on A, RHS, BC rows, force
+operator, and matvec for both boundary-condition settings.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from skellysim_tpu.fibers import fd_fiber, get_mats
+from tests.ref_fiber import RefFiber
+
+N = 16
+ETA = 1.3
+DT = 0.013
+LENGTH = 2.1
+LENGTH_PREV = 2.05
+E_BEND = 0.05
+RADIUS = 0.0125
+
+
+def make_fiber_x(n=N, seed=0):
+    """Smooth, slightly bent fiber: arc with small perturbation."""
+    rng = np.random.default_rng(seed)
+    t = np.linspace(0, 1, n)
+    x = np.stack([
+        LENGTH * t,
+        0.1 * np.sin(2.0 * t),
+        0.05 * t**2 + 0.02 * np.cos(3 * t),
+    ], axis=1)
+    return x + 1e-3 * rng.standard_normal((n, 3))
+
+
+def scalars(v_growth=0.0):
+    return fd_fiber.FiberScalars(
+        length=jnp.asarray(LENGTH), length_prev=jnp.asarray(LENGTH_PREV),
+        bending_rigidity=jnp.asarray(E_BEND), radius=jnp.asarray(RADIUS),
+        penalty=jnp.asarray(500.0), beta_tstep=jnp.asarray(1.0),
+        v_growth=jnp.asarray(v_growth))
+
+
+def ref_fiber(x, v_growth=0.0):
+    return RefFiber(x.T, LENGTH, E_BEND, RADIUS, ETA,
+                    length_prev=LENGTH_PREV, v_growth=v_growth)
+
+
+@pytest.mark.parametrize("minus_clamped,plus_pinned",
+                         [(False, False), (True, False), (False, True), (True, True)])
+def test_operator_rhs_bc_match_reference(minus_clamped, plus_pinned):
+    x = make_fiber_x()
+    mats = get_mats(N)
+    sc = scalars(v_growth=0.7)
+    rng = np.random.default_rng(7)
+    flow = rng.standard_normal((N, 3))
+    f_ext = rng.standard_normal((N, 3))
+
+    xs, xss, xsss, _ = fd_fiber.derivatives(jnp.asarray(x), sc.length_prev, mats)
+    A = fd_fiber.build_A(xs, xss, xsss, DT, ETA, sc, mats)
+    RHS = fd_fiber.build_RHS(jnp.asarray(x), xs, xss, DT, ETA, sc, mats,
+                             flow=jnp.asarray(flow), f_external=jnp.asarray(f_ext))
+    A_bc, RHS_bc = fd_fiber.apply_bc_rectangular(
+        A, RHS, jnp.asarray(x), xs, xss, DT, ETA, sc, mats,
+        minus_clamped, plus_pinned,
+        v_on_fiber=jnp.asarray(flow), f_on_fiber=jnp.asarray(f_ext))
+
+    ref = ref_fiber(x, v_growth=0.7)
+    ref.update_linear_operator(DT)
+    ref.update_RHS(DT, flow.T, f_ext.T)
+    ref.apply_bc_rectangular(DT, flow.T, f_ext.T,
+                             "velocity" if minus_clamped else "force",
+                             "velocity" if plus_pinned else "force")
+
+    # tolerances are relative to the matrix scale: D4 entries reach ~1e6, so
+    # float ordering differences between the two transcriptions give ~1e-7 abs
+    def close(got, want):
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-11 * scale)
+
+    close(np.asarray(A), ref.A)
+    close(np.asarray(A_bc), ref.A_bc)
+    close(np.asarray(RHS_bc), ref.RHS_bc)
+
+
+def test_force_operator_matches_reference():
+    x = make_fiber_x(seed=2)
+    mats = get_mats(N)
+    sc = scalars()
+    xs, xss, _, _ = fd_fiber.derivatives(jnp.asarray(x), sc.length_prev, mats)
+    fo = fd_fiber.force_operator(xs, xss, ETA, sc, mats)
+
+    ref = ref_fiber(x)
+    ref.update_force_operator()
+    np.testing.assert_allclose(np.asarray(fo), ref.force_operator, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("plus_pinned", [False, True])
+def test_matvec_matches_reference(plus_pinned):
+    x = make_fiber_x(seed=3)
+    mats = get_mats(N)
+    sc = scalars()
+    rng = np.random.default_rng(11)
+    xvec = rng.standard_normal(4 * N)
+    v = rng.standard_normal((N, 3))
+    v_bdy = rng.standard_normal(7)
+
+    xs, xss, xsss, _ = fd_fiber.derivatives(jnp.asarray(x), sc.length_prev, mats)
+    A = fd_fiber.build_A(xs, xss, xsss, DT, ETA, sc, mats)
+    RHS = fd_fiber.build_RHS(jnp.asarray(x), xs, xss, DT, ETA, sc, mats)
+    A_bc, _ = fd_fiber.apply_bc_rectangular(
+        A, RHS, jnp.asarray(x), xs, xss, DT, ETA, sc, mats, False, plus_pinned)
+    got = fd_fiber.matvec(A_bc, jnp.asarray(xvec), jnp.asarray(v),
+                          jnp.asarray(v_bdy), xs, sc, mats, plus_pinned)
+
+    ref = ref_fiber(x)
+    ref.update_linear_operator(DT)
+    ref.update_RHS(DT, None, None)
+    ref.apply_bc_rectangular(DT, None, None, "force",
+                             "velocity" if plus_pinned else "force")
+    want = ref.matvec(xvec, v.T, v_bdy, "velocity" if plus_pinned else "force")
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-9, atol=1e-9 * np.abs(want).max())
+
+
+def test_fiber_error_straight_fiber_zero():
+    n = 16
+    t = np.linspace(0, 1, n)
+    x = np.stack([LENGTH * t, np.zeros(n), np.zeros(n)], axis=1)
+    err = fd_fiber.fiber_error(jnp.asarray(x), LENGTH, get_mats(n))
+    assert float(err) < 1e-12

@@ -1,0 +1,56 @@
+"""GMRES solver tests against dense numpy solves."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from skellysim_tpu.solver import gmres
+
+
+def _system(n, seed, cond_boost=0.0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((n, n)) / np.sqrt(n) + (2.0 + cond_boost) * np.eye(n)
+    b = rng.standard_normal(n)
+    return A, b
+
+
+def test_gmres_unpreconditioned():
+    A, b = _system(60, 0)
+    res = gmres(lambda v: jnp.asarray(A) @ v, jnp.asarray(b), tol=1e-12, restart=60)
+    assert bool(res.converged)
+    np.testing.assert_allclose(np.asarray(res.x), np.linalg.solve(A, b), rtol=1e-9, atol=1e-10)
+
+
+def test_gmres_right_preconditioned_fewer_iters():
+    A, b = _system(80, 1)
+    M = np.linalg.inv(A + 0.05 * np.random.default_rng(2).standard_normal((80, 80)))
+    plain = gmres(lambda v: jnp.asarray(A) @ v, jnp.asarray(b), tol=1e-10, restart=80)
+    prec = gmres(lambda v: jnp.asarray(A) @ v, jnp.asarray(b),
+                 precond=lambda v: jnp.asarray(M) @ v, tol=1e-10, restart=80)
+    assert bool(prec.converged)
+    assert int(prec.iters) < int(plain.iters)
+    np.testing.assert_allclose(np.asarray(prec.x), np.linalg.solve(A, b), rtol=1e-7, atol=1e-8)
+
+
+def test_gmres_restarted():
+    A, b = _system(100, 3, cond_boost=2.0)
+    res = gmres(lambda v: jnp.asarray(A) @ v, jnp.asarray(b), tol=1e-10, restart=25, maxiter=400)
+    assert bool(res.converged)
+    np.testing.assert_allclose(np.asarray(res.x), np.linalg.solve(A, b), rtol=1e-7, atol=1e-8)
+
+
+def test_gmres_zero_rhs():
+    A, _ = _system(20, 4)
+    res = gmres(lambda v: jnp.asarray(A) @ v, jnp.zeros(20), tol=1e-12)
+    assert bool(res.converged)
+    assert int(res.iters) == 0
+    np.testing.assert_allclose(np.asarray(res.x), 0.0)
+
+
+def test_gmres_exact_in_n_iterations():
+    # Krylov exactness: an n-dim system converges within n inner iterations
+    A, b = _system(30, 5)
+    res = gmres(lambda v: jnp.asarray(A) @ v, jnp.asarray(b), tol=1e-13, restart=30)
+    assert int(res.iters) <= 30
+    explicit = np.linalg.norm(A @ np.asarray(res.x) - b) / np.linalg.norm(b)
+    assert explicit < 1e-11
