@@ -1,0 +1,2 @@
+from .quadrature import surface_quadrature_weights  # noqa: F401
+from .shapes import ShapeSpec, sphere_shape, ellipsoid_shape, surface_of_revolution_shape  # noqa: F401

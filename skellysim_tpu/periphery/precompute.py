@@ -1,0 +1,87 @@
+"""Offline precompute: periphery/body quadrature + dense shell operator inverse.
+
+Mirror of the reference's `skelly_precompute` pipeline
+(`/root/reference/src/skelly_sim/precompute.py:37-245`): build surface nodes
+(shape gallery), triangulate (convex hull), compute Reeger-Fornberg quadrature
+weights, assemble the dense second-kind shell operator and invert it. Results
+are plain dicts of NumPy arrays, storable as npz (same keys as the reference so
+trajectories/precompute files interoperate).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.spatial import ConvexHull
+
+from .periphery import build_shell_operator
+from .quadrature import surface_quadrature_weights
+from .shapes import ShapeSpec, ellipsoid_shape, sphere_shape, surface_of_revolution_shape
+
+#: node radius inflation relative to the attachment radius (`precompute.py:34`)
+PERIPHERY_NODE_SCALE_FACTOR = 1.04
+#: body quadrature radius shrinkage (`precompute.py:27-29`)
+BODY_QUADRATURE_RADIUS_OFFSET_LOW = 0.1
+BODY_QUADRATURE_RADIUS_OFFSET_HIGH = 0.2
+BODY_QUADRATURE_RADIUS_THRESHOLD = 2.0
+
+
+def _shape_for_periphery(shape: str, n_nodes: int, **kw) -> ShapeSpec:
+    s = PERIPHERY_NODE_SCALE_FACTOR
+    if shape == "sphere":
+        return sphere_shape(n_nodes, radius=kw["radius"] * s)
+    if shape == "ellipsoid":
+        return ellipsoid_shape(n_nodes, a=kw["a"] * s, b=kw["b"] * s, c=kw["c"] * s)
+    if shape == "surface_of_revolution":
+        return surface_of_revolution_shape(kw["envelope"], scale_factor=s)
+    raise ValueError(f"unknown periphery shape: {shape}")
+
+
+def precompute_periphery(shape: str, n_nodes: int = 0, eta: float = 1.0, **kw) -> dict:
+    """Full periphery precompute. Returns dict with the reference npz keys:
+    nodes, normals (inward), quadrature_weights, stresslet_plus_complementary,
+    M_inv (+ envelope fit state for surfaces of revolution)."""
+    spec = _shape_for_periphery(shape, n_nodes, **kw)
+    nodes = spec.nodes
+    normals = -spec.node_normals  # periphery normals point inward (`precompute.py:82`)
+
+    tris = ConvexHull(nodes).simplices
+    weights = surface_quadrature_weights(nodes, tris, spec.gradh)
+
+    operator, M_inv = build_shell_operator(nodes, normals, weights, eta=eta)
+
+    out = {
+        "nodes": nodes,
+        "normals": normals,
+        "quadrature_weights": weights,
+        "stresslet_plus_complementary": operator,
+        "M_inv": M_inv,
+    }
+    if spec.envelope is not None:
+        out.update(spec.envelope.get_state())
+    return out
+
+
+def precompute_body(shape: str, n_nodes: int, radius: float = 0.0,
+                    a: float = 0.0, b: float = 0.0, c: float = 0.0) -> dict:
+    """Body surface precompute: reference-frame nodes/normals + quadrature weights.
+
+    Spheres shrink the quadrature-node radius below the hydrodynamic radius
+    (`precompute.py:153-160`).
+    """
+    if shape == "sphere":
+        r = radius - (BODY_QUADRATURE_RADIUS_OFFSET_LOW
+                      if radius < BODY_QUADRATURE_RADIUS_THRESHOLD
+                      else BODY_QUADRATURE_RADIUS_OFFSET_HIGH)
+        spec = sphere_shape(n_nodes, radius=r)
+    elif shape == "ellipsoid":
+        spec = ellipsoid_shape(n_nodes, a=a, b=b, c=c)
+    else:
+        raise ValueError(f"unknown body shape: {shape}")
+
+    tris = ConvexHull(spec.nodes).simplices
+    weights = surface_quadrature_weights(spec.nodes, tris, spec.gradh)
+    return {
+        "node_positions_ref": spec.nodes,
+        "node_normals_ref": spec.node_normals,
+        "node_weights": weights,
+    }

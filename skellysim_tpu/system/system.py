@@ -9,21 +9,19 @@ pure function. Backup/restore for rejected adaptive steps
 
 The solution vector layout matches the reference (`system.cpp:75-96`):
 [fibers (4n per fiber) | shell (3 per node) | bodies (3 per node + 6 per body)].
-Periphery and bodies plug into `_apply_matvec`/`_apply_precond`/`_prep` in the
-same seams as `system.cpp:269-324`.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..fibers import container as fc
 from ..params import Params
+from ..periphery import periphery as peri
+from ..periphery.periphery import PeripheryShape, PeripheryState
 from ..solver import gmres
 from .sources import BackgroundFlow, PointSources
 
@@ -36,7 +34,7 @@ class SimState(NamedTuple):
     fibers: Optional[fc.FiberGroup]
     points: Optional[PointSources]
     background: Optional[BackgroundFlow]
-    shell: Any = None    # periphery.PeripheryState once present
+    shell: Optional[PeripheryState] = None
     bodies: Any = None   # bodies.BodyState once present
 
 
@@ -50,15 +48,26 @@ class StepInfo(NamedTuple):
 class System:
     """Holds static config; all dynamics flow through pure jit'd functions."""
 
-    def __init__(self, params: Params):
+    def __init__(self, params: Params, shell_shape: PeripheryShape | None = None):
         self.params = params
+        self.shell_shape = shell_shape
         self._solve_jit = jax.jit(self._solve_impl)
-        self._fiber_error_jit = jax.jit(self._fiber_error)
+        self._collision_jit = jax.jit(self._check_collision)
 
     # ------------------------------------------------------------- state setup
 
     def make_state(self, fibers=None, points=None, background=None,
                    shell=None, bodies=None) -> SimState:
+        if fibers is None and shell is None and bodies is None and points is None:
+            raise ValueError("state has no solvable or flow components")
+        if shell is not None and self.shell_shape is None:
+            raise ValueError(
+                "a periphery state requires System(shell_shape=PeripheryShape(...)) "
+                "matching the precompute geometry; use kind='generic' explicitly "
+                "for a shell with no wall physics")
+        if shell is not None and background is not None and background.is_active():
+            # `sanity_check`, system.cpp:625-626
+            raise ValueError("background sources are incompatible with peripheries")
         dtype = fibers.x.dtype if fibers is not None else jnp.float64
         return SimState(
             time=jnp.asarray(0.0, dtype=dtype),
@@ -68,10 +77,27 @@ class System:
 
     # ----------------------------------------------------------------- helpers
 
-    def _fiber_node_positions(self, state: SimState):
-        if state.fibers is None:
+    def _node_positions(self, state: SimState):
+        """All hydrodynamic node positions [fibers | shell] (`get_node_maps`)."""
+        parts = []
+        if state.fibers is not None:
+            parts.append(fc.node_positions(state.fibers))
+        if state.shell is not None:
+            parts.append(state.shell.nodes)
+        if not parts:
             return jnp.zeros((0, 3), dtype=jnp.float64)
-        return fc.node_positions(state.fibers)
+        return jnp.concatenate(parts, axis=0)
+
+    def _counts(self, state: SimState):
+        nf_nodes = (state.fibers.n_fibers * state.fibers.n_nodes
+                    if state.fibers is not None else 0)
+        ns_nodes = state.shell.n_nodes if state.shell is not None else 0
+        return nf_nodes, ns_nodes
+
+    def _sizes(self, state: SimState):
+        fib = fc.solution_size(state.fibers) if state.fibers is not None else 0
+        shell = state.shell.solution_size if state.shell is not None else 0
+        return fib, shell
 
     def _external_flows(self, state: SimState, r_trg):
         """Point-source + background contributions (`system.cpp:445-446`)."""
@@ -82,34 +108,75 @@ class System:
             v = v + state.background.flow(r_trg, self.params.eta)
         return v
 
+    # ------------------------------------------------- fiber-periphery coupling
+
+    def _periphery_force_fibers(self, state: SimState):
+        """Steric wall force on fiber nodes [nf, n, 3] (`periphery_force`)."""
+        fibers = state.fibers
+        fp = self.params.fiber_periphery_interaction
+        if state.shell is None or not self.params.periphery_interaction_flag:
+            return jnp.zeros_like(fibers.x)
+        shape = self.shell_shape
+        return jax.vmap(
+            lambda x, mc: peri.fiber_steric_force(shape, x, fp.f_0, fp.l_0, mc)
+        )(fibers.x, fibers.minus_clamped)
+
+    def _update_plus_pinning(self, state: SimState) -> SimState:
+        """Hinge plus ends near an attachment-active periphery
+        (`update_boundary_conditions`, `fiber_finite_difference.cpp:74-91`)."""
+        pb = self.params.periphery_binding
+        fibers = state.fibers
+        if state.shell is None or not pb.active or fibers is None:
+            return state
+        shape = self.shell_shape
+
+        def one(x):
+            tip = x[-1] / jnp.linalg.norm(x[-1])
+            angle = jnp.arccos(jnp.clip(tip[2], -1.0, 1.0))
+            in_window = (angle >= pb.polar_angle_start) & (angle <= pb.polar_angle_end)
+            near = peri.check_collision(shape, x, pb.threshold)
+            return in_window & near
+
+        pinned = jax.vmap(one)(fibers.x)
+        return state._replace(fibers=fibers._replace(plus_pinned=pinned))
+
     # ------------------------------------------------------------------- prep
 
     def _prep(self, state: SimState):
         """All velocities/forces/RHS/BC assembly (`prep_state_for_solver`,
-        `system.cpp:398-458`). Returns per-component caches."""
+        `system.cpp:398-458`). Returns (state, fiber caches, shell RHS)."""
         p = self.params
+        state = self._update_plus_pinning(state)
         fibers = state.fibers
         caches = None
+        shell_rhs = None
+
+        r_all = self._node_positions(state)
+        nf_nodes, ns_nodes = self._counts(state)
+        v_all = jnp.zeros_like(r_all)
+
         if fibers is not None:
             caches = fc.update_cache(fibers, state.dt, p.eta)
-
-            r_all = self._fiber_node_positions(state)
-
             nf, n = fibers.n_fibers, fibers.n_nodes
-            zero_f = jnp.zeros((nf, n, 3), dtype=fibers.x.dtype)
 
-            # motor force activates after the configured delay (`system.cpp:417-419`)
+            external = self._periphery_force_fibers(state)
             motor = jnp.where(state.time >= p.implicit_motor_activation_delay,
-                              fc.generate_constant_force(fibers, caches), zero_f)
-            external = zero_f  # fiber-periphery steric force once shell exists
+                              fc.generate_constant_force(fibers, caches),
+                              jnp.zeros_like(fibers.x))
 
-            v_all = fc.flow(fibers, caches, r_all, external, p.eta)
-            v_all = v_all + self._external_flows(state, r_all)
-            v_fib = v_all.reshape(nf, n, 3)
+            v_all = v_all + fc.flow(fibers, caches, r_all, external, p.eta)
 
+        v_all = v_all + self._external_flows(state, r_all)
+
+        if fibers is not None:
+            v_fib = v_all[:nf_nodes].reshape(nf, n, 3)
             caches = fc.update_rhs_and_bc(fibers, caches, state.dt, p.eta,
                                           v_fib, motor + external, external)
-        return caches
+        if state.shell is not None:
+            v_shell = v_all[nf_nodes:nf_nodes + ns_nodes]
+            shell_rhs = peri.update_RHS(v_shell)
+
+        return state, caches, shell_rhs
 
     # ------------------------------------------------------- operator closures
 
@@ -117,49 +184,100 @@ class System:
         """Coupled operator A x (`apply_matvec`, `system.cpp:269-324`)."""
         p = self.params
         fibers = state.fibers
-        nf, n = fibers.n_fibers, fibers.n_nodes
-        x_fib = x_flat[:nf * 4 * n].reshape(nf, 4 * n)
+        shell = state.shell
+        fib_size, shell_size = self._sizes(state)
+        nf_nodes, ns_nodes = self._counts(state)
+        x_shell = x_flat[fib_size:fib_size + shell_size]
 
-        r_all = self._fiber_node_positions(state)
-        fw = fc.apply_fiber_force(fibers, caches, x_fib)
-        v_all = fc.flow(fibers, caches, r_all, fw, p.eta, subtract_self=True)
-        v_fib = v_all[:nf * n].reshape(nf, n, 3)
+        r_all = self._node_positions(state)
+        v_all = jnp.zeros_like(r_all)
 
-        v_boundary = jnp.zeros((nf, 7), dtype=x_flat.dtype)  # body links later
-        res_fib = fc.matvec(fibers, caches, x_fib, v_fib, v_boundary)
-        return res_fib.reshape(-1)
+        if fibers is not None:
+            nf, n = fibers.n_fibers, fibers.n_nodes
+            x_fib = x_flat[:fib_size].reshape(nf, 4 * n)
+            fw = fc.apply_fiber_force(fibers, caches, x_fib)
+            v_all = v_all + fc.flow(fibers, caches, r_all, fw, p.eta, subtract_self=True)
+
+        if shell is not None and fibers is not None:
+            # shell flow is evaluated at fiber (and body) nodes only; the shell
+            # self-interaction lives in the dense operator (`system.cpp:301-315`)
+            v_shell2fib = peri.flow(shell, r_all[:nf_nodes], x_shell, p.eta)
+            v_all = v_all.at[:nf_nodes].add(v_shell2fib)
+
+        res = []
+        if fibers is not None:
+            v_fib = v_all[:nf_nodes].reshape(nf, n, 3)
+            v_boundary = jnp.zeros((nf, 7), dtype=x_flat.dtype)  # body links later
+            res.append(fc.matvec(fibers, caches, x_fib, v_fib, v_boundary).reshape(-1))
+        if shell is not None:
+            v_shell = v_all[nf_nodes:nf_nodes + ns_nodes]
+            res.append(peri.matvec(shell, x_shell, v_shell))
+        return jnp.concatenate(res)
 
     def _apply_precond(self, state: SimState, caches, x_flat):
         """Block preconditioner P^-1 x (`apply_preconditioner`, `system.cpp:248-262`)."""
         fibers = state.fibers
-        nf, n = fibers.n_fibers, fibers.n_nodes
-        x_fib = x_flat[:nf * 4 * n].reshape(nf, 4 * n)
-        y = fc.apply_preconditioner(fibers, caches, x_fib)
-        return y.reshape(-1)
+        fib_size, shell_size = self._sizes(state)
+        res = []
+        if fibers is not None:
+            nf, n = fibers.n_fibers, fibers.n_nodes
+            x_fib = x_flat[:fib_size].reshape(nf, 4 * n)
+            res.append(fc.apply_preconditioner(fibers, caches, x_fib).reshape(-1))
+        if state.shell is not None:
+            res.append(peri.apply_preconditioner(
+                state.shell, x_flat[fib_size:fib_size + shell_size]))
+        return jnp.concatenate(res)
 
     # ------------------------------------------------------------------- solve
 
     def _solve_impl(self, state: SimState):
         p = self.params
-        caches = self._prep(state)
-        rhs = caches.RHS.reshape(-1)
+        state, caches, shell_rhs = self._prep(state)
+
+        rhs_parts = []
+        if caches is not None:
+            rhs_parts.append(caches.RHS.reshape(-1))
+        if shell_rhs is not None:
+            rhs_parts.append(shell_rhs)
+        if not rhs_parts:
+            raise ValueError("state has no implicit components to solve")
+        rhs = jnp.concatenate(rhs_parts)
+
         result = gmres(
             lambda v: self._apply_matvec(state, caches, v), rhs,
             precond=lambda v: self._apply_precond(state, caches, v),
             tol=p.gmres_tol, restart=p.gmres_restart, maxiter=p.gmres_maxiter)
 
-        fibers = state.fibers
-        nf, n = fibers.n_fibers, fibers.n_nodes
-        sol_fib = result.x[:nf * 4 * n].reshape(nf, 4 * n)
-        new_fibers = fc.step(fibers, sol_fib)
-        new_state = state._replace(fibers=new_fibers)
+        fib_size, shell_size = self._sizes(state)
+        new_state = state
+        fiber_error = jnp.asarray(0.0, dtype=rhs.dtype)
+        if state.fibers is not None:
+            sol_fib = result.x[:fib_size].reshape(state.fibers.n_fibers, -1)
+            new_fibers = fc.step(state.fibers, sol_fib)
+            new_state = new_state._replace(fibers=new_fibers)
+            fiber_error = fc.fiber_error(new_fibers)
+        if state.shell is not None:
+            new_state = new_state._replace(shell=state.shell._replace(
+                density=result.x[fib_size:fib_size + shell_size]))
+
         info = StepInfo(converged=result.converged, iters=result.iters,
-                        residual=result.residual,
-                        fiber_error=fc.fiber_error(new_fibers))
+                        residual=result.residual, fiber_error=fiber_error)
         return new_state, result.x, info
 
-    def _fiber_error(self, state: SimState):
-        return fc.fiber_error(state.fibers)
+    def _check_collision(self, state: SimState):
+        """Fiber/shell collision gate (`check_collision`, `system.cpp:576-595`);
+        body collisions join once bodies land."""
+        if state.shell is None or state.fibers is None:
+            return jnp.asarray(False)
+        shape = self.shell_shape
+
+        def one(x, mc):
+            # clamped fibers exclude their anchored first node
+            pts = jnp.where((jnp.arange(x.shape[0]) >= jnp.where(mc, 1, 0))[:, None],
+                            x, x[-1])
+            return peri.check_collision(shape, pts, 0.0)
+
+        return jnp.any(jax.vmap(one)(state.fibers.x, state.fibers.minus_clamped))
 
     # -------------------------------------------------------------- public API
 
@@ -172,9 +290,9 @@ class System:
         """Adaptive time loop (`run`, `system.cpp:516-571`).
 
         Host-side control flow around the jit'd step: accept/reject on fiber
-        error, scale dt by beta_up/beta_down, keep the previous pytree as the
-        backup for rejected steps. ``writer`` is called with (state, solution)
-        after each accepted step that crosses a dt_write boundary.
+        error + collision, scale dt by beta_up/beta_down, keep the previous
+        pytree as the backup for rejected steps. ``writer`` is called with
+        (state, solution) after each accepted step crossing a dt_write boundary.
         """
         p = self.params
         n_steps = 0
@@ -199,7 +317,9 @@ class System:
                     dt_new = dt * p.beta_down
                     accept = False
 
-                # collision gate (`system.cpp:542-546`) once shell/bodies exist
+                if converged and bool(self._collision_jit(new_state)):
+                    dt_new = dt * 0.5
+                    accept = False
 
                 if dt_new < p.dt_min:
                     raise RuntimeError("Timestep smaller than dt_min")
