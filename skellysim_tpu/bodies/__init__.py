@@ -1,0 +1,1 @@
+from .bodies import BodyGroup, BodyCaches, make_group  # noqa: F401

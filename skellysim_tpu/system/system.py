@@ -13,11 +13,12 @@ The solution vector layout matches the reference (`system.cpp:75-96`):
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..bodies import bodies as bd
 from ..fibers import container as fc
 from ..params import Params
 from ..periphery import periphery as peri
@@ -35,7 +36,7 @@ class SimState(NamedTuple):
     points: Optional[PointSources]
     background: Optional[BackgroundFlow]
     shell: Optional[PeripheryState] = None
-    bodies: Any = None   # bodies.BodyState once present
+    bodies: Optional[bd.BodyGroup] = None
 
 
 class StepInfo(NamedTuple):
@@ -58,8 +59,10 @@ class System:
 
     def make_state(self, fibers=None, points=None, background=None,
                    shell=None, bodies=None) -> SimState:
-        if fibers is None and shell is None and bodies is None and points is None:
-            raise ValueError("state has no solvable or flow components")
+        if fibers is None and shell is None and bodies is None:
+            raise ValueError(
+                "state needs at least one implicit component (fibers, shell, or "
+                "bodies) to solve; point/background sources only contribute flow")
         if shell is not None and self.shell_shape is None:
             raise ValueError(
                 "a periphery state requires System(shell_shape=PeripheryShape(...)) "
@@ -84,6 +87,8 @@ class System:
             parts.append(fc.node_positions(state.fibers))
         if state.shell is not None:
             parts.append(state.shell.nodes)
+        if state.bodies is not None:
+            parts.append(bd.place(state.bodies)[0].reshape(-1, 3))
         if not parts:
             return jnp.zeros((0, 3), dtype=jnp.float64)
         return jnp.concatenate(parts, axis=0)
@@ -92,12 +97,15 @@ class System:
         nf_nodes = (state.fibers.n_fibers * state.fibers.n_nodes
                     if state.fibers is not None else 0)
         ns_nodes = state.shell.n_nodes if state.shell is not None else 0
-        return nf_nodes, ns_nodes
+        nb_nodes = (state.bodies.n_bodies * state.bodies.n_nodes
+                    if state.bodies is not None else 0)
+        return nf_nodes, ns_nodes, nb_nodes
 
     def _sizes(self, state: SimState):
         fib = fc.solution_size(state.fibers) if state.fibers is not None else 0
         shell = state.shell.solution_size if state.shell is not None else 0
-        return fib, shell
+        body = state.bodies.solution_size if state.bodies is not None else 0
+        return fib, shell, body
 
     def _external_flows(self, state: SimState, r_trg):
         """Point-source + background contributions (`system.cpp:445-446`)."""
@@ -111,10 +119,16 @@ class System:
     # ------------------------------------------------- fiber-periphery coupling
 
     def _periphery_force_fibers(self, state: SimState):
-        """Steric wall force on fiber nodes [nf, n, 3] (`periphery_force`)."""
+        """Steric wall force on fiber nodes [nf, n, 3] (`periphery_force`).
+
+        Applied unconditionally during the solve, like the reference's
+        `prep_state_for_solver` (`system.cpp:422`); the
+        periphery_interaction_flag only gates post-processing
+        (`velocity_at_targets`, `system.cpp:340-341`).
+        """
         fibers = state.fibers
         fp = self.params.fiber_periphery_interaction
-        if state.shell is None or not self.params.periphery_interaction_flag:
+        if state.shell is None:
             return jnp.zeros_like(fibers.x)
         shape = self.shell_shape
         return jax.vmap(
@@ -144,15 +158,18 @@ class System:
 
     def _prep(self, state: SimState):
         """All velocities/forces/RHS/BC assembly (`prep_state_for_solver`,
-        `system.cpp:398-458`). Returns (state, fiber caches, shell RHS)."""
+        `system.cpp:398-458`). Returns (state, fiber caches, body caches,
+        shell RHS, body RHS)."""
         p = self.params
         state = self._update_plus_pinning(state)
         fibers = state.fibers
         caches = None
+        body_caches = None
         shell_rhs = None
+        body_rhs = None
 
         r_all = self._node_positions(state)
-        nf_nodes, ns_nodes = self._counts(state)
+        nf_nodes, ns_nodes, nb_nodes = self._counts(state)
         v_all = jnp.zeros_like(r_all)
 
         if fibers is not None:
@@ -166,7 +183,22 @@ class System:
 
             v_all = v_all + fc.flow(fibers, caches, r_all, external, p.eta)
 
+        if state.bodies is not None:
+            body_caches = bd.update_cache(state.bodies, p.eta)
+            # external body forces/torques induce explicit flow everywhere
+            # (`system.cpp:430-443`)
+            ext_ft = bd.external_forces_torques(state.bodies, state.time)
+            zero_sol = jnp.zeros((state.bodies.n_bodies,
+                                  3 * state.bodies.n_nodes + 6), dtype=r_all.dtype)
+            v_all = v_all + bd.flow(state.bodies, body_caches, r_all, zero_sol,
+                                    ext_ft, p.eta)
+
         v_all = v_all + self._external_flows(state, r_all)
+
+        if state.bodies is not None:
+            v_bodies = v_all[nf_nodes + ns_nodes:].reshape(
+                state.bodies.n_bodies, state.bodies.n_nodes, 3)
+            body_rhs = bd.update_RHS(state.bodies, v_bodies)
 
         if fibers is not None:
             v_fib = v_all[:nf_nodes].reshape(nf, n, 3)
@@ -176,48 +208,70 @@ class System:
             v_shell = v_all[nf_nodes:nf_nodes + ns_nodes]
             shell_rhs = peri.update_RHS(v_shell)
 
-        return state, caches, shell_rhs
+        return state, caches, body_caches, shell_rhs, body_rhs
 
     # ------------------------------------------------------- operator closures
 
-    def _apply_matvec(self, state: SimState, caches, x_flat):
+    def _apply_matvec(self, state: SimState, caches, body_caches, x_flat):
         """Coupled operator A x (`apply_matvec`, `system.cpp:269-324`)."""
         p = self.params
         fibers = state.fibers
         shell = state.shell
-        fib_size, shell_size = self._sizes(state)
-        nf_nodes, ns_nodes = self._counts(state)
+        bodies = state.bodies
+        fib_size, shell_size, body_size = self._sizes(state)
+        nf_nodes, ns_nodes, nb_nodes = self._counts(state)
         x_shell = x_flat[fib_size:fib_size + shell_size]
 
         r_all = self._node_positions(state)
         v_all = jnp.zeros_like(r_all)
 
+        x_fib = None
         if fibers is not None:
             nf, n = fibers.n_fibers, fibers.n_nodes
             x_fib = x_flat[:fib_size].reshape(nf, 4 * n)
             fw = fc.apply_fiber_force(fibers, caches, x_fib)
             v_all = v_all + fc.flow(fibers, caches, r_all, fw, p.eta, subtract_self=True)
 
-        if shell is not None and fibers is not None:
-            # shell flow is evaluated at fiber (and body) nodes only; the shell
+        if shell is not None and (fibers is not None or bodies is not None):
+            # shell flow is evaluated at fiber and body nodes only; the shell
             # self-interaction lives in the dense operator (`system.cpp:301-315`)
-            v_shell2fib = peri.flow(shell, r_all[:nf_nodes], x_shell, p.eta)
-            v_all = v_all.at[:nf_nodes].add(v_shell2fib)
+            r_fibbody = jnp.concatenate(
+                [r_all[:nf_nodes], r_all[nf_nodes + ns_nodes:]], axis=0)
+            v_shell2fibbody = peri.flow(shell, r_fibbody, x_shell, p.eta)
+            v_all = v_all.at[:nf_nodes].add(v_shell2fibbody[:nf_nodes])
+            v_all = v_all.at[nf_nodes + ns_nodes:].add(v_shell2fibbody[nf_nodes:])
+
+        v_boundary = None
+        x_bodies = None
+        if bodies is not None:
+            nb, n_b = bodies.n_bodies, bodies.n_nodes
+            x_bodies = x_flat[fib_size + shell_size:].reshape(nb, 3 * n_b + 6)
+            if fibers is not None:
+                v_boundary, body_ft = bd.link_conditions(
+                    bodies, body_caches, fibers, caches, x_fib, x_bodies)
+            else:
+                body_ft = jnp.zeros((nb, 6), dtype=x_flat.dtype)
+            v_all = v_all + bd.flow(bodies, body_caches, r_all, x_bodies,
+                                    body_ft, p.eta)
 
         res = []
         if fibers is not None:
             v_fib = v_all[:nf_nodes].reshape(nf, n, 3)
-            v_boundary = jnp.zeros((nf, 7), dtype=x_flat.dtype)  # body links later
+            if v_boundary is None:
+                v_boundary = jnp.zeros((nf, 7), dtype=x_flat.dtype)
             res.append(fc.matvec(fibers, caches, x_fib, v_fib, v_boundary).reshape(-1))
         if shell is not None:
             v_shell = v_all[nf_nodes:nf_nodes + ns_nodes]
             res.append(peri.matvec(shell, x_shell, v_shell))
+        if bodies is not None:
+            v_bodies = v_all[nf_nodes + ns_nodes:].reshape(nb, n_b, 3)
+            res.append(bd.matvec(bodies, body_caches, x_bodies, v_bodies).reshape(-1))
         return jnp.concatenate(res)
 
-    def _apply_precond(self, state: SimState, caches, x_flat):
+    def _apply_precond(self, state: SimState, caches, body_caches, x_flat):
         """Block preconditioner P^-1 x (`apply_preconditioner`, `system.cpp:248-262`)."""
         fibers = state.fibers
-        fib_size, shell_size = self._sizes(state)
+        fib_size, shell_size, body_size = self._sizes(state)
         res = []
         if fibers is not None:
             nf, n = fibers.n_fibers, fibers.n_nodes
@@ -226,49 +280,73 @@ class System:
         if state.shell is not None:
             res.append(peri.apply_preconditioner(
                 state.shell, x_flat[fib_size:fib_size + shell_size]))
+        if state.bodies is not None:
+            nb = state.bodies.n_bodies
+            x_bod = x_flat[fib_size + shell_size:].reshape(nb, -1)
+            res.append(bd.apply_preconditioner(
+                state.bodies, body_caches, x_bod).reshape(-1))
         return jnp.concatenate(res)
 
     # ------------------------------------------------------------------- solve
 
     def _solve_impl(self, state: SimState):
         p = self.params
-        state, caches, shell_rhs = self._prep(state)
+        state, caches, body_caches, shell_rhs, body_rhs = self._prep(state)
 
         rhs_parts = []
         if caches is not None:
             rhs_parts.append(caches.RHS.reshape(-1))
         if shell_rhs is not None:
             rhs_parts.append(shell_rhs)
+        if body_rhs is not None:
+            rhs_parts.append(body_rhs.reshape(-1))
         if not rhs_parts:
             raise ValueError("state has no implicit components to solve")
         rhs = jnp.concatenate(rhs_parts)
 
         result = gmres(
-            lambda v: self._apply_matvec(state, caches, v), rhs,
-            precond=lambda v: self._apply_precond(state, caches, v),
+            lambda v: self._apply_matvec(state, caches, body_caches, v), rhs,
+            precond=lambda v: self._apply_precond(state, caches, body_caches, v),
             tol=p.gmres_tol, restart=p.gmres_restart, maxiter=p.gmres_maxiter)
 
-        fib_size, shell_size = self._sizes(state)
+        fib_size, shell_size, body_size = self._sizes(state)
         new_state = state
         fiber_error = jnp.asarray(0.0, dtype=rhs.dtype)
         if state.fibers is not None:
             sol_fib = result.x[:fib_size].reshape(state.fibers.n_fibers, -1)
             new_fibers = fc.step(state.fibers, sol_fib)
             new_state = new_state._replace(fibers=new_fibers)
-            fiber_error = fc.fiber_error(new_fibers)
         if state.shell is not None:
             new_state = new_state._replace(shell=state.shell._replace(
                 density=result.x[fib_size:fib_size + shell_size]))
+        if state.bodies is not None:
+            sol_bod = result.x[fib_size + shell_size:].reshape(
+                state.bodies.n_bodies, -1)
+            new_bodies = bd.step(state.bodies, sol_bod, state.dt)
+            new_state = new_state._replace(bodies=new_bodies)
+            if new_state.fibers is not None:
+                # fibers re-pin to their (moved) nucleation sites
+                # (`system.cpp:488`, `repin_to_bodies`)
+                _, _, new_sites = bd.place(new_bodies)
+                new_state = new_state._replace(fibers=bd.repin_to_bodies(
+                    new_state.fibers, new_sites, new_bodies))
+        if new_state.fibers is not None:
+            fiber_error = fc.fiber_error(new_state.fibers)
 
         info = StepInfo(converged=result.converged, iters=result.iters,
                         residual=result.residual, fiber_error=fiber_error)
         return new_state, result.x, info
 
     def _check_collision(self, state: SimState):
-        """Fiber/shell collision gate (`check_collision`, `system.cpp:576-595`);
-        body collisions join once bodies land."""
+        """Fiber/shell + body collision gate (`check_collision`, `system.cpp:576-595`)."""
+        collided = jnp.asarray(False)
+        if state.bodies is not None:
+            collided = collided | bd.check_collision_pairwise(state.bodies, 0.0)
+            if state.shell is not None and self.shell_shape.kind == "sphere":
+                collided = collided | bd.check_collision_shell(
+                    state.bodies, self.shell_shape.radius, 0.0)
         if state.shell is None or state.fibers is None:
-            return jnp.asarray(False)
+            return collided
         shape = self.shell_shape
 
         def one(x, mc):
@@ -277,7 +355,8 @@ class System:
                             x, x[-1])
             return peri.check_collision(shape, pts, 0.0)
 
-        return jnp.any(jax.vmap(one)(state.fibers.x, state.fibers.minus_clamped))
+        return collided | jnp.any(
+            jax.vmap(one)(state.fibers.x, state.fibers.minus_clamped))
 
     # -------------------------------------------------------------- public API
 

@@ -1,0 +1,1 @@
+from . import quaternion  # noqa: F401

@@ -1,0 +1,46 @@
+"""Quaternion helpers (w, x, y, z convention), jit/vmap friendly.
+
+Replaces Eigen::Quaterniond usage in body stepping (`body_spherical.cpp:13-35`)
+and the reference's minimal Python quaternion (`src/skelly_sim/quaternion.py`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+IDENTITY = jnp.asarray([1.0, 0.0, 0.0, 0.0])
+
+
+def multiply(q1, q2):
+    w1, x1, y1, z1 = q1[..., 0], q1[..., 1], q1[..., 2], q1[..., 3]
+    w2, x2, y2, z2 = q2[..., 0], q2[..., 1], q2[..., 2], q2[..., 3]
+    return jnp.stack([
+        w1 * w2 - x1 * x2 - y1 * y2 - z1 * z2,
+        w1 * x2 + x1 * w2 + y1 * z2 - z1 * y2,
+        w1 * y2 - x1 * z2 + y1 * w2 + z1 * x2,
+        w1 * z2 + x1 * y2 - y1 * x2 + z1 * w2,
+    ], axis=-1)
+
+
+def rotation_matrix(q):
+    """[..., 4] -> [..., 3, 3] rotation matrix."""
+    w, x, y, z = q[..., 0], q[..., 1], q[..., 2], q[..., 3]
+    return jnp.stack([
+        jnp.stack([1 - 2 * (y**2 + z**2), 2 * (x * y - w * z), 2 * (x * z + w * y)], axis=-1),
+        jnp.stack([2 * (x * y + w * z), 1 - 2 * (x**2 + z**2), 2 * (y * z - w * x)], axis=-1),
+        jnp.stack([2 * (x * z - w * y), 2 * (y * z + w * x), 1 - 2 * (x**2 + y**2)], axis=-1),
+    ], axis=-2)
+
+
+def from_rotation_vector(phi):
+    """Rotation vector [..., 3] -> quaternion; safe at |phi| = 0."""
+    norm = jnp.linalg.norm(phi, axis=-1, keepdims=True)
+    safe = jnp.where(norm > 0, norm, 1.0)
+    half = 0.5 * norm
+    w = jnp.cos(half)
+    xyz = jnp.where(norm > 0, jnp.sin(half) * phi / safe, jnp.zeros_like(phi))
+    return jnp.concatenate([w, xyz], axis=-1)
+
+
+def normalize(q):
+    return q / jnp.linalg.norm(q, axis=-1, keepdims=True)

@@ -1,0 +1,145 @@
+"""Rigid-body physics oracles.
+
+Mirrors of the reference integration tests:
+* `tests/combined/test_body_const_force.py`: sphere under constant force moves
+  at the Stokes drag velocity F/(6 pi eta R_eff), rel. error < 1e-6, where
+  R_eff is the (shrunken) quadrature node radius.
+* `tests/combined/test_body_const_torque.py` analogue: rotation under constant
+  torque at T/(8 pi eta R^3).
+* mobility symmetry/sanity for the ellipsoidal formulation via the
+  sphere-as-ellipsoid consistency check (`tests/combined/bodies/`).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from skellysim_tpu.bodies import bodies as bd
+from skellysim_tpu.params import Params
+from skellysim_tpu.periphery.precompute import precompute_body
+from skellysim_tpu.system import System
+
+
+def make_sphere_body(n_nodes=600, radius=0.5, **kw):
+    pre = precompute_body("sphere", n_nodes, radius=radius)
+    return bd.make_group(pre["node_positions_ref"], pre["node_normals_ref"],
+                         pre["node_weights"], radius=radius, kind="sphere", **kw), pre
+
+
+def test_body_const_force_stokes_drag():
+    eta = 0.9
+    force = 1.5
+    group, pre = make_sphere_body(n_nodes=600, radius=0.5,
+                                  external_force=[0.0, 0.0, force])
+    r_eff = np.linalg.norm(pre["node_positions_ref"][0])
+
+    params = Params(eta=eta, dt_initial=0.1, t_final=0.3, gmres_tol=1e-10,
+                    adaptive_timestep_flag=False)
+    system = System(params)
+    state = system.make_state(bodies=group)
+
+    z0 = float(state.bodies.position[0, 2])
+    t0 = float(state.time)
+    state = system.run(state)
+    z1 = float(state.bodies.position[0, 2])
+    t1 = float(state.time)
+
+    v_measured = (z1 - z0) / (t1 - t0)
+    v_theory = force / (6 * np.pi * eta * r_eff)
+    rel_err = abs(1 - v_measured / v_theory)
+    assert rel_err < 1e-6, rel_err
+
+
+def test_body_const_torque_rotation():
+    eta = 1.2
+    torque = 0.7
+    group, pre = make_sphere_body(n_nodes=600, radius=0.5,
+                                  external_torque=[0.0, 0.0, torque])
+    r_eff = np.linalg.norm(pre["node_positions_ref"][0])
+
+    params = Params(eta=eta, dt_initial=0.05, t_final=0.05, gmres_tol=1e-10,
+                    adaptive_timestep_flag=False)
+    system = System(params)
+    state = system.make_state(bodies=group)
+    state, _, info = system.step(state)
+    assert bool(info.converged)
+
+    w_measured = float(state.bodies.angular_velocity[0, 2])
+    w_theory = torque / (8 * np.pi * eta * r_eff**3)
+    rel_err = abs(1 - w_measured / w_theory)
+    assert rel_err < 1e-4, rel_err
+
+
+def test_ellipsoid_as_sphere_matches_sphere_drag():
+    """Ellipsoid with a==b==c must reproduce the spherical mobility
+    (`tests/combined/bodies/test_ellipsoid_assphere_constforce.py`)."""
+    eta = 1.0
+    r = 0.4
+    pre = precompute_body("ellipsoid", 500, a=r, b=r, c=r)
+    group = bd.make_group(pre["node_positions_ref"], pre["node_normals_ref"],
+                          pre["node_weights"], kind="ellipsoid",
+                          external_force=[0.0, 0.0, 1.0])
+    params = Params(eta=eta, dt_initial=0.05, t_final=0.05, gmres_tol=1e-10,
+                    adaptive_timestep_flag=False)
+    system = System(params)
+    state = system.make_state(bodies=group)
+    state, _, info = system.step(state)
+    assert bool(info.converged)
+    v = float(state.bodies.velocity[0, 2])
+    v_theory = 1.0 / (6 * np.pi * eta * r)
+    assert abs(1 - v / v_theory) < 1e-3
+
+
+def test_fiber_body_link_holds():
+    """A fiber bound to a body stays pinned to its nucleation site as the
+    body translates under force."""
+    from skellysim_tpu.fibers import container as fc
+
+    eta = 1.0
+    group, pre = make_sphere_body(n_nodes=400, radius=0.5,
+                                  external_force=[0.0, 0.0, 1.0],
+                                  nucleation_sites_ref=[[0.0, 0.0, 0.5]])
+    params = Params(eta=eta, dt_initial=0.01, t_final=0.03, gmres_tol=1e-9,
+                    adaptive_timestep_flag=False)
+    system = System(params)
+
+    t = np.linspace(0, 1, 16)
+    x = np.stack([np.zeros(16), np.zeros(16), 0.5 + 0.6 * t], axis=1)[None]
+    fibers = fc.make_group(x, lengths=0.6, bending_rigidity=0.01, radius=0.0125,
+                           binding_body=0, binding_site=0)
+    state = system.make_state(fibers=fibers, bodies=group)
+    state = system.run(state)
+
+    _, _, sites = bd.place(state.bodies)
+    gap = np.linalg.norm(np.asarray(state.fibers.x[0, 0]) - np.asarray(sites[0, 0]))
+    assert gap < 1e-12
+    # body actually moved
+    assert float(state.bodies.position[0, 2]) > 1e-3
+
+
+def test_body_oscillatory_force_schedule():
+    group, _ = make_sphere_body(n_nodes=200, radius=0.5,
+                                external_force=[0.0, 0.0, 1.0],
+                                ext_force_type=bd.EXTFORCE_OSCILLATORY,
+                                osc_amplitude=2.0, osc_omega=2 * np.pi,
+                                osc_phase=0.0)
+    ft = np.asarray(bd.external_forces_torques(group, jnp.asarray(0.25)))
+    np.testing.assert_allclose(ft[0, 2], 2.0 * np.sin(np.pi / 2), rtol=1e-12)
+    ft0 = np.asarray(bd.external_forces_torques(group, jnp.asarray(0.0)))
+    np.testing.assert_allclose(ft0[0, 2], 0.0, atol=1e-12)
+
+
+def test_body_collision_checks():
+    group, _ = make_sphere_body(n_nodes=200, radius=0.5)
+    two = bd.make_group(
+        np.stack([np.asarray(group.nodes_ref[0])] * 2),
+        np.stack([np.asarray(group.normals_ref[0])] * 2),
+        np.stack([np.asarray(group.weights[0])] * 2),
+        position=np.array([[0.0, 0.0, 0.0], [0.0, 0.0, 0.8]]),
+        radius=0.5, kind="sphere")
+    assert bool(bd.check_collision_pairwise(two, 0.0))
+    apart = two._replace(position=jnp.asarray([[0.0, 0.0, 0.0], [0.0, 0.0, 1.5]]))
+    assert not bool(bd.check_collision_pairwise(apart, 0.0))
+    assert bool(bd.check_collision_shell(apart, 1.8, 0.0))
+    assert not bool(bd.check_collision_shell(apart, 2.5, 0.0))
