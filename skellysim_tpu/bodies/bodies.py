@@ -218,12 +218,18 @@ def update_RHS(group: BodyGroup, v_on_bodies):
 def flow(group: BodyGroup, caches: BodyCaches, r_trg, x_bodies, forces_torques, eta):
     """Body -> target velocities (`flow_spherical`, `body_container.cpp:269-339`):
     double-layer stresslet from node densities + Stokeslet from COM forces +
-    rotlet from COM torques. ``forces_torques`` is [nb, 6]."""
+    rotlet from COM torques. ``forces_torques`` is [nb, 6]. Pass
+    ``x_bodies=None`` to skip the stresslet term (e.g. the explicit RHS flow,
+    which only carries COM forces/torques)."""
     nb, n = group.n_bodies, group.n_nodes
-    densities = x_bodies[:, :3 * n].reshape(nb * n, 3)
-    normals = caches.normals.reshape(nb * n, 3)
-    f_dl = 2.0 * eta * normals[:, :, None] * densities[:, None, :]
-    v = kernels.stresslet_direct(caches.nodes.reshape(nb * n, 3), r_trg, f_dl, eta)
+    if x_bodies is None:
+        v = jnp.zeros_like(r_trg)
+    else:
+        densities = x_bodies[:, :3 * n].reshape(nb * n, 3)
+        normals = caches.normals.reshape(nb * n, 3)
+        f_dl = 2.0 * eta * normals[:, :, None] * densities[:, None, :]
+        v = kernels.stresslet_direct(caches.nodes.reshape(nb * n, 3), r_trg,
+                                     f_dl, eta)
     v = v + kernels.stokeslet_direct(group.position, r_trg, forces_torques[:, :3], eta)
     v = v + kernels.rotlet(group.position, r_trg, forces_torques[:, 3:], eta)
     return v

@@ -1,0 +1,2 @@
+from .trajectory import (TrajectoryReader, TrajectoryWriter, frame_to_state,
+                         resume_state)

@@ -1,0 +1,62 @@
+"""Wire-format helpers for the reference trajectory encoding.
+
+The reference serializes Eigen matrices as msgpack arrays
+``['__eigen__', rows, cols, <data in column-major order>]``
+(`/root/reference/include/eigen_matrix_plugin.h:30-41`) and quaternions as
+``['__quat__', w, x, y, z]`` (`eigen_quaternion_plugin.h:27-36`). Matching the
+format byte-for-byte means the reference's Python toolkit (reader, ParaView
+utilities) can read our trajectories unmodified.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pack_matrix(a: np.ndarray) -> list:
+    """Encode an array as an ``__eigen__`` list.
+
+    Convention mapping to the reference: a point cloud we store as [n, 3]
+    (points along rows) is the reference's 3 x n column-major matrix, so its
+    column-major ravel equals our row-major ravel — encode rows=3, cols=n with
+    the row-major ravel of the [n, 3] array.
+    """
+    a = np.asarray(a, dtype=np.float64)
+    if a.ndim == 1:
+        return ["__eigen__", a.shape[0], 1] + a.tolist()
+    if a.ndim == 2 and a.shape[1] == 3:
+        return ["__eigen__", 3, a.shape[0]] + a.ravel().tolist()
+    if a.ndim == 2:
+        return ["__eigen__", a.shape[0], a.shape[1]] + a.ravel(order="F").tolist()
+    raise ValueError(f"cannot encode array of shape {a.shape}")
+
+
+def unpack_matrix(d: list) -> np.ndarray:
+    """Decode an ``__eigen__`` list (mirrors `reader.py:28-62` semantics)."""
+    rows, cols = d[1], d[2]
+    data = np.asarray(d[3:], dtype=np.float64)
+    if rows == 1 or cols == 1:
+        return data
+    if rows == 3:
+        # column-major 3 x n == row-major [n, 3] points
+        return data.reshape(cols, rows)
+    return data.reshape(cols, rows).T
+
+
+def pack_quat(q) -> list:
+    """Encode a (w, x, y, z) quaternion."""
+    q = np.asarray(q, dtype=np.float64)
+    return ["__quat__"] + q.tolist()
+
+
+def decode_tree(d):
+    """Recursively convert ``__eigen__``/``__quat__`` lists to numpy arrays."""
+    if isinstance(d, list):
+        if d and d[0] == "__eigen__":
+            return unpack_matrix(d)
+        if d and d[0] == "__quat__":
+            return np.asarray(d[1:], dtype=np.float64)
+        return [decode_tree(v) for v in d]
+    if isinstance(d, dict):
+        return {k: decode_tree(v) for k, v in d.items()}
+    return d

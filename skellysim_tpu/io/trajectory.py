@@ -1,0 +1,351 @@
+"""Trajectory writer/reader + checkpoint-resume.
+
+Byte-compatible with the reference trajectory format v1:
+frame = msgpack map {time, dt, rng_state, fibers, bodies, shell}
+(`/root/reference/include/io_maps.hpp:17-38`), preceded by a header map
+{trajversion, number_mpi_ranks, fiber_type, ...} (`io_maps.hpp:43-56`), with
+Eigen/quaternion payloads in the ``__eigen__``/``__quat__`` wire encoding.
+The trajectory doubles as the checkpoint (`SURVEY.md` §5.4): `resume_state`
+replays the last frame into a fresh `SimState`.
+
+Fast random access uses a ``.cindex`` side file {mtime, offsets, times}
+(`trajectory_reader.cpp:78-124`, `reader.py:293-329`), built by the native C++
+scanner (`skellysim_tpu/native/trajscan.cpp`) with a Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import platform
+import time as _time
+from typing import Optional
+
+import msgpack
+import numpy as np
+
+from .. import __version__
+from ..native import load_library
+from . import eigen
+
+TRAJECTORY_VERSION = 1
+FIBER_TYPE_NONE = 0
+FIBER_TYPE_FINITE_DIFFERENCE = 1
+
+
+# ---------------------------------------------------------------- frame build
+
+def _fiber_maps(fibers, mask=None):
+    """Per-fiber msgpack maps (`fiber_finite_difference.hpp:160-161` field set)."""
+    x = np.asarray(fibers.x, dtype=np.float64)
+    tension = np.asarray(fibers.tension, dtype=np.float64)
+    active = np.asarray(fibers.active)
+    out = []
+    for i in range(x.shape[0]):
+        if not active[i] or (mask is not None and not mask[i]):
+            continue
+        out.append({
+            "n_nodes_": int(x.shape[1]),
+            "radius_": float(fibers.radius[i]),
+            "length_": float(fibers.length[i]),
+            "length_prev_": float(fibers.length_prev[i]),
+            "bending_rigidity_": float(fibers.bending_rigidity[i]),
+            "penalty_param_": float(fibers.penalty[i]),
+            "force_scale_": float(fibers.force_scale[i]),
+            "beta_tstep_": float(fibers.beta_tstep[i]),
+            "binding_site_": [int(fibers.binding_body[i]), int(fibers.binding_site[i])],
+            "tension_": eigen.pack_matrix(tension[i]),
+            "x_": eigen.pack_matrix(x[i]),
+            "minus_clamped_": bool(fibers.minus_clamped[i]),
+        })
+    return out
+
+
+def _body_maps(bodies):
+    """Bodies as [spherical, deformable, ellipsoidal] (`body_container.hpp:158`)."""
+    spheres, ellipsoids = [], []
+    if bodies is None:
+        return [spheres, [], ellipsoids]
+    pos = np.asarray(bodies.position, dtype=np.float64)
+    orient = np.asarray(bodies.orientation, dtype=np.float64)
+    sol = np.asarray(bodies.solution, dtype=np.float64)
+    kind_sphere = np.asarray(bodies.kind_sphere)
+    for i in range(pos.shape[0]):
+        m = {
+            "radius_": float(bodies.radius[i]),
+            "position_": eigen.pack_matrix(pos[i]),
+            "orientation_": eigen.pack_quat(orient[i]),
+            "solution_vec_": eigen.pack_matrix(sol[i]),
+        }
+        (spheres if kind_sphere[i] else ellipsoids).append(m)
+    return [spheres, [], ellipsoids]
+
+
+def state_to_frame(state, rng_state=None) -> dict:
+    """Encode a SimState as a trajectory-v1 frame map."""
+    if state.fibers is not None:
+        fibers_field = [FIBER_TYPE_FINITE_DIFFERENCE, _fiber_maps(state.fibers)]
+    else:
+        fibers_field = [FIBER_TYPE_NONE, []]
+    shell_sol = (np.asarray(state.shell.density, dtype=np.float64)
+                 if state.shell is not None else np.zeros(0))
+    return {
+        "time": float(state.time),
+        "dt": float(state.dt),
+        "rng_state": rng_state if rng_state is not None else [],
+        "fibers": fibers_field,
+        "bodies": _body_maps(state.bodies),
+        "shell": {"solution_vec_": eigen.pack_matrix(shell_sol)},
+    }
+
+
+# -------------------------------------------------------------------- writer
+
+class TrajectoryWriter:
+    """Appends header + frames to a trajectory file (`System::write`,
+    `system.cpp:100-218`)."""
+
+    def __init__(self, path: str, *, append: bool = False,
+                 fiber_type: int = FIBER_TYPE_FINITE_DIFFERENCE):
+        self.path = path
+        self._fh = open(path, "ab" if append else "wb")
+        if not append:
+            self._fh.write(msgpack.packb({
+                "trajversion": TRAJECTORY_VERSION,
+                "number_mpi_ranks": 1,
+                "fiber_type": fiber_type,
+                "skellysim_version": __version__,
+                "skellysim_commit": "skellysim_tpu",
+                "simdate": _time.strftime("%Y-%m-%d %H:%M:%S"),
+                "hostname": platform.node(),
+            }))
+            self._fh.flush()
+
+    def write_frame(self, state, solution=None, *, rng_state=None):
+        """Append one frame. ``solution`` is accepted (and ignored) so this can
+        be passed directly as ``System.run(..., writer=tw.write_frame)``."""
+        self._fh.write(msgpack.packb(state_to_frame(state, rng_state)))
+        self._fh.flush()
+
+    def close(self):
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# --------------------------------------------------------------------- index
+
+def _scan_native(path: str):
+    lib = load_library("trajscan")
+    if lib is None:
+        return None
+    lib.trajscan_buffer.restype = ctypes.c_int64
+    lib.trajscan_buffer.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double))]
+    import mmap
+
+    offsets_p = ctypes.POINTER(ctypes.c_uint64)()
+    times_p = ctypes.POINTER(ctypes.c_double)()
+    with open(path, "rb") as fh:
+        size = os.fstat(fh.fileno()).st_size
+        if size == 0:
+            return [], []
+        # ACCESS_COPY: pages stay lazily file-backed (no up-front RAM copy of a
+        # multi-GB trajectory) but the buffer is writable, which
+        # ctypes.from_buffer requires; the scanner never writes.
+        mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_COPY)
+        try:
+            cbuf = ctypes.c_char.from_buffer(mm)
+            n = lib.trajscan_buffer(ctypes.addressof(cbuf), size,
+                                    ctypes.byref(offsets_p),
+                                    ctypes.byref(times_p))
+            del cbuf
+        finally:
+            mm.close()
+    if n < 0:
+        return None
+    offsets = np.ctypeslib.as_array(offsets_p, shape=(max(n, 1),))[:n].copy()
+    times = np.ctypeslib.as_array(times_p, shape=(max(n, 1),))[:n].copy()
+    lib.trajscan_free(offsets_p)
+    lib.trajscan_free(times_p)
+    return offsets.tolist(), times.tolist()
+
+
+def _scan_python(path: str):
+    offsets, times = [], []
+    with open(path, "rb") as fh:
+        unpacker = msgpack.Unpacker(fh, raw=False)
+        while True:
+            try:
+                pos = unpacker.tell()
+                obj = unpacker.unpack()
+            except msgpack.exceptions.OutOfData:
+                break
+            if isinstance(obj, dict) and "time" in obj:
+                offsets.append(pos)
+                times.append(obj["time"])
+    return offsets, times
+
+
+def build_index(path: str, use_native: bool = True):
+    """Frame (offsets, times); written to `<path>.cindex` like the reference."""
+    res = _scan_native(path) if use_native else None
+    if res is None:
+        res = _scan_python(path)
+    offsets, times = res
+    index = {"mtime": os.stat(path).st_mtime, "offsets": offsets, "times": times}
+    with open(path + ".cindex", "wb") as fh:
+        msgpack.dump(index, fh)
+    return offsets, times
+
+
+# --------------------------------------------------------------------- reader
+
+class TrajectoryReader:
+    """Random-access frame reader (`reader.py:198-355` semantics)."""
+
+    def __init__(self, path: str = "skelly_sim.out"):
+        self.path = path
+        self._fh = open(path, "rb")
+        self.header = msgpack.Unpacker(self._fh, raw=False).unpack()
+        if not (isinstance(self.header, dict) and "trajversion" in self.header):
+            raise ValueError(f"{path}: missing trajectory header")
+        self.trajectory_version = self.header["trajversion"]
+        self.fiber_type = self.header["fiber_type"]
+
+        index_file = path + ".cindex"
+        mtime = os.stat(path).st_mtime
+        offsets = times = None
+        if os.path.exists(index_file):
+            with open(index_file, "rb") as fh:
+                index = msgpack.unpack(fh, raw=False)
+            if index.get("mtime") == mtime:
+                offsets, times = index["offsets"], index["times"]
+        if offsets is None:
+            offsets, times = build_index(path)
+        self._fpos = offsets
+        self.times = times
+        self._frame = None
+
+    def __len__(self):
+        return len(self._fpos)
+
+    def load_frame(self, i: int) -> dict:
+        i = int(i)
+        if i < 0:
+            i += len(self)
+        self._fh.seek(self._fpos[i])
+        raw = msgpack.Unpacker(self._fh, raw=False).unpack()
+        self._frame = eigen.decode_tree(raw)
+        return self._frame
+
+    def __getitem__(self, key):
+        if self._frame is None:
+            self.load_frame(0)
+        if key == "bodies":
+            return [b for sub in self._frame["bodies"] for b in sub]
+        if key == "fibers":
+            return self._frame["fibers"][1]
+        return self._frame[key]
+
+    def keys(self):
+        return self._frame.keys() if self._frame is not None else []
+
+    def close(self):
+        self._fh.close()
+
+
+# -------------------------------------------------------------------- resume
+
+def frame_to_state(frame: dict, template_state, dtype=None):
+    """Rebuild a SimState from a decoded frame.
+
+    Fibers are fully reconstructed from the frame (their parameters are
+    serialized); bodies and shell keep their geometry/operators from
+    ``template_state`` and take position/orientation/solution from the frame
+    (`trajectory_reader.cpp:139-251`).
+    """
+    import jax.numpy as jnp
+
+    from ..fibers import container as fc
+
+    if dtype is None:
+        dtype = (template_state.fibers.x.dtype if template_state.fibers is not None
+                 else jnp.float64)
+    state = template_state
+
+    fiber_maps = frame["fibers"][1] if frame["fibers"][0] else []
+    if fiber_maps:
+        n_nodes = {f["n_nodes_"] for f in fiber_maps}
+        if len(n_nodes) != 1:
+            raise NotImplementedError(
+                "mixed fiber resolutions in one trajectory frame")
+        x = np.stack([np.asarray(f["x_"]).reshape(-1, 3) for f in fiber_maps])
+        fibers = fc.make_group(
+            x,
+            lengths=np.array([f["length_"] for f in fiber_maps]),
+            bending_rigidity=np.array([f["bending_rigidity_"] for f in fiber_maps]),
+            radius=np.array([f["radius_"] for f in fiber_maps]),
+            penalty=np.array([f["penalty_param_"] for f in fiber_maps]),
+            beta_tstep=np.array([f["beta_tstep_"] for f in fiber_maps]),
+            force_scale=np.array([f["force_scale_"] for f in fiber_maps]),
+            minus_clamped=np.array([f["minus_clamped_"] for f in fiber_maps]),
+            binding_body=np.array([f["binding_site_"][0] for f in fiber_maps]),
+            binding_site=np.array([f["binding_site_"][1] for f in fiber_maps]),
+            dtype=dtype)
+        fibers = fibers._replace(
+            tension=jnp.asarray(np.stack([f["tension_"] for f in fiber_maps]),
+                                dtype=dtype),
+            length_prev=jnp.asarray([f["length_prev_"] for f in fiber_maps],
+                                    dtype=dtype))
+        state = state._replace(fibers=fibers)
+    elif template_state.fibers is not None:
+        state = state._replace(fibers=None)
+
+    bodies = [b for sub in frame["bodies"] for b in sub]
+    if bodies:
+        if state.bodies is None or state.bodies.n_bodies != len(bodies):
+            raise ValueError("trajectory bodies do not match the configured state")
+        # the wire groups bodies as [spheres..., ellipsoids...]; undo that
+        # regrouping against the template's kind order
+        kind_sphere = np.asarray(state.bodies.kind_sphere)
+        wire_order = ([i for i in range(len(bodies)) if kind_sphere[i]]
+                      + [i for i in range(len(bodies)) if not kind_sphere[i]])
+        position = np.empty((len(bodies), 3))
+        orientation = np.empty((len(bodies), 4))
+        solution = np.empty((len(bodies), bodies[0]["solution_vec_"].shape[0]))
+        for wire_slot, template_i in enumerate(wire_order):
+            position[template_i] = bodies[wire_slot]["position_"]
+            orientation[template_i] = bodies[wire_slot]["orientation_"]
+            solution[template_i] = bodies[wire_slot]["solution_vec_"]
+        state = state._replace(bodies=state.bodies._replace(
+            position=jnp.asarray(position, dtype=dtype),
+            orientation=jnp.asarray(orientation, dtype=dtype),
+            solution=jnp.asarray(solution, dtype=dtype)))
+
+    shell_sol = np.asarray(frame["shell"]["solution_vec_"])
+    if state.shell is not None and shell_sol.size == state.shell.density.shape[0]:
+        state = state._replace(shell=state.shell._replace(
+            density=jnp.asarray(shell_sol, dtype=dtype)))
+
+    state = state._replace(
+        time=jnp.asarray(frame["time"], dtype=dtype),
+        dt=jnp.asarray(frame["dt"], dtype=dtype))
+    return state
+
+
+def resume_state(path: str, template_state):
+    """(state, rng_state, reader) from the last frame (`--resume`,
+    `system.cpp:223-228`)."""
+    reader = TrajectoryReader(path)
+    if len(reader) == 0:
+        raise ValueError(f"{path}: no frames to resume from")
+    frame = reader.load_frame(len(reader) - 1)
+    state = frame_to_state(frame, template_state)
+    return state, frame.get("rng_state", []), reader

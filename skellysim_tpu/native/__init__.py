@@ -1,0 +1,8 @@
+"""Native (C++) runtime helpers, loaded lazily via ctypes.
+
+Each helper ships as a single .cpp compiled on first use with the system g++
+into a shared object cached next to the source. Every native path has a pure
+Python fallback so the framework works without a toolchain.
+"""
+
+from .build import load_library

@@ -188,9 +188,7 @@ class System:
             # external body forces/torques induce explicit flow everywhere
             # (`system.cpp:430-443`)
             ext_ft = bd.external_forces_torques(state.bodies, state.time)
-            zero_sol = jnp.zeros((state.bodies.n_bodies,
-                                  3 * state.bodies.n_nodes + 6), dtype=r_all.dtype)
-            v_all = v_all + bd.flow(state.bodies, body_caches, r_all, zero_sol,
+            v_all = v_all + bd.flow(state.bodies, body_caches, r_all, None,
                                     ext_ft, p.eta)
 
         v_all = v_all + self._external_flows(state, r_all)
