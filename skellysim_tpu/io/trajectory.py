@@ -24,25 +24,24 @@ from typing import Optional
 import msgpack
 import numpy as np
 
-from .. import __version__
+from .. import TRAJECTORY_VERSION, __version__
 from ..native import load_library
 from . import eigen
 
-TRAJECTORY_VERSION = 1
 FIBER_TYPE_NONE = 0
 FIBER_TYPE_FINITE_DIFFERENCE = 1
 
 
 # ---------------------------------------------------------------- frame build
 
-def _fiber_maps(fibers, mask=None):
+def _fiber_maps(fibers):
     """Per-fiber msgpack maps (`fiber_finite_difference.hpp:160-161` field set)."""
     x = np.asarray(fibers.x, dtype=np.float64)
     tension = np.asarray(fibers.tension, dtype=np.float64)
     active = np.asarray(fibers.active)
     out = []
     for i in range(x.shape[0]):
-        if not active[i] or (mask is not None and not mask[i]):
+        if not active[i]:
             continue
         out.append({
             "n_nodes_": int(x.shape[1]),
@@ -195,11 +194,13 @@ def _scan_python(path: str):
 
 def build_index(path: str, use_native: bool = True):
     """Frame (offsets, times); written to `<path>.cindex` like the reference."""
+    # stat BEFORE scanning: a frame appended mid-scan must invalidate the index
+    mtime = os.stat(path).st_mtime
     res = _scan_native(path) if use_native else None
     if res is None:
         res = _scan_python(path)
     offsets, times = res
-    index = {"mtime": os.stat(path).st_mtime, "offsets": offsets, "times": times}
+    index = {"mtime": mtime, "offsets": offsets, "times": times}
     with open(path + ".cindex", "wb") as fh:
         msgpack.dump(index, fh)
     return offsets, times

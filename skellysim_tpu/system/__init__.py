@@ -1,2 +1,3 @@
 from .sources import PointSources, BackgroundFlow  # noqa: F401
 from .system import SimState, System  # noqa: F401
+from .dynamic_instability import apply_dynamic_instability  # noqa: F401

@@ -1,0 +1,203 @@
+"""Dynamic instability: stochastic fiber catastrophe + nucleation.
+
+Host-side re-bucketing between jit'd solve steps, the TPU-native counterpart of
+`System::dynamic_instability` (`/root/reference/src/core/dynamic_instability.cpp`):
+
+- each active fiber draws a catastrophe with P = 1 - exp(-dt * f_cat)
+  (`dynamic_instability.cpp:83-84`), with growth/catastrophe rates rescaled for
+  plus-pinned fibers (`:76-79`); survivors grow by dt * v_growth (`:89-91`)
+- nucleation-site occupancy is a flat bitmap over all body sites (`:63,87`)
+- the number of new fibers is Poisson(dt * rate * n_inactive_old) capped by the
+  free-site count (`:115-116`), each placed on a uniformly drawn free site
+  (`:118-126`), pointing radially out of its body (`:178-186`)
+
+Where the reference mutates a `std::list` and load-balances new fibers across
+MPI ranks (`:150-156`), we flip an `active` mask over a fixed-capacity fiber
+batch: catastrophes deactivate slots (no recompilation), nucleations fill
+inactive slots, and capacity grows geometrically so XLA only re-traces
+O(log n) times. There is no rank placement — the batch axis is mesh-sharded.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..fibers import container as fc
+from ..utils.rng import SimRNG
+
+
+def _grow_capacity(fibers, new_cap: int):
+    """Pad every [nf]-leading leaf to ``new_cap`` slots (padding inactive)."""
+    nf = fibers.n_fibers
+    pad = new_cap - nf
+
+    def pad_leaf(leaf):
+        leaf = np.asarray(leaf)
+        if leaf.ndim >= 1 and leaf.shape[0] == nf:
+            fill = np.zeros((pad,) + leaf.shape[1:], dtype=leaf.dtype)
+            return np.concatenate([leaf, fill], axis=0)
+        return leaf
+
+    padded = type(fibers)(*[pad_leaf(l) for l in fibers])
+    # padded slots must be inert: inactive, unbound
+    active = np.asarray(padded.active)
+    active[nf:] = False
+    binding_body = np.asarray(padded.binding_body)
+    binding_body[nf:] = -1
+    return padded._replace(active=active, binding_body=binding_body)
+
+
+def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5):
+    """One nucleation/catastrophe update. Returns a new SimState.
+
+    Runs on host between solves (like the reference, which calls it at the top
+    of `prep_state_for_solver`, `system.cpp:403`).
+    """
+    di = params.dynamic_instability
+    if di.n_nodes == 0:
+        return state
+    fibers = state.fibers
+    bodies = state.bodies
+    dt = float(state.dt)
+
+    if fibers is not None and fibers.n_nodes != di.n_nodes:
+        raise NotImplementedError(
+            "dynamic_instability.n_nodes must match the fiber group resolution "
+            f"({di.n_nodes} != {fibers.n_nodes}); mixed-resolution buckets are "
+            "not implemented")
+
+    # ---------------------------------------------- catastrophe + growth
+    if fibers is not None and fibers.n_fibers > 0:
+        nf = fibers.n_fibers
+        active = np.asarray(fibers.active).copy()
+        plus_pinned = np.asarray(fibers.plus_pinned)
+        v_growth = np.where(plus_pinned, di.v_growth * di.v_grow_collision_scale,
+                            di.v_growth)
+        f_cat = np.where(plus_pinned,
+                         di.f_catastrophe * di.f_catastrophe_collision_scale,
+                         di.f_catastrophe)
+        attached = active & (np.asarray(fibers.binding_body) >= 0)
+        n_active_old = int(attached.sum())
+
+        u = rng.distributed.uniform(size=nf)
+        die = active & (u > np.exp(-dt * f_cat))
+        survive = active & ~die
+
+        length = np.asarray(fibers.length)
+        length_prev = np.where(survive, length, np.asarray(fibers.length_prev))
+        length = np.where(survive, length + dt * v_growth, length)
+        fibers = fibers._replace(
+            active=survive,
+            length=length, length_prev=length_prev,
+            v_growth=np.where(survive, v_growth, 0.0),
+            binding_body=np.where(survive, np.asarray(fibers.binding_body), -1),
+        )
+    else:
+        n_active_old = 0
+
+    # ---------------------------------------------------------- nucleation
+    if bodies is None or bodies.nucleation_sites_ref.shape[1] == 0:
+        return state._replace(fibers=_as_device(fibers, state))
+    nb, ns = bodies.n_bodies, bodies.nucleation_sites_ref.shape[1]
+    n_sites = nb * ns
+
+    occupied = np.zeros(n_sites, dtype=bool)
+    if fibers is not None and fibers.n_fibers > 0:
+        bb = np.asarray(fibers.binding_body)
+        bs = np.asarray(fibers.binding_site)
+        bound = np.asarray(fibers.active) & (bb >= 0)
+        occupied[bb[bound] * ns + bs[bound]] = True
+
+    free_sites = np.flatnonzero(~occupied)
+    n_inactive_old = n_sites - n_active_old
+    n_nucleate = min(
+        rng.distributed.poisson_int(dt * di.nucleation_rate * n_inactive_old),
+        free_sites.size)
+
+    # sequential uniform draws without replacement (`dynamic_instability.cpp:118-126`)
+    chosen = []
+    pool = list(free_sites)
+    for _ in range(n_nucleate):
+        j = rng.distributed.uniform_int(0, len(pool))
+        chosen.append(pool.pop(j))
+    if not chosen:
+        return state._replace(fibers=_as_device(fibers, state))
+
+    from ..bodies import bodies as bd
+
+    _, _, sites_lab = bd.place(bodies)
+    sites_lab = np.asarray(sites_lab)          # [nb, ns, 3]
+    body_pos = np.asarray(bodies.position)     # [nb, 3]
+
+    new_x, new_body, new_site = [], [], []
+    s = np.linspace(0.0, di.min_length, di.n_nodes)
+    for flat in chosen:
+        i_body, i_site = divmod(int(flat), ns)
+        origin = sites_lab[i_body, i_site]
+        u_dir = origin - body_pos[i_body]
+        u_dir = u_dir / np.linalg.norm(u_dir)
+        new_x.append(origin[None, :] + s[:, None] * u_dir[None, :])
+        new_body.append(i_body)
+        new_site.append(i_site)
+
+    if fibers is None or fibers.n_fibers == 0:
+        dtype = state.time.dtype
+        fibers = fc.make_group(
+            np.stack(new_x), lengths=di.min_length,
+            bending_rigidity=di.bending_rigidity, radius=di.radius,
+            minus_clamped=True, binding_body=np.array(new_body),
+            binding_site=np.array(new_site), dtype=dtype)
+        return state._replace(fibers=fibers)
+
+    # fill inactive slots; grow capacity geometrically when out of room
+    active = np.asarray(fibers.active)
+    slots = np.flatnonzero(~active)
+    if slots.size < len(chosen):
+        need = int(active.sum()) + len(chosen)
+        new_cap = max(int(np.ceil(fibers.n_fibers * capacity_factor)), need)
+        fibers = _grow_capacity(fibers, new_cap)
+        active = np.asarray(fibers.active)
+        slots = np.flatnonzero(~active)
+    slots = slots[:len(chosen)]
+
+    from ..fibers import fd_fiber
+
+    arr = {name: np.asarray(getattr(fibers, name)).copy()
+           for name in ("x", "tension", "length", "length_prev",
+                        "bending_rigidity", "radius", "penalty", "beta_tstep",
+                        "v_growth", "force_scale", "minus_clamped",
+                        "plus_pinned", "binding_body", "binding_site", "active")}
+    for k, slot in enumerate(slots):
+        arr["x"][slot] = new_x[k]
+        arr["tension"][slot] = 0.0
+        arr["length"][slot] = di.min_length
+        arr["length_prev"][slot] = di.min_length
+        arr["bending_rigidity"][slot] = di.bending_rigidity
+        arr["radius"][slot] = di.radius
+        arr["penalty"][slot] = fd_fiber.DEFAULT_PENALTY
+        arr["beta_tstep"][slot] = fd_fiber.DEFAULT_BETA_TSTEP
+        arr["v_growth"][slot] = 0.0
+        arr["force_scale"][slot] = 0.0
+        arr["minus_clamped"][slot] = True
+        arr["plus_pinned"][slot] = False
+        arr["binding_body"][slot] = new_body[k]
+        arr["binding_site"][slot] = new_site[k]
+        arr["active"][slot] = True
+    fibers = fibers._replace(**arr)
+    return state._replace(fibers=_as_device(fibers, state))
+
+
+def _as_device(fibers, state):
+    """Re-materialize numpy-edited leaves as device arrays of the state dtype."""
+    if fibers is None:
+        return None
+    dtype = state.time.dtype
+
+    def conv(name, leaf):
+        leaf = np.asarray(leaf)
+        if leaf.dtype.kind == "f":
+            return jnp.asarray(leaf, dtype=dtype)
+        return jnp.asarray(leaf)
+
+    return type(fibers)(*[conv(n, l) for n, l in zip(fibers._fields, fibers)])

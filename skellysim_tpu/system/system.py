@@ -363,20 +363,29 @@ class System:
         `system.cpp:482-492`). Returns (new_state, solution, info)."""
         return self._solve_jit(state)
 
-    def run(self, state: SimState, *, writer=None, max_steps: int | None = None):
+    def run(self, state: SimState, *, writer=None, max_steps: int | None = None,
+            rng=None):
         """Adaptive time loop (`run`, `system.cpp:516-571`).
 
         Host-side control flow around the jit'd step: accept/reject on fiber
         error + collision, scale dt by beta_up/beta_down, keep the previous
         pytree as the backup for rejected steps. ``writer`` is called with
-        (state, solution) after each accepted step crossing a dt_write boundary.
+        (state, solution) after each accepted step crossing a dt_write boundary
+        (plus ``rng_state=`` when ``rng`` is given). Passing a `SimRNG` enables
+        dynamic instability when `params.dynamic_instability.n_nodes > 0`
+        (`prep_state_for_solver`, `system.cpp:403`); like the reference, a
+        rejected step does not rewind the RNG.
         """
+        from .dynamic_instability import apply_dynamic_instability
+
         p = self.params
         n_steps = 0
         while float(state.time) < p.t_final:
             if max_steps is not None and n_steps >= max_steps:
                 break
             backup = state
+            if rng is not None and p.dynamic_instability.n_nodes > 0:
+                state = apply_dynamic_instability(state, p, rng)
             new_state, solution, info = self.step(state)
             n_steps += 1
             converged = bool(info.converged)
@@ -408,7 +417,10 @@ class System:
                     dt=jnp.asarray(dt_new, dtype=state.dt.dtype))
                 if writer is not None and (int(t_new / p.dt_write)
                                            > int((t_new - dt) / p.dt_write)):
-                    writer(state, solution)
+                    if rng is not None:
+                        writer(state, solution, rng_state=rng.dump_state())
+                    else:
+                        writer(state, solution)
             else:
                 state = backup._replace(dt=jnp.asarray(dt_new, dtype=state.dt.dtype))
         return state

@@ -1,1 +1,2 @@
 from . import quaternion  # noqa: F401
+from .rng import SimRNG, Stream  # noqa: F401

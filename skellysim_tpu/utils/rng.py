@@ -1,0 +1,92 @@
+"""Counter-based RNG with dump/restore, the analogue of the reference's trng4
+`yarn2` engines (`/root/reference/src/core/rng.cpp:13-63`).
+
+Two named streams mirror the reference: ``shared`` (identical draws everywhere;
+`split(2,0)`) and ``distributed`` (per-domain draws; `split(2,1)` +
+per-rank split). On TPU there are no ranks — the whole simulation is one
+program — so both streams are plain counter-based JAX key chains. Determinism
+is *rank-count independent*, which removes the reference's resume restriction
+(`trajectory_reader.cpp:204-219`: resume requires the same rank count).
+
+State is (seed, counter) per stream, serialized to the trajectory as
+``"seed:counter"`` strings in the reference's `rng_state` field
+(`io_maps.hpp:24`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Stream:
+    """One counter-based draw stream. Every draw folds the counter into the
+    base key, so state = (seed, stream_id, counter) fully determines the
+    future sequence."""
+
+    def __init__(self, seed: int, stream_id: int, counter: int = 0):
+        self.seed = int(seed)
+        self.stream_id = int(stream_id)
+        self.counter = int(counter)
+        self._base = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                        self.stream_id)
+
+    def _next_key(self):
+        k = jax.random.fold_in(self._base, self.counter)
+        self.counter += 1
+        return k
+
+    def uniform(self, low=0.0, high=1.0, size=None):
+        shape = () if size is None else ((size,) if np.isscalar(size) else tuple(size))
+        out = np.asarray(jax.random.uniform(
+            self._next_key(), shape, dtype=jnp.float64, minval=low, maxval=high))
+        return float(out) if size is None else out
+
+    def uniform_int(self, low: int, high: int, size=None):
+        """Integer in [low, high) (trng `uniform_int_dist` semantics)."""
+        shape = () if size is None else ((size,) if np.isscalar(size) else tuple(size))
+        out = np.asarray(jax.random.randint(self._next_key(), shape, low, high))
+        return int(out) if size is None else out
+
+    def normal(self, mu=0.0, sigma=1.0, size=None):
+        shape = () if size is None else ((size,) if np.isscalar(size) else tuple(size))
+        out = mu + sigma * np.asarray(jax.random.normal(
+            self._next_key(), shape, dtype=jnp.float64))
+        return float(out) if size is None else out
+
+    def poisson_int(self, lam: float, size=None) -> int:
+        shape = () if size is None else ((size,) if np.isscalar(size) else tuple(size))
+        out = np.asarray(jax.random.poisson(self._next_key(), lam, shape))
+        return int(out) if size is None else out
+
+    def dump(self) -> str:
+        return f"{self.seed}:{self.stream_id}:{self.counter}"
+
+    @staticmethod
+    def load(s: str) -> "Stream":
+        seed, stream_id, counter = (int(v) for v in s.split(":"))
+        return Stream(seed, stream_id, counter)
+
+
+class SimRNG:
+    """The two-stream RNG bundle (`RNG::init`, `rng.cpp:18-32`)."""
+
+    def __init__(self, seed: int = 1):
+        self.shared = Stream(seed, 0)
+        self.distributed = Stream(seed, 1)
+
+    def dump_state(self):
+        """Trajectory `rng_state` payload: [[name, state], ...]."""
+        return [["shared", self.shared.dump()],
+                ["distributed", self.distributed.dump()]]
+
+    @staticmethod
+    def from_state(state) -> "SimRNG":
+        rng = SimRNG()
+        names = {name: s for name, s in state}
+        if "shared" in names:
+            rng.shared = Stream.load(names["shared"])
+        if "distributed" in names:
+            rng.distributed = Stream.load(names["distributed"])
+        return rng
