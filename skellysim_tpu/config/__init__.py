@@ -1,0 +1,7 @@
+from . import param_tools, toml_io
+from .schema import (BackgroundSource, Body, Config, ConfigEllipsoidal,
+                     ConfigRevolution, ConfigSpherical, DynamicInstability,
+                     EllipsoidalPeriphery, Fiber, Params, Periphery,
+                     PeripheryBinding, Point, RevolutionPeriphery,
+                     SphericalPeriphery, load_config, perturbed_fiber_positions,
+                     to_runtime_params, unpack)

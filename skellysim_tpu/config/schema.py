@@ -1,0 +1,539 @@
+"""Typed configuration schema → TOML (the user-facing config contract).
+
+Capability mirror of the reference Python toolkit's dataclass schema
+(`/root/reference/src/skelly_sim/skelly_config.py:253-1036`): the field names
+and defaults ARE the TOML contract read by the runtime, so they match the
+reference exactly; the placement/generation logic is re-implemented on
+vectorized numpy + `param_tools`.
+
+Layout notes vs the reference:
+- `Config.save()` validates types and unknown attributes, then TOML-dumps.
+- `load_config()` is the inverse (the reference only reads TOML from C++).
+- `to_runtime_params()` bridges the schema-level `Params` to the runtime
+  `skellysim_tpu.params.Params` (static jit-relevant configuration).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import List
+
+import numpy as np
+from scipy.optimize import brentq
+from scipy.special import ellipe, ellipeinc
+
+from . import param_tools, toml_io
+from .. import params as runtime_params
+
+__all__ = [
+    "Fiber", "DynamicInstability", "PeripheryBinding", "Params",
+    "Periphery", "SphericalPeriphery", "EllipsoidalPeriphery",
+    "RevolutionPeriphery", "Body", "Point", "BackgroundSource",
+    "Config", "ConfigSpherical", "ConfigEllipsoidal", "ConfigRevolution",
+    "perturbed_fiber_positions", "load_config", "unpack", "to_runtime_params",
+]
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+def _vec3() -> List[float]:
+    return [0.0, 0.0, 0.0]
+
+
+def _ivec3() -> List[int]:
+    return [0, 1, 2]
+
+
+def _quat_identity() -> List[float]:
+    return [0.0, 0.0, 0.0, 1.0]
+
+
+def _random_unit_vector(rng: np.random.Generator) -> np.ndarray:
+    v = rng.normal(size=3)
+    return v / np.linalg.norm(v)
+
+
+def _random_orthogonal(normal: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+    off = np.array([1.0, 0, 0]) if (normal[1] or normal[2]) else np.array([0, 1.0, 0])
+    b = np.cross(normal, off)
+    b /= np.linalg.norm(b)
+    c = np.cross(normal, b)
+    c /= np.linalg.norm(c)
+    theta = 2 * np.pi * rng.uniform()
+    return b * np.cos(theta) + c * np.sin(theta)
+
+
+def _sin_arc_length(amplitude: float, xf: float) -> float:
+    """Arc length of amplitude*sin(2πx/xf) over one period [0, xf]."""
+    a2 = (2 * np.pi * amplitude / xf) ** 2
+    return xf / np.pi * (ellipe(-a2) + np.sqrt(1 + a2) * ellipe(a2 / (1 + a2)))
+
+
+def _cos_arc_length(amplitude: float, xi: float, xf: float, x_max: float) -> float:
+    """Arc length of amplitude*cos(2πx/x_max) on [xi, xf]."""
+    k = 2 * np.pi / x_max
+    a2 = (k * amplitude) ** 2
+    return (ellipeinc(k * xf, -a2) - ellipeinc(k * xi, -a2)) / k
+
+
+def perturbed_fiber_positions(amplitude: float, length: float, x0, normal,
+                              n_nodes: int, ortho=None,
+                              rng: np.random.Generator | None = None) -> np.ndarray:
+    """[n_nodes, 3] fiber nodes: straight along `normal` with a one-period
+    cosine perturbation of the given amplitude, arc-length-parameterized so
+    node spacing is uniform in arc length and the total equals `length`
+    (reference `perturbed_fiber_positions`, `skelly_config.py:130-169`)."""
+    rng = rng or np.random.default_rng()
+    x0 = np.asarray(x0, dtype=float)
+    normal = np.asarray(normal, dtype=float)
+
+    # axial extent x_max such that the perturbed curve has the right length
+    x_max = brentq(lambda xf: _sin_arc_length(amplitude, xf) - length,
+                   1e-3 * length, length)
+    if ortho is None:
+        ortho = _random_orthogonal(normal, rng)
+
+    # place nodes at equal arc-length increments by inverting s(x)
+    ds = length / (n_nodes - 1)
+    xs = np.zeros(n_nodes)
+    for i in range(1, n_nodes):
+        lo = xs[i - 1]
+        xs[i] = brentq(
+            lambda xf: _cos_arc_length(amplitude, lo, xf, x_max) - ds,
+            lo, x_max + 1e-9) if i < n_nodes - 1 else x_max
+    positions = np.outer(xs, normal)
+    positions += np.outer(amplitude * (np.cos(2 * np.pi * xs / x_max) - 1.0), ortho)
+    return positions + x0
+
+
+def _min_sep_ok(x0: np.ndarray, minus_ends: list, ds_min: float) -> bool:
+    if not minus_ends:
+        return True
+    d2 = np.sum((np.asarray(minus_ends) - x0) ** 2, axis=1)
+    return bool(np.all(d2 >= ds_min * ds_min))
+
+
+# ---------------------------------------------------------------------------
+# schema dataclasses (field names/defaults = the TOML contract)
+
+@dataclass
+class Fiber:
+    """One fiber (reference `Fiber`, `skelly_config.py:253-308`)."""
+    n_nodes: int = 32
+    parent_body: int = -1
+    parent_site: int = -1
+    force_scale: float = 0.0
+    bending_rigidity: float = 2.5e-3
+    radius: float = 0.0125
+    length: float = 1.0
+    minus_clamped: bool = False
+    x: List[float] = field(default_factory=list)
+
+    def fill_node_positions(self, x0, normal) -> None:
+        """Straight fiber from x0 along `normal`, uniformly spaced."""
+        x0 = np.asarray(x0, dtype=float)
+        normal = np.asarray(normal, dtype=float)
+        s = np.linspace(0.0, self.length, self.n_nodes)
+        self.x = (x0[None, :] + s[:, None] * normal[None, :]).ravel().tolist()
+
+
+@dataclass
+class DynamicInstability:
+    n_nodes: int = 0
+    v_growth: float = 0.0
+    f_catastrophe: float = 0.0
+    v_grow_collision_scale: float = 0.5
+    f_catastrophe_collision_scale: float = 2.0
+    nucleation_rate: float = 0.0
+    radius: float = 0.025
+    min_length: float = 0.5
+    bending_rigidity: float = 2.5e-3
+    min_separation: float = 0.1
+
+
+@dataclass
+class PeripheryBinding:
+    active: bool = False
+    polar_angle_start: float = 0.0
+    polar_angle_end: float = 0.5 * np.pi
+    threshold: float = 0.75
+
+
+@dataclass
+class Params:
+    """System parameters (reference `Params`, `skelly_config.py:373-430`)."""
+    eta: float = 1.0
+    dt_initial: float = 0.025
+    dt_min: float = 1e-5
+    dt_max: float = 0.025
+    dt_write: float = 0.1
+    t_final: float = 100.0
+    gmres_tol: float = 1e-8
+    fiber_error_tol: float = 0.1
+    seed: int = 130319
+    implicit_motor_activation_delay: float = 0.0
+    dynamic_instability: DynamicInstability = field(default_factory=DynamicInstability)
+    periphery_binding: PeripheryBinding = field(default_factory=PeripheryBinding)
+    periphery_interaction_flag: bool = False
+    adaptive_timestep_flag: bool = True
+    pair_evaluator: str = "TPU"
+    fiber_type: str = "FiniteDifference"
+
+
+@dataclass
+class Periphery:
+    """Base periphery (use a shaped subclass)."""
+    n_nodes: int = 6000
+    precompute_file: str = "periphery_precompute.npz"
+
+    def find_binding_site(self, fibers, ds_min):
+        raise NotImplementedError
+
+    def move_fibers_to_surface(self, fibers, ds_min, verbose=True,
+                               rng=None) -> None:
+        """Place fibers' minus ends uniformly on the surface pointing inward,
+        rejecting sites closer than ds_min to prior minus ends."""
+        rng = rng or np.random.default_rng()
+        ends: list = []
+        for i, fib in enumerate(fibers):
+            x0, inward = self.find_binding_site_impl(ends, ds_min, rng)
+            fib.fill_node_positions(x0, inward)
+            ends.append(x0)
+            if verbose:
+                print(f"Inserted fiber {i} at {x0}")
+
+
+@dataclass
+class SphericalPeriphery(Periphery):
+    shape: str = "sphere"
+    radius: float = 6.0
+
+    def find_binding_site_impl(self, minus_ends, ds_min, rng):
+        while True:
+            u0 = _random_unit_vector(rng)
+            x0 = 0.99999999 * self.radius * u0
+            if _min_sep_ok(x0, minus_ends, ds_min):
+                return x0, -u0
+
+    def find_binding_site(self, fibers, ds_min, rng=None):
+        rng = rng or np.random.default_rng()
+        ends = [np.asarray(f.x[0:3]) for f in fibers if len(f.x) >= 3]
+        x0, inward = self.find_binding_site_impl(ends, ds_min, rng)
+        return x0, -inward
+
+
+@dataclass
+class EllipsoidalPeriphery(Periphery):
+    """(x/a)² + (y/b)² + (z/c)² = 1."""
+    shape: str = "ellipsoid"
+    a: float = 7.8
+    b: float = 4.16
+    c: float = 4.16
+
+    def move_fibers_to_surface(self, fibers, ds_min, verbose=True, rng=None):
+        rng = rng or np.random.default_rng()
+        # sample uniform-by-area trial points slightly inside the surface
+        a, b, c = self.a / 1.04, self.b / 1.04, self.c / 1.04
+
+        def surf(t, u):
+            return np.stack([a * np.cos(t) * np.sin(u),
+                             b * np.sin(t) * np.sin(u),
+                             c * np.cos(u)])
+
+        n_trials = max(5 * len(fibers), 64)
+        trials = param_tools.r_surface(n_trials, surf, 0, 2 * np.pi, 0, np.pi,
+                                       rng=rng)[0].T
+        ends: list = []
+        i_trial = 0
+        for i, fib in enumerate(fibers):
+            while True:
+                if i_trial >= n_trials:
+                    raise RuntimeError(
+                        "Unable to insert fibers; decrease density or ds_min")
+                x0 = trials[i_trial]
+                i_trial += 1
+                if _min_sep_ok(x0, ends, ds_min):
+                    break
+            normal = np.array([x0[0] / self.a ** 2, x0[1] / self.b ** 2,
+                               x0[2] / self.c ** 2])
+            normal = -normal / np.linalg.norm(normal)
+            fib.fill_node_positions(x0, normal)
+            ends.append(x0)
+            if verbose:
+                print(f"Inserted fiber {i} at {x0}")
+
+
+@dataclass
+class RevolutionPeriphery(Periphery):
+    """Surface of revolution of a height function h(x) around the x axis.
+
+    `envelope` keys (reference `RevolutionPeriphery`, `skelly_config.py:609-716`):
+    height (a one-line expression of x), lower_bound, upper_bound,
+    n_nodes_target, plus free parameters referenced by the expression.
+    """
+    shape: str = "surface_of_revolution"
+    n_nodes: int = 0
+    envelope: dict = field(default_factory=dict)
+
+    def move_fibers_to_surface(self, fibers, ds_min, verbose=True, rng=None):
+        from ..periphery.shapes import Envelope
+        rng = rng or np.random.default_rng()
+        env = Envelope(self.envelope)
+        lb, ub = self.envelope["lower_bound"], self.envelope["upper_bound"]
+
+        # CDF of circumference-weighted x for uniform-by-area sampling
+        xs = np.linspace(lb, ub, 1000)
+        w = np.maximum(env.raw_height(xs), 0.0)
+        cdf = np.concatenate([[0.0], np.cumsum(0.5 * (w[1:] + w[:-1]))])
+        cdf /= cdf[-1]
+
+        ends: list = []
+        for i, fib in enumerate(fibers):
+            while True:
+                x_t = np.interp(rng.uniform(), cdf, xs)
+                h_t = float(env.raw_height(x_t))
+                theta = 2 * np.pi * rng.uniform()
+                x0 = np.array([x_t, h_t * np.cos(theta), h_t * np.sin(theta)])
+                if _min_sep_ok(x0, ends, ds_min):
+                    break
+            if x0[0] <= env.lower_bound:
+                normal = np.array([1.0, 0.0, 0.0])
+            elif x0[0] >= env.upper_bound:
+                normal = np.array([-1.0, 0.0, 0.0])
+            else:
+                normal = np.array([float(env(x0[0]) * env.differentiate(x0[0])),
+                                   -x0[1], -x0[2]])
+                normal /= np.linalg.norm(normal)
+            fib.fill_node_positions(x0, normal)
+            ends.append(x0)
+            if verbose:
+                print(f"Inserted fiber {i} at {x0}")
+
+
+@dataclass
+class Body:
+    """One rigid body (reference `Body`, `skelly_config.py:719-872`)."""
+    n_nucleation_sites: int = 0
+    position: List[float] = field(default_factory=_vec3)
+    orientation: List[float] = field(default_factory=_quat_identity)
+    shape: str = "sphere"
+    radius: float = 1.0
+    n_nodes: int = 600
+    axis_length: List[float] = field(default_factory=_vec3)
+    precompute_file: str = "body_precompute.npz"
+    external_force_type: str = "Linear"
+    external_force: List[float] = field(default_factory=_vec3)
+    external_torque: List[float] = field(default_factory=_vec3)
+    nucleation_sites: List[float] = field(default_factory=list)
+    external_oscillation_force_amplitude: float = 0.0
+    external_oscillation_force_frequency: float = 0.0
+    external_oscillation_force_phase: float = 0.0
+
+    def _require_sphere(self):
+        if self.shape != "sphere":
+            raise ValueError("fiber attachment only implemented for spherical bodies")
+
+    def find_binding_site(self, fibers, ds_min, rng=None):
+        self._require_sphere()
+        rng = rng or np.random.default_rng()
+        com = np.asarray(self.position)
+        ends = [np.asarray(f.x[0:3]) for f in fibers if len(f.x) >= 3]
+        while True:
+            u0 = _random_unit_vector(rng)
+            x0 = com + self.radius * u0
+            if _min_sep_ok(x0, ends, ds_min):
+                return x0, u0
+
+    def generate_nucleation_sites(self, ds_min, verbose=True, rng=None) -> None:
+        self._require_sphere()
+        rng = rng or np.random.default_rng()
+        com = np.asarray(self.position)
+        sites: list = []
+        for isite in range(self.n_nucleation_sites):
+            while True:
+                x0 = com + self.radius * _random_unit_vector(rng)
+                if _min_sep_ok(x0, sites, ds_min):
+                    sites.append(x0)
+                    if verbose:
+                        print(f"Inserting site {isite} at {x0}")
+                    break
+        self.nucleation_sites = np.asarray(sites).ravel().tolist()
+
+    def move_fibers_to_surface(self, fibers, ds_min, verbose=True, rng=None):
+        """Place fibers on the body surface pointing outward."""
+        self._require_sphere()
+        rng = rng or np.random.default_rng()
+        com = np.asarray(self.position)
+        ends: list = []
+        for i, fib in enumerate(fibers):
+            while True:
+                u0 = _random_unit_vector(rng)
+                x0 = com + self.radius * u0
+                if _min_sep_ok(x0, ends, ds_min):
+                    break
+            fib.fill_node_positions(x0, u0)
+            ends.append(x0)
+            if verbose:
+                print(f"Inserted fiber {i} at {x0}")
+
+
+@dataclass
+class Point:
+    """Point force/torque source (reference `Point`, `skelly_config.py:875-894`)."""
+    position: List[float] = field(default_factory=_vec3)
+    force: List[float] = field(default_factory=_vec3)
+    torque: List[float] = field(default_factory=_vec3)
+    time_to_live: float = 0.0
+
+
+@dataclass
+class BackgroundSource:
+    """Uniform + linear-shear background flow (reference `skelly_config.py:897-913`)."""
+    components: List[int] = field(default_factory=_ivec3)
+    scale_factor: List[float] = field(default_factory=_vec3)
+    uniform: List[float] = field(default_factory=_vec3)
+
+
+@dataclass
+class Config:
+    """Free-space config (no bounding volume)."""
+    params: Params = field(default_factory=Params)
+    bodies: List[Body] = field(default_factory=list)
+    fibers: List[Fiber] = field(default_factory=list)
+    point_sources: List[Point] = field(default_factory=list)
+    background: BackgroundSource = field(default_factory=BackgroundSource)
+
+    def validate(self) -> list[str]:
+        return _validate(self)
+
+    def save(self, filename: str = "skelly_config.toml") -> None:
+        problems = self.validate()
+        if problems:
+            raise ValueError("invalid config:\n  " + "\n  ".join(problems))
+        toml_io.dump(unpack(self), filename)
+
+
+@dataclass
+class ConfigSpherical(Config):
+    periphery: SphericalPeriphery = field(default_factory=SphericalPeriphery)
+
+
+@dataclass
+class ConfigEllipsoidal(Config):
+    periphery: EllipsoidalPeriphery = field(default_factory=EllipsoidalPeriphery)
+
+
+@dataclass
+class ConfigRevolution(Config):
+    periphery: RevolutionPeriphery = field(default_factory=RevolutionPeriphery)
+
+
+# ---------------------------------------------------------------------------
+# validation / (de)serialization
+
+def _validate(obj, prefix: str = "") -> list[str]:
+    """Type-check every field against its annotation; flag unknown attributes
+    (reference `check_type` + `_check_invalid_attributes`,
+    `skelly_config.py:202-228,958-973`)."""
+    problems: list[str] = []
+    known = {f.name for f in fields(obj)}
+    for name in vars(obj):
+        if name not in known:
+            problems.append(f"{prefix}{name}: unknown attribute")
+    for f in fields(obj):
+        v = getattr(obj, f.name)
+        where = f"{prefix}{f.name}"
+        if is_dataclass(v):
+            problems += _validate(v, where + ".")
+        elif isinstance(v, list):
+            for j, item in enumerate(v):
+                if is_dataclass(item):
+                    problems += _validate(item, f"{where}[{j}].")
+                elif isinstance(item, (np.floating, np.integer)):
+                    problems.append(f"{where}[{j}]: numpy scalar; use float/int")
+        elif isinstance(v, (np.floating, np.integer, np.ndarray)):
+            problems.append(f"{where}: numpy type; use plain float/int/list")
+        elif isinstance(v, (bool, float, int, str, dict)):
+            pass
+        else:
+            problems.append(f"{where}: unsupported type {type(v).__name__}")
+    return problems
+
+
+def unpack(obj) -> dict:
+    """Dataclass tree → plain dict suitable for TOML (drops empty lists the
+    runtime treats as absent? no — keeps everything; the TOML is the contract)."""
+    if is_dataclass(obj):
+        return {f.name: unpack(getattr(obj, f.name)) for f in fields(obj)}
+    if isinstance(obj, (list, tuple)):
+        return [unpack(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    return obj
+
+
+def _from_dict(cls, data: dict):
+    kwargs = {}
+    known = {f.name: f for f in fields(cls)}
+    for k, v in data.items():
+        if k not in known:
+            continue  # forward compatibility: ignore unknown keys on load
+        f = known[k]
+        ann = str(f.type)
+        if "DynamicInstability" in ann:
+            v = _from_dict(DynamicInstability, v)
+        elif "PeripheryBinding" in ann:
+            v = _from_dict(PeripheryBinding, v)
+        kwargs[k] = v
+    return cls(**kwargs)
+
+
+def load_config(path: str):
+    """TOML file → Config (shaped subclass chosen by periphery.shape)."""
+    data = toml_io.load(path)
+    peri = data.get("periphery")
+    if peri is None:
+        cfg = Config()
+    else:
+        shape = peri.get("shape", "sphere")
+        cls, pcls = {
+            "sphere": (ConfigSpherical, SphericalPeriphery),
+            "ellipsoid": (ConfigEllipsoidal, EllipsoidalPeriphery),
+            "surface_of_revolution": (ConfigRevolution, RevolutionPeriphery),
+        }[shape]
+        cfg = cls()
+        cfg.periphery = _from_dict(pcls, peri)
+    cfg.params = _from_dict(Params, data.get("params", {}))
+    cfg.fibers = [_from_dict(Fiber, d) for d in data.get("fibers", [])]
+    cfg.bodies = [_from_dict(Body, d) for d in data.get("bodies", [])]
+    cfg.point_sources = [_from_dict(Point, d) for d in data.get("point_sources", [])]
+    cfg.background = _from_dict(BackgroundSource, data.get("background", {}))
+    return cfg
+
+
+def to_runtime_params(p: Params) -> runtime_params.Params:
+    """Schema-level Params → runtime (jit-static) Params."""
+    return runtime_params.Params(
+        eta=p.eta,
+        dt_initial=p.dt_initial,
+        dt_min=p.dt_min,
+        dt_max=p.dt_max,
+        adaptive_timestep_flag=p.adaptive_timestep_flag,
+        dt_write=p.dt_write,
+        t_final=p.t_final,
+        gmres_tol=p.gmres_tol,
+        fiber_error_tol=p.fiber_error_tol,
+        seed=p.seed,
+        implicit_motor_activation_delay=p.implicit_motor_activation_delay,
+        periphery_interaction_flag=p.periphery_interaction_flag,
+        dynamic_instability=runtime_params.DynamicInstability(
+            **dataclasses.asdict(p.dynamic_instability)),
+        periphery_binding=runtime_params.PeripheryBinding(
+            **dataclasses.asdict(p.periphery_binding)),
+    )

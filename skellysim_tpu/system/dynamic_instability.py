@@ -35,7 +35,10 @@ def _grow_capacity(fibers, new_cap: int):
     def pad_leaf(leaf):
         leaf = np.asarray(leaf)
         if leaf.ndim >= 1 and leaf.shape[0] == nf:
-            fill = np.zeros((pad,) + leaf.shape[1:], dtype=leaf.dtype)
+            # replicate slot 0 instead of zero-filling: a zero-length/zero-x
+            # fiber makes the cache derivatives inf/NaN, and 0-weight * NaN
+            # leaks NaN through the stokeslet sum even for inactive slots
+            fill = np.repeat(leaf[:1], pad, axis=0)
             return np.concatenate([leaf, fill], axis=0)
         return leaf
 
@@ -163,11 +166,18 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5):
 
     from ..fibers import fd_fiber
 
-    arr = {name: np.asarray(getattr(fibers, name)).copy()
-           for name in ("x", "tension", "length", "length_prev",
-                        "bending_rigidity", "radius", "penalty", "beta_tstep",
-                        "v_growth", "force_scale", "minus_clamped",
-                        "plus_pinned", "binding_body", "binding_site", "active")}
+    arr = {name: np.asarray(leaf).copy()
+           for name, leaf in zip(fibers._fields, fibers)
+           if np.asarray(leaf).ndim >= 1
+           and np.asarray(leaf).shape[0] == fibers.n_fibers}
+    handled = {"x", "tension", "length", "length_prev", "bending_rigidity",
+               "radius", "penalty", "beta_tstep", "v_growth", "force_scale",
+               "minus_clamped", "plus_pinned", "binding_body", "binding_site",
+               "active"}
+    if set(arr) - handled:
+        raise RuntimeError(
+            f"nucleation slot-fill does not reset fiber fields {set(arr) - handled}; "
+            "recycled slots would inherit dead fibers' values")
     for k, slot in enumerate(slots):
         arr["x"][slot] = new_x[k]
         arr["tension"][slot] = 0.0

@@ -1,0 +1,192 @@
+"""Config schema layer: TOML round-trip, validation, generators, param_tools.
+
+Mirrors the role of the reference's config toolkit
+(`/root/reference/src/skelly_sim/skelly_config.py`, `param_tools.py`).
+"""
+
+import numpy as np
+import pytest
+
+from skellysim_tpu.config import (Body, Config, ConfigEllipsoidal,
+                                  ConfigRevolution, ConfigSpherical, Fiber,
+                                  Point, load_config, param_tools,
+                                  perturbed_fiber_positions, to_runtime_params,
+                                  toml_io, unpack)
+
+
+def test_toml_round_trip_scalars_and_tables(tmp_path):
+    data = {
+        "params": {"eta": 1.5, "seed": 42, "adaptive_timestep_flag": True,
+                   "name": 'quote"inside', "nested": {"x": [1.0, 2.0, 3.0]}},
+        "fibers": [{"n_nodes": 32, "length": 1.0}, {"n_nodes": 16, "length": 2.0}],
+    }
+    p = tmp_path / "t.toml"
+    toml_io.dump(data, str(p))
+    back = toml_io.load(str(p))
+    assert back == data
+
+
+def test_config_save_load_round_trip(tmp_path):
+    cfg = ConfigSpherical()
+    cfg.params.eta = 0.9
+    cfg.params.dynamic_instability.nucleation_rate = 30.0
+    cfg.periphery.radius = 4.25
+    cfg.periphery.n_nodes = 1000
+    fib = Fiber(n_nodes=24, length=0.8, bending_rigidity=1e-2)
+    fib.fill_node_positions(np.zeros(3), np.array([0.0, 0.0, 1.0]))
+    cfg.fibers = [fib]
+    cfg.bodies = [Body(radius=0.5, n_nodes=400, external_force=[0.0, 0.0, -1.0])]
+    cfg.point_sources = [Point(position=[0.0, 0.0, 1.0], force=[1.0, 0.0, 0.0])]
+    path = tmp_path / "skelly_config.toml"
+    cfg.save(str(path))
+
+    back = load_config(str(path))
+    assert isinstance(back, ConfigSpherical)
+    assert back.params.eta == 0.9
+    assert back.params.dynamic_instability.nucleation_rate == 30.0
+    assert back.periphery.radius == 4.25
+    assert len(back.fibers) == 1 and back.fibers[0].n_nodes == 24
+    np.testing.assert_allclose(back.fibers[0].x, fib.x)
+    assert back.bodies[0].external_force == [0.0, 0.0, -1.0]
+    assert back.point_sources[0].force == [1.0, 0.0, 0.0]
+
+
+def test_validation_rejects_numpy_and_unknown(tmp_path):
+    cfg = Config()
+    cfg.fibers = [Fiber()]
+    cfg.fibers[0].length = np.float64(1.0)  # numpy scalar → rejected
+    with pytest.raises(ValueError, match="numpy"):
+        cfg.save(str(tmp_path / "bad.toml"))
+
+    cfg2 = Config()
+    cfg2.typo_field = 3  # unknown attribute → rejected
+    with pytest.raises(ValueError, match="unknown attribute"):
+        cfg2.save(str(tmp_path / "bad2.toml"))
+
+
+def test_fill_node_positions_straight_line():
+    fib = Fiber(n_nodes=8, length=2.0)
+    fib.fill_node_positions(np.array([1.0, 0, 0]), np.array([0, 0, 1.0]))
+    x = np.asarray(fib.x).reshape(8, 3)
+    np.testing.assert_allclose(x[0], [1, 0, 0], atol=1e-14)
+    np.testing.assert_allclose(x[-1], [1, 0, 2.0], atol=1e-14)
+    seg = np.linalg.norm(np.diff(x, axis=0), axis=1)
+    np.testing.assert_allclose(seg, 2.0 / 7, atol=1e-14)
+
+
+def test_perturbed_fiber_arclength_and_endpoints():
+    rng = np.random.default_rng(0)
+    L = 1.0
+    x = perturbed_fiber_positions(0.05, L, np.array([1.0, 1.0, 1.0]),
+                                  np.array([0.0, 0.0, 1.0]), 64, rng=rng)
+    assert x.shape == (64, 3)
+    np.testing.assert_allclose(x[0], [1, 1, 1], atol=1e-9)
+    # arc length ≈ L, and node spacing uniform in arc length
+    seg = np.linalg.norm(np.diff(x, axis=0), axis=1)
+    assert abs(seg.sum() - L) < 1e-3
+    assert seg.std() / seg.mean() < 1e-2
+    # perturbation vanishes at both ends: end-to-end vector along normal
+    ee = x[-1] - x[0]
+    assert abs(ee[0]) < 1e-6 and abs(ee[1]) < 1e-6
+
+
+def test_spherical_fiber_placement_min_separation():
+    cfg = ConfigSpherical()
+    cfg.periphery.radius = 5.0
+    cfg.fibers = [Fiber(n_nodes=8, length=1.0) for _ in range(40)]
+    cfg.periphery.move_fibers_to_surface(cfg.fibers, ds_min=0.5, verbose=False,
+                                         rng=np.random.default_rng(3))
+    ends = np.array([f.x[0:3] for f in cfg.fibers])
+    r = np.linalg.norm(ends, axis=1)
+    np.testing.assert_allclose(r, 5.0, rtol=1e-6)
+    d = np.linalg.norm(ends[:, None] - ends[None, :], axis=-1)
+    np.fill_diagonal(d, np.inf)
+    assert d.min() >= 0.5
+    # fibers point inward: tip radius < base radius
+    tips = np.array([f.x[-3:] for f in cfg.fibers])
+    assert np.all(np.linalg.norm(tips, axis=1) < r)
+
+
+def test_ellipsoidal_fiber_placement():
+    cfg = ConfigEllipsoidal()
+    cfg.periphery.a, cfg.periphery.b, cfg.periphery.c = 6.0, 4.0, 4.0
+    cfg.fibers = [Fiber(n_nodes=8, length=0.5) for _ in range(20)]
+    cfg.periphery.move_fibers_to_surface(cfg.fibers, ds_min=0.3, verbose=False,
+                                         rng=np.random.default_rng(5))
+    ends = np.array([f.x[0:3] for f in cfg.fibers])
+    lvl = (ends[:, 0] / (6.0 / 1.04)) ** 2 + (ends[:, 1] / (4.0 / 1.04)) ** 2 \
+        + (ends[:, 2] / (4.0 / 1.04)) ** 2
+    np.testing.assert_allclose(lvl, 1.0, atol=0.05)
+
+
+def test_revolution_fiber_placement():
+    cfg = ConfigRevolution()
+    cfg.periphery.envelope = {
+        "n_nodes_target": 400,
+        "lower_bound": -3.75, "upper_bound": 3.75,
+        "height": "0.5 * T * ((1 + 2*x/length)**p1) * ((1 - 2*x/length)**p2) * length",
+        "T": 0.72, "p1": 0.4, "p2": 0.2, "length": 7.5,
+    }
+    cfg.fibers = [Fiber(n_nodes=8, length=0.3) for _ in range(10)]
+    cfg.periphery.move_fibers_to_surface(cfg.fibers, ds_min=0.2, verbose=False,
+                                         rng=np.random.default_rng(7))
+    ends = np.array([f.x[0:3] for f in cfg.fibers])
+    # minus ends lie on the surface: y² + z² = h(x)²
+    from skellysim_tpu.periphery.shapes import Envelope
+    env = Envelope(cfg.periphery.envelope)
+    h = env.raw_height(ends[:, 0])
+    np.testing.assert_allclose(np.hypot(ends[:, 1], ends[:, 2]), h, rtol=1e-6)
+
+
+def test_body_nucleation_sites_and_placement():
+    body = Body(radius=1.0, position=[1.0, 2.0, 3.0], n_nucleation_sites=20)
+    body.generate_nucleation_sites(0.3, verbose=False,
+                                   rng=np.random.default_rng(11))
+    sites = np.asarray(body.nucleation_sites).reshape(20, 3)
+    r = np.linalg.norm(sites - np.array([1.0, 2.0, 3.0]), axis=1)
+    np.testing.assert_allclose(r, 1.0, rtol=1e-9)
+    d = np.linalg.norm(sites[:, None] - sites[None, :], axis=-1)
+    np.fill_diagonal(d, np.inf)
+    assert d.min() >= 0.3
+
+
+def test_to_runtime_params():
+    cfg = Config()
+    cfg.params.gmres_tol = 1e-9
+    cfg.params.dynamic_instability.v_growth = 0.75
+    rp = to_runtime_params(cfg.params)
+    assert rp.gmres_tol == 1e-9
+    assert rp.dynamic_instability.v_growth == 0.75
+
+
+def test_param_tools_uniform_on_sphere():
+    rng = np.random.default_rng(0)
+
+    def sphere(t, u):
+        return np.stack([np.cos(t) * np.sin(u), np.sin(t) * np.sin(u),
+                         np.cos(u) * np.ones_like(t)])
+
+    area = param_tools.surface_area(sphere, 0, 2 * np.pi, 0, np.pi,
+                                    t_precision=200, u_precision=200)
+    assert abs(area - 4 * np.pi) / (4 * np.pi) < 1e-3
+
+    pts = param_tools.r_surface(4000, sphere, 0, 2 * np.pi, 0, np.pi, rng=rng)[0].T
+    np.testing.assert_allclose(np.linalg.norm(pts, axis=1), 1.0, atol=1e-3)
+    # uniform by area → each octant gets ~1/8
+    octant = (pts[:, 0] > 0) & (pts[:, 1] > 0) & (pts[:, 2] > 0)
+    assert abs(octant.mean() - 0.125) < 0.02
+    # z uniform on [-1, 1] for a uniform sphere sample
+    assert abs(pts[:, 2].mean()) < 0.05
+
+
+def test_param_tools_arc():
+    def helix(t):
+        return np.stack([np.cos(t), np.sin(t), 0.5 * t])
+
+    L = param_tools.arc_length(helix, 0, 4 * np.pi, precision=4000)
+    assert abs(L - 4 * np.pi * np.sqrt(1.25)) / L < 1e-4
+    pts, ts, ss = param_tools.r_arc(500, helix, 0, 4 * np.pi,
+                                    rng=np.random.default_rng(1))
+    assert pts.shape == (3, 500)
+    # uniform in arc length → t uniform (constant speed curve)
+    assert abs(ts.mean() - 2 * np.pi) / (2 * np.pi) < 0.1

@@ -160,6 +160,24 @@ def test_capacity_growth_preserves_state():
     assert np.all(np.asarray(grown.binding_body)[3:] == -1)
 
 
+def test_capacity_growth_padding_is_finite_in_flow():
+    """Regression: zero-padded slots (length=0) made the fiber cache NaN and
+    0-weight * NaN leaked through the stokeslet sum, poisoning all targets."""
+    import jax.numpy as jnp
+    x = np.tile(np.linspace(0, 1, 16)[None, :, None], (2, 1, 3)) \
+        + np.array([[[1.0, 0, 0]], [[-1.0, 0, 0]]])
+    fibers = fc.make_group(x, lengths=1.0, bending_rigidity=0.01, radius=0.0125)
+    grown = _grow_capacity(fibers, 5)
+    grown = type(grown)(*[jnp.asarray(l) for l in grown])
+    caches = fc.update_cache(grown, dt=0.01, eta=1.0)
+    for leaf in caches:
+        assert np.all(np.isfinite(np.asarray(leaf))), "NaN in fiber cache"
+    r_trg = jnp.asarray(np.random.default_rng(0).uniform(-2, 2, (7, 3)))
+    forces = jnp.zeros_like(grown.x)
+    u = fc.flow(grown, caches, r_trg, forces, eta=1.0, subtract_self=False)
+    assert np.all(np.isfinite(np.asarray(u)))
+
+
 # ------------------------------------------------------------- integration
 
 def test_run_loop_with_dynamic_instability():
