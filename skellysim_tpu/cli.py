@@ -1,0 +1,79 @@
+"""Process driver: run / resume / listen on a TOML config.
+
+Counterpart of the reference CLI (`/root/reference/src/skelly_sim.cpp:12-68`):
+flag parsing, trajectory-existence guards, dispatch to the time loop or the
+listener server. No MPI/Kokkos boot — device setup is JAX's.
+
+Usage: python -m skellysim_tpu [--config-file=...] [--resume] [--overwrite] [--listen]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+
+from .builder import build_simulation
+from .io.trajectory import TrajectoryWriter, resume_state
+from .utils.rng import SimRNG
+
+TRAJECTORY_FILE = "skelly_sim.out"
+
+
+def run(config_file: str, resume: bool = False, overwrite: bool = False,
+        trajectory_path: str | None = None) -> None:
+    traj = trajectory_path or os.path.join(
+        os.path.dirname(os.path.abspath(config_file)) or ".", TRAJECTORY_FILE)
+
+    # trajectory guards (`skelly_sim.cpp:32-50`)
+    if os.path.exists(traj) and not (resume or overwrite):
+        sys.exit(f"Trajectory '{traj}' already exists and neither --resume nor "
+                 "--overwrite was given; refusing to clobber it")
+    if resume and not os.path.exists(traj):
+        sys.exit(f"--resume given but trajectory '{traj}' does not exist")
+
+    system, state, rng = build_simulation(config_file)
+
+    if resume:
+        state, rng_state, reader = resume_state(traj, state)
+        reader.close()
+        if rng_state:
+            rng = SimRNG.from_state(rng_state)
+        writer = TrajectoryWriter(traj, append=True)
+        print(f"Resuming from t={float(state.time):.6g}")
+    else:
+        writer = TrajectoryWriter(traj)
+        # initial config snapshot (`system.cpp:716`, `skelly_sim.initial_config`)
+        shutil.copyfile(config_file, traj.replace(".out", ".initial_config"))
+        writer.write_frame(state, rng_state=rng.dump_state())
+
+    with writer:
+        final = system.run(state, writer=writer.write_frame, rng=rng)
+
+    shutil.copyfile(config_file, traj.replace(".out", ".final_config"))
+    print(f"Finished at t={float(final.time):.6g}")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="skellysim-tpu",
+        description="TPU-native cytoskeletal hydrodynamics simulator")
+    ap.add_argument("--config-file", default="skelly_config.toml")
+    ap.add_argument("--resume", action="store_true",
+                    help="continue an existing trajectory from its last frame")
+    ap.add_argument("--overwrite", action="store_true",
+                    help="overwrite an existing trajectory")
+    ap.add_argument("--listen", action="store_true",
+                    help="post-processing server: msgpack requests on stdin")
+    args = ap.parse_args(argv)
+
+    if args.listen:
+        from .listener import serve  # deferred: heavy post-processing imports
+        serve(args.config_file)
+        return
+    run(args.config_file, resume=args.resume, overwrite=args.overwrite)
+
+
+if __name__ == "__main__":
+    main()

@@ -283,10 +283,12 @@ class RevolutionPeriphery(Periphery):
         env = Envelope(self.envelope)
         lb, ub = self.envelope["lower_bound"], self.envelope["upper_bound"]
 
-        # CDF of circumference-weighted x for uniform-by-area sampling
+        # CDF of the area element h(x)·√(dx² + dh²) for uniform-by-area sampling
         xs = np.linspace(lb, ub, 1000)
-        w = np.maximum(env.raw_height(xs), 0.0)
-        cdf = np.concatenate([[0.0], np.cumsum(0.5 * (w[1:] + w[:-1]))])
+        h = np.maximum(env.raw_height(xs), 0.0)
+        slant = np.sqrt(np.diff(xs) ** 2 + np.diff(h) ** 2)
+        dA = 0.5 * (h[1:] + h[:-1]) * slant
+        cdf = np.concatenate([[0.0], np.cumsum(dA)])
         cdf /= cdf[-1]
 
         ends: list = []
@@ -455,7 +457,15 @@ def _validate(obj, prefix: str = "") -> list[str]:
                     problems.append(f"{where}[{j}]: numpy scalar; use float/int")
         elif isinstance(v, (np.floating, np.integer, np.ndarray)):
             problems.append(f"{where}: numpy type; use plain float/int/list")
-        elif isinstance(v, (bool, float, int, str, dict)):
+        elif isinstance(v, dict):
+            for k, item in v.items():
+                # numpy scalars are unpacked to plain types at save; flag
+                # anything else non-TOML-serializable
+                if not isinstance(item, (bool, float, int, str, list, dict,
+                                         np.floating, np.integer, np.ndarray)):
+                    problems.append(
+                        f"{where}[{k!r}]: unsupported type {type(item).__name__}")
+        elif isinstance(v, (bool, float, int, str)):
             pass
         else:
             problems.append(f"{where}: unsupported type {type(v).__name__}")
@@ -467,6 +477,8 @@ def unpack(obj) -> dict:
     runtime treats as absent? no — keeps everything; the TOML is the contract)."""
     if is_dataclass(obj):
         return {f.name: unpack(getattr(obj, f.name)) for f in fields(obj)}
+    if isinstance(obj, dict):
+        return {k: unpack(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [unpack(v) for v in obj]
     if isinstance(obj, np.ndarray):

@@ -1,0 +1,13 @@
+"""Listener mode: post-processing server over stdin/stdout (placeholder).
+
+Counterpart of `listener::run` (`/root/reference/src/core/listener.cpp:86-136`).
+Implemented with streamlines/velocity-field support in a follow-up; the CLI
+flag is wired already.
+"""
+
+from __future__ import annotations
+
+
+def serve(config_file: str) -> None:
+    raise NotImplementedError(
+        "listener mode lands with the post-processing subsystem")

@@ -1,0 +1,76 @@
+"""Precompute console: periphery + body quadrature/operator npz generation.
+
+Counterpart of the reference's `skelly_precompute` entry point
+(`/root/reference/src/skelly_sim/precompute.py:17-280`): reads the TOML config,
+builds every body's quadrature npz and the periphery's dense operator npz, and
+— for surface-of-revolution peripheries — rewrites the config with the actual
+node count chosen by the envelope discretization.
+
+Usage: python -m skellysim_tpu.precompute [skelly_config.toml]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from .config import schema
+from .periphery.precompute import precompute_body, precompute_periphery
+
+
+def precompute_from_config(config_file: str, verbose: bool = True) -> None:
+    config = schema.load_config(config_file)
+    config_dir = os.path.dirname(os.path.abspath(config_file)) or "."
+
+    done: set[str] = set()
+    for body in config.bodies:
+        path = os.path.join(config_dir, body.precompute_file)
+        if path in done:
+            continue
+        if verbose:
+            print(f"Precomputing body ({body.shape}, n={body.n_nodes}) "
+                  f"-> {body.precompute_file}")
+        a, b, c = body.axis_length
+        data = precompute_body(body.shape.lower(), body.n_nodes,
+                               radius=body.radius, a=a, b=b, c=c)
+        np.savez(path, **data)
+        done.add(path)
+
+    periphery = getattr(config, "periphery", None)
+    if periphery is not None:
+        kw: dict = {"eta": config.params.eta}
+        if periphery.shape == "sphere":
+            kw["radius"] = periphery.radius
+        elif periphery.shape == "ellipsoid":
+            kw.update(a=periphery.a, b=periphery.b, c=periphery.c)
+        elif periphery.shape == "surface_of_revolution":
+            kw["envelope"] = dict(periphery.envelope)
+        if verbose:
+            print(f"Precomputing periphery ({periphery.shape}, "
+                  f"n={periphery.n_nodes}) -> {periphery.precompute_file}")
+        data = precompute_periphery(periphery.shape, periphery.n_nodes, **kw)
+        np.savez(os.path.join(config_dir, periphery.precompute_file), **data)
+
+        n_actual = data["nodes"].shape[0]
+        if n_actual != periphery.n_nodes:
+            # the envelope discretization picks the real node count; write it
+            # back so the runtime sees consistent sizes (`precompute.py:270-280`)
+            if verbose:
+                print(f"Updating config n_nodes: {periphery.n_nodes} -> {n_actual}")
+            periphery.n_nodes = n_actual
+            config.save(config_file)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="skellysim-tpu-precompute",
+        description="Generate periphery/body precompute npz files for a config")
+    ap.add_argument("config_file", nargs="?", default="skelly_config.toml")
+    args = ap.parse_args(argv)
+    precompute_from_config(args.config_file)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,7 +38,10 @@ def _grow_capacity(fibers, new_cap: int):
             # replicate slot 0 instead of zero-filling: a zero-length/zero-x
             # fiber makes the cache derivatives inf/NaN, and 0-weight * NaN
             # leaks NaN through the stokeslet sum even for inactive slots
-            fill = np.repeat(leaf[:1], pad, axis=0)
+            if nf == 0:
+                fill = np.zeros((pad,) + leaf.shape[1:], dtype=leaf.dtype)
+            else:
+                fill = np.repeat(leaf[:1], pad, axis=0)
             return np.concatenate([leaf, fill], axis=0)
         return leaf
 

@@ -1,0 +1,155 @@
+"""End-to-end pipeline: gen_config -> precompute -> run -> read trajectory.
+
+Mirrors the reference's 4-stage combined tests
+(`/root/reference/tests/combined/`, `src/skelly_sim/testing.py:18-33`), driven
+in-process through the builder/CLI instead of a subprocess binary.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from skellysim_tpu import builder, cli, precompute
+from skellysim_tpu.config import (Body, Config, ConfigSpherical, Fiber, Point,
+                                  BackgroundSource)
+from skellysim_tpu.io.trajectory import TrajectoryReader
+
+
+def _free_fiber_config(tmp_path, n_nodes=16):
+    cfg = Config()
+    cfg.params.eta = 1.0
+    cfg.params.dt_initial = 0.005
+    cfg.params.dt_write = 0.005
+    cfg.params.t_final = 0.02
+    cfg.params.gmres_tol = 1e-10
+    cfg.params.adaptive_timestep_flag = False
+    fib = Fiber(n_nodes=n_nodes, length=1.0, bending_rigidity=0.01)
+    fib.fill_node_positions(np.zeros(3), np.array([0.0, 0.0, 1.0]))
+    cfg.fibers = [fib]
+    cfg.background = BackgroundSource(uniform=[1.0, 0.0, 0.0])
+    path = str(tmp_path / "skelly_config.toml")
+    cfg.save(path)
+    return path
+
+
+def test_cli_run_free_fiber_uniform_background(tmp_path):
+    """Fiber advected by uniform background: x advances by u*t (the reference's
+    `test_fiber_uniform_background.py` oracle)."""
+    cfg_path = _free_fiber_config(tmp_path)
+    cli.run(cfg_path)
+
+    traj = str(tmp_path / "skelly_sim.out")
+    assert os.path.exists(traj)
+    assert os.path.exists(str(tmp_path / "skelly_sim.initial_config"))
+    assert os.path.exists(str(tmp_path / "skelly_sim.final_config"))
+
+    r = TrajectoryReader(traj)
+    assert len(r) >= 2
+    first, last = r.load_frame(0), r.load_frame(len(r) - 1)
+    t_el = last["time"] - first["time"]
+    x0 = np.asarray(first["fibers"][1][0]["x_"])
+    x1 = np.asarray(last["fibers"][1][0]["x_"])
+    drift = (x1 - x0).reshape(-1, 3)
+    np.testing.assert_allclose(drift[:, 0], t_el, atol=1e-10)
+    np.testing.assert_allclose(drift[:, 1:], 0.0, atol=1e-10)
+    r.close()
+
+
+def test_cli_guards(tmp_path):
+    cfg_path = _free_fiber_config(tmp_path)
+    cli.run(cfg_path)
+    with pytest.raises(SystemExit, match="refusing"):
+        cli.run(cfg_path)
+    with pytest.raises(SystemExit, match="does not exist"):
+        cli.run(str(tmp_path / "skelly_config.toml"),
+                trajectory_path=str(tmp_path / "nope.out"), resume=True)
+
+
+def test_cli_resume_continues(tmp_path):
+    cfg_path = _free_fiber_config(tmp_path)
+    cli.run(cfg_path)
+    traj = str(tmp_path / "skelly_sim.out")
+    r = TrajectoryReader(traj)
+    t_end1 = r.load_frame(len(r) - 1)["time"]
+    n1 = len(r)
+    r.close()
+
+    # extend t_final and resume
+    from skellysim_tpu.config import load_config
+    cfg = load_config(cfg_path)
+    cfg.params.t_final = 0.04
+    cfg.save(cfg_path)
+    cli.run(cfg_path, resume=True)
+
+    r = TrajectoryReader(traj)
+    assert len(r) > n1
+    t_end2 = r.load_frame(len(r) - 1)["time"]
+    assert t_end2 > t_end1
+    assert t_end2 == pytest.approx(0.04, abs=0.006)
+    r.close()
+
+
+def test_precompute_and_body_drag_pipeline(tmp_path):
+    """Config with a sphere body under constant force inside no periphery:
+    velocity matches Stokes drag 6*pi*eta*R*v (reference
+    `test_body_const_force.py`, 1e-6 gate relaxed to quadrature accuracy)."""
+    cfg = Config()
+    cfg.params.eta = 1.3
+    cfg.params.dt_initial = 0.005
+    cfg.params.dt_write = 0.005
+    cfg.params.t_final = 0.01
+    cfg.params.adaptive_timestep_flag = False
+    cfg.params.gmres_tol = 1e-10
+    body = Body(radius=0.6, n_nodes=600, external_force=[0.0, 0.0, 1.0],
+                precompute_file="body.npz")
+    cfg.bodies = [body]
+    cfg_path = str(tmp_path / "skelly_config.toml")
+    cfg.save(cfg_path)
+
+    precompute.precompute_from_config(cfg_path, verbose=False)
+    assert os.path.exists(str(tmp_path / "body.npz"))
+
+    system, state, rng = builder.build_simulation(cfg_path)
+    new_state, solution, info = system.step(state)
+    assert bool(info.converged)
+    v = np.asarray(new_state.bodies.velocity)[0]
+    # hydrodynamic radius is the quadrature-node radius (0.6 - 0.1)
+    expected = 1.0 / (6 * np.pi * 1.3 * 0.5)
+    assert abs(v[2] - expected) / expected < 2e-3
+    np.testing.assert_allclose(v[:2], 0.0, atol=1e-8)
+
+
+def test_precompute_spherical_periphery_pipeline(tmp_path):
+    """Point force inside a spherical shell: rigid-wall flow at the shell is
+    cancelled (shell solve converges and density is finite)."""
+    cfg = ConfigSpherical()
+    cfg.params.eta = 1.0
+    cfg.params.dt_initial = 0.01
+    cfg.params.t_final = 0.01
+    cfg.params.adaptive_timestep_flag = False
+    cfg.periphery.radius = 2.0
+    cfg.periphery.n_nodes = 300
+    cfg.periphery.precompute_file = "periphery.npz"
+    cfg.point_sources = [Point(position=[0.0, 0.0, 0.5], force=[0.0, 0.0, 1.0])]
+    fib = Fiber(n_nodes=16, length=0.5, bending_rigidity=0.01)
+    fib.fill_node_positions(np.array([0.5, 0.0, 0.0]), np.array([0.0, 0.0, 1.0]))
+    cfg.fibers = [fib]
+    cfg_path = str(tmp_path / "skelly_config.toml")
+    cfg.save(cfg_path)
+
+    precompute.precompute_from_config(cfg_path, verbose=False)
+    system, state, rng = builder.build_simulation(cfg_path)
+    new_state, solution, info = system.step(state)
+    assert bool(info.converged)
+    assert np.all(np.isfinite(np.asarray(new_state.shell.density)))
+    assert np.all(np.isfinite(np.asarray(new_state.fibers.x)))
+
+
+def test_builder_rejects_mixed_resolution(tmp_path):
+    cfg = Config()
+    f1 = Fiber(n_nodes=16); f1.fill_node_positions(np.zeros(3), np.array([0, 0, 1.0]))
+    f2 = Fiber(n_nodes=32); f2.fill_node_positions(np.ones(3), np.array([0, 0, 1.0]))
+    cfg.fibers = [f1, f2]
+    with pytest.raises(ValueError, match="share n_nodes"):
+        builder.build_fibers(cfg.fibers, np.float64)

@@ -64,6 +64,25 @@ def test_validation_rejects_numpy_and_unknown(tmp_path):
         cfg2.save(str(tmp_path / "bad2.toml"))
 
 
+def test_envelope_numpy_scalars_round_trip(tmp_path):
+    """Regression: numpy scalars inside dict fields (periphery.envelope) used
+    to bypass unpack() and emit invalid TOML like `T = np.float64(0.72)`."""
+    cfg = ConfigRevolution()
+    cfg.periphery.envelope = {
+        "n_nodes_target": np.int64(400), "lower_bound": np.float64(-3.75),
+        "upper_bound": 3.75, "height": "0.72 * (1 - (x/3.75)**2) * 3.75",
+    }
+    path = tmp_path / "rev.toml"
+    cfg.save(str(path))
+    back = load_config(str(path))
+    assert back.periphery.envelope["n_nodes_target"] == 400
+    assert back.periphery.envelope["lower_bound"] == -3.75
+
+    cfg.periphery.envelope = {"bad": object()}
+    with pytest.raises(ValueError, match="unsupported type"):
+        cfg.save(str(path))
+
+
 def test_fill_node_positions_straight_line():
     fib = Fiber(n_nodes=8, length=2.0)
     fib.fill_node_positions(np.array([1.0, 0, 0]), np.array([0, 0, 1.0]))
