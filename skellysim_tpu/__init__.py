@@ -14,6 +14,20 @@ scaling uses jax.sharding.Mesh + shard_map with ICI collectives instead
 of MPI.
 """
 
+import os as _os
+
+import jax as _jax
+
 __version__ = "0.1.0"
 
 TRAJECTORY_VERSION = 1
+
+# MXU precision policy. TPU float32 matmuls default to single-pass bfloat16,
+# which loses ~5 decimal digits in every contraction: the GMRES operator then
+# converges (self-consistently) to the solution of a 1e-2-perturbed system and
+# e.g. a force-free fiber radiates O(0.1) spurious far-field flow. Every
+# contraction in the implicit solve path therefore runs at HIGHEST precision
+# by default (6-pass bf16 on MXU ~= true f32). Override per-process with
+# SKELLYSIM_MATMUL_PRECISION={default,high,highest} for perf experiments.
+_jax.config.update("jax_default_matmul_precision",
+                   _os.environ.get("SKELLYSIM_MATMUL_PRECISION", "highest"))
