@@ -74,10 +74,20 @@ def build_bodies(cfg_bodies: list, config_dir: str, dtype) -> bd.BodyGroup | Non
     ns = site_counts.pop()
 
     def sites_ref(b):
-        # config nucleation sites are lab-frame at t=0 with identity-ish
-        # orientation; store body-frame (relative to center)
+        # config nucleation sites are lab-frame at t=0; body-frame storage must
+        # undo the configured orientation (lab = pos + R(q) @ ref,
+        # `body_spherical.cpp:158`), so ref = R(q)^T @ (lab - pos)
+        from .utils import quaternion as quat
+
         s = np.asarray(b.nucleation_sites, dtype=float).reshape(ns, 3)
-        return s - np.asarray(b.position)
+        R = np.asarray(quat.rotation_matrix(np.asarray(b.orientation, dtype=float)))
+        return (s - np.asarray(b.position)) @ R  # (R^T @ d^T)^T = d @ R
+
+    shapes = {b.shape for b in cfg_bodies}
+    if len(shapes) != 1:
+        # a mixed batch would silently demote spheres to kind="generic" and
+        # lose their shell-collision handling; refuse until per-kind batching
+        raise ValueError(f"all bodies must share one shape (got {sorted(shapes)})")
 
     ext_type = [bd.EXTFORCE_OSCILLATORY if b.external_force_type == "Oscillatory"
                 else bd.EXTFORCE_LINEAR for b in cfg_bodies]
@@ -98,7 +108,7 @@ def build_bodies(cfg_bodies: list, config_dir: str, dtype) -> bd.BodyGroup | Non
         osc_phase=np.array([b.external_oscillation_force_phase
                             for b in cfg_bodies]),
         radius=np.array([b.radius for b in cfg_bodies]),
-        kind="sphere" if all(b.shape == "sphere" for b in cfg_bodies) else "generic",
+        kind="sphere" if shapes == {"sphere"} else "generic",
         dtype=dtype)
 
 

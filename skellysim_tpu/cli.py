@@ -21,6 +21,16 @@ from .utils.rng import SimRNG
 TRAJECTORY_FILE = "skelly_sim.out"
 
 
+def _snapshot_path(traj: str, suffix: str) -> str:
+    """Sibling snapshot path: 'skelly_sim.out' -> 'skelly_sim.<suffix>'.
+
+    A trajectory path without the '.out' extension gets the suffix appended,
+    never substituted — a naive str.replace could alias the trajectory itself.
+    """
+    base, ext = os.path.splitext(traj)
+    return (base if ext == ".out" else traj) + "." + suffix
+
+
 def run(config_file: str, resume: bool = False, overwrite: bool = False,
         trajectory_path: str | None = None) -> None:
     traj = trajectory_path or os.path.join(
@@ -45,13 +55,13 @@ def run(config_file: str, resume: bool = False, overwrite: bool = False,
     else:
         writer = TrajectoryWriter(traj)
         # initial config snapshot (`system.cpp:716`, `skelly_sim.initial_config`)
-        shutil.copyfile(config_file, traj.replace(".out", ".initial_config"))
+        shutil.copyfile(config_file, _snapshot_path(traj, "initial_config"))
         writer.write_frame(state, rng_state=rng.dump_state())
 
     with writer:
         final = system.run(state, writer=writer.write_frame, rng=rng)
 
-    shutil.copyfile(config_file, traj.replace(".out", ".final_config"))
+    shutil.copyfile(config_file, _snapshot_path(traj, "final_config"))
     print(f"Finished at t={float(final.time):.6g}")
 
 

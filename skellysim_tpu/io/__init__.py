@@ -1,2 +1,4 @@
 from .trajectory import (TrajectoryReader, TrajectoryWriter, frame_to_state,
                          resume_state)
+from .listener_client import (Listener, Request, StreamlinesRequest,
+                              VelocityFieldRequest)

@@ -1,0 +1,110 @@
+"""Client for the listener-mode post-processing server.
+
+Mirror of the reference Python toolkit's `Listener` / `Request` dataclasses
+(`/root/reference/src/skelly_sim/reader.py:64-194`): spawns the simulator in
+``--listen`` mode and exchanges length-prefixed msgpack messages over
+stdin/stdout. The wire schema is identical, so this client also drives the
+reference binary (and the reference client drives our server).
+"""
+
+from __future__ import annotations
+
+import struct
+import subprocess
+import sys
+from dataclasses import asdict, dataclass, field
+
+import msgpack
+import numpy as np
+
+from . import eigen
+
+
+def _default_seeds() -> np.ndarray:
+    return np.zeros((0, 3), dtype=np.float64)
+
+
+@dataclass
+class StreamlinesRequest:
+    """Streamline batch request (`reader.py:65-89` field set)."""
+
+    dt_init: float = 0.1
+    t_final: float = 1.0
+    abs_err: float = 1e-10
+    rel_err: float = 1e-6
+    back_integrate: bool = True
+    x0: np.ndarray = field(default_factory=_default_seeds)
+
+
+@dataclass
+class VelocityFieldRequest:
+    x: np.ndarray = field(default_factory=_default_seeds)
+
+
+@dataclass
+class Request:
+    frame_no: int = 0
+    evaluator: str = "CPU"
+    streamlines: StreamlinesRequest = field(default_factory=StreamlinesRequest)
+    vortexlines: StreamlinesRequest = field(default_factory=StreamlinesRequest)
+    velocity_field: VelocityFieldRequest = field(
+        default_factory=VelocityFieldRequest)
+
+
+def _ndencode(obj):
+    if isinstance(obj, np.ndarray):
+        return eigen.pack_matrix(obj)
+    return obj
+
+
+class Listener:
+    """Drives a ``--listen`` server subprocess for on-the-fly analysis."""
+
+    def __init__(self, toml_file: str = "skelly_config.toml",
+                 binary: list[str] | None = None):
+        cmd = binary or [sys.executable, "-m", "skellysim_tpu", "--listen",
+                         f"--config-file={toml_file}"]
+        self._proc = subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                                      stdout=subprocess.PIPE)
+
+    def request(self, command: Request) -> dict | None:
+        """Send one request; returns the decoded response dict (or None for an
+        invalid frame)."""
+        msg = msgpack.packb(asdict(command), default=_ndencode)
+        self._proc.stdin.write(struct.pack("<Q", len(msg)))
+        self._proc.stdin.write(msg)
+        self._proc.stdin.flush()
+        hdr = self._proc.stdout.read(8)
+        if len(hdr) < 8:
+            raise RuntimeError("listener server closed unexpectedly")
+        (ressize,) = struct.unpack("<Q", hdr)
+        if ressize == 0:
+            return None
+        payload = b""
+        while len(payload) < ressize:
+            chunk = self._proc.stdout.read(ressize - len(payload))
+            if not chunk:
+                raise RuntimeError("listener server closed mid-response")
+            payload += chunk
+        return eigen.decode_tree(msgpack.unpackb(payload, raw=False))
+
+    def close(self):
+        if self._proc.poll() is None:
+            try:
+                self._proc.stdin.write(struct.pack("<Q", 0))
+                self._proc.stdin.flush()
+                self._proc.wait(timeout=10)
+            except (BrokenPipeError, subprocess.TimeoutExpired):
+                self._proc.terminate()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,13 +1,148 @@
-"""Listener mode: post-processing server over stdin/stdout (placeholder).
+"""Listener mode: post-processing server over stdin/stdout.
 
-Counterpart of `listener::run` (`/root/reference/src/core/listener.cpp:86-136`).
-Implemented with streamlines/velocity-field support in a follow-up; the CLI
-flag is wired already.
+Counterpart of `listener::run` (`/root/reference/src/core/listener.cpp:86-136`):
+length-prefixed (little-endian u64) msgpack requests
+{frame_no, evaluator, streamlines, vortexlines, velocity_field} arrive on
+stdin; the requested trajectory frame is loaded, streamlines / vortex lines /
+velocity fields are computed from it, and a msgpack response
+{time, i_frame, n_frames, streamlines, vortexlines, velocity_field} is written
+to stdout. A zero-length message terminates the server.
+
+The `evaluator` field selected CPU/GPU/FMM backends in the reference
+(`listener.cpp:117`); here there is a single XLA backend, so it is accepted
+and ignored. An invalid frame_no answers with a zero-length response like the
+reference (`listener.cpp:111-116`).
 """
 
 from __future__ import annotations
 
+import struct
+import sys
 
-def serve(config_file: str) -> None:
-    raise NotImplementedError(
-        "listener mode lands with the post-processing subsystem")
+import msgpack
+import numpy as np
+
+from .builder import build_simulation
+from .io import eigen
+from .io.trajectory import TrajectoryReader, frame_to_state
+from .postprocess import streamlines as compute_streamlines
+from .postprocess import vortex_lines as compute_vortex_lines
+from .system.system import solution_from_state
+
+_LINE_DEFAULTS = dict(dt_init=0.1, t_final=1.0, abs_err=1e-10, rel_err=1e-6,
+                      back_integrate=True)
+
+
+def _line_kwargs(req: dict) -> dict:
+    kw = dict(_LINE_DEFAULTS)
+    for k in kw:
+        if req and k in req:
+            kw[k] = req[k]
+    return kw
+
+
+def _seeds(req: dict) -> np.ndarray:
+    x0 = req.get("x0") if req else None
+    if x0 is None:
+        return np.zeros((0, 3))
+    return np.atleast_2d(np.asarray(x0, dtype=np.float64))
+
+
+def _pack_lines(lines: list) -> list:
+    return [{"x": eigen.pack_matrix(ln["x"]), "val": eigen.pack_matrix(ln["val"]),
+             "time": eigen.pack_matrix(ln["time"])} for ln in lines]
+
+
+def process_request(system, template_state, reader: TrajectoryReader,
+                    cmd: dict, vel_fn=None) -> dict | None:
+    """One request → response dict, or None for an invalid frame.
+
+    ``vel_fn(pts, state, solution)`` must be a *stable* function across
+    requests (created once per server); per-frame state/solution flow through
+    `field_args` so the compiled streamline integrator is reused instead of
+    retraced on every request.
+    """
+    frame_no = int(cmd.get("frame_no", 0))
+    if frame_no < 0 or frame_no >= len(reader):
+        return None
+    frame = reader.load_frame(frame_no)
+    state = frame_to_state(frame, template_state)
+    solution = solution_from_state(state)
+
+    if vel_fn is None:
+        def vel_fn(pts, state, solution):
+            return system._velocity_at_targets_impl(state, solution, pts)
+
+    sl_req = cmd.get("streamlines") or {}
+    vl_req = cmd.get("vortexlines") or {}
+    vf_req = cmd.get("velocity_field") or {}
+
+    sl = compute_streamlines(vel_fn, _seeds(sl_req), **_line_kwargs(sl_req),
+                             field_args=(state, solution))
+    vl = compute_vortex_lines(vel_fn, _seeds(vl_req), **_line_kwargs(vl_req),
+                              field_args=(state, solution))
+
+    vf_x = vf_req.get("x")
+    if vf_x is not None and np.asarray(vf_x).size:
+        vf = np.asarray(system.velocity_at_targets(state, solution,
+                                                   np.atleast_2d(vf_x)))
+    else:
+        vf = np.zeros((0, 3))
+
+    return {
+        "time": frame["time"],
+        "i_frame": frame_no,
+        "n_frames": len(reader),
+        "streamlines": _pack_lines(sl),
+        "vortexlines": _pack_lines(vl),
+        "velocity_field": eigen.pack_matrix(vf),
+    }
+
+
+def serve(config_file: str = "skelly_config.toml",
+          trajectory_file: str | None = None,
+          stdin=None, stdout=None) -> None:
+    import os
+
+    stdin = stdin if stdin is not None else sys.stdin.buffer
+    stdout = stdout if stdout is not None else sys.stdout.buffer
+    traj = trajectory_file or os.path.join(
+        os.path.dirname(os.path.abspath(config_file)) or ".", "skelly_sim.out")
+
+    system, template_state, _ = build_simulation(config_file)
+    reader = TrajectoryReader(traj)
+    print(f"Entering listener mode ({len(reader)} frames)", file=sys.stderr)
+
+    # one velocity-field function for the server's lifetime: its identity keys
+    # the streamline integrator's jit cache, so frames swap via field_args
+    # without recompiling
+    def vel_fn(pts, state, solution):
+        return system._velocity_at_targets_impl(state, solution, pts)
+
+    while True:
+        hdr = stdin.read(8)
+        if len(hdr) < 8:
+            return
+        (msgsize,) = struct.unpack("<Q", hdr)
+        if msgsize == 0:
+            print("Terminate message received. Exiting listener mode",
+                  file=sys.stderr)
+            return
+        payload = b""
+        while len(payload) < msgsize:
+            chunk = stdin.read(msgsize - len(payload))
+            if not chunk:
+                return
+            payload += chunk
+        cmd = eigen.decode_tree(msgpack.unpackb(payload, raw=False))
+
+        response = process_request(system, template_state, reader, cmd,
+                                   vel_fn=vel_fn)
+        if response is None:
+            stdout.write(struct.pack("<Q", 0))
+            stdout.flush()
+            continue
+        buf = msgpack.packb(response)
+        stdout.write(struct.pack("<Q", len(buf)))
+        stdout.write(buf)
+        stdout.flush()

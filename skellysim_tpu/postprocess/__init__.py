@@ -1,0 +1,11 @@
+"""Post-processing: velocity fields, streamlines, vortex lines.
+
+Counterpart of the reference's listener-mode analysis stack
+(`/root/reference/src/core/streamline.cpp`, `listener.cpp`), redesigned for
+TPU: all line seeds integrate simultaneously as one batched adaptive RK
+program instead of one odeint call per line.
+"""
+
+from .streamline import streamlines, vortex_lines, make_vorticity_fn
+
+__all__ = ["streamlines", "vortex_lines", "make_vorticity_fn"]

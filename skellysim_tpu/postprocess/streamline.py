@@ -1,0 +1,236 @@
+"""Batched adaptive streamline / vortex-line integration.
+
+TPU-native replacement for the reference's per-line Boost.odeint RK Cash-Karp
+5(4) loops (`/root/reference/src/core/streamline.cpp:67-165`): all K seed
+points advance together under one `lax.while_loop`, so every integrator stage
+is a single batched velocity-field evaluation (one kernel launch over K
+targets) instead of K sequential 1-point evaluations. Per-line adaptive step
+control, early termination (t_final reached, buffer full, or singularity
+bailout at ||v|| > 1e3, `streamline.cpp:51-53`) is carried as a done-mask.
+
+Error control mirrors Boost's `controlled_runge_kutta` +
+`default_error_checker` (a_x = a_dxdt = 1): per-component tolerance
+abs_err + rel_err*(|x| + dt*|dxdt|), max-norm acceptance at 1, step shrink
+0.9*err^(-1/3) floored at 0.2, growth 0.9*err^(-1/5) capped at 5 when
+err < 0.5.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Cash-Karp 5(4) tableau
+_A = (
+    (1 / 5,),
+    (3 / 40, 9 / 40),
+    (3 / 10, -9 / 10, 6 / 5),
+    (-11 / 54, 5 / 2, -70 / 27, 35 / 27),
+    (1631 / 55296, 175 / 512, 575 / 13824, 44275 / 110592, 253 / 4096),
+)
+_B5 = (37 / 378, 0.0, 250 / 621, 125 / 594, 0.0, 512 / 1771)
+_B4 = (2825 / 27648, 0.0, 18575 / 48384, 13525 / 55296, 277 / 14336, 1 / 4)
+
+_SINGULAR_SPEED = 1e3  # `streamline.cpp:51`
+
+
+class _LineBatch(NamedTuple):
+    """Raw padded integration output for K lines."""
+
+    x: jnp.ndarray      # [K, S, 3]
+    time: jnp.ndarray   # [K, S]
+    count: jnp.ndarray  # [K] valid samples per line
+
+
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("max_steps",))
+def _integrate_batch(field_fn: Callable, speed_fn: Callable | None, x0, dt_init,
+                     t_final, abs_err, rel_err, sign, max_steps: int,
+                     field_args=()):
+    """Integrate dx/ds = sign*field(x, *field_args) for s in [0, t_final], all
+    lines at once.
+
+    ``field_args`` are traced operands threaded to ``field_fn``/``speed_fn``:
+    callers with per-frame data (the listener) pass it here so the compiled
+    executable is reused across frames instead of retracing per closure.
+    ``speed_fn`` (the singularity bailout field) may be None when it equals
+    ``field_fn``; the k1 stage evaluation is reused then.
+
+    Recorded times are sign*s, matching the reference's backward integration
+    from 0 to -t_final (`streamline.cpp:84`).
+    """
+    K = x0.shape[0]
+    S = max_steps
+    dtype = x0.dtype
+    ks = jnp.arange(K)
+
+    buf_x = jnp.zeros((K, S, 3), dtype=dtype).at[:, 0].set(x0)
+    buf_t = jnp.zeros((K, S), dtype=dtype)
+    count = jnp.ones((K,), dtype=jnp.int32)
+    t = jnp.zeros((K,), dtype=dtype)
+    dt = jnp.full((K,), dt_init, dtype=dtype)
+    done = jnp.zeros((K,), dtype=bool) | (t_final <= 0.0)
+
+    def cond(carry):
+        x, t, dt, bufs, counts, done, it = carry
+        return (~done).any() & (it < 8 * S)
+
+    def body(carry):
+        x, t, dt, (buf_x, buf_t), count, done, it = carry
+
+        dt_use = jnp.minimum(dt, t_final - t)
+
+        def f(xx):
+            return sign * field_fn(xx, *field_args)
+
+        k1 = f(x)
+
+        # singularity bailout: the previous point was recorded; if the field
+        # speed there explodes, the line ends (observer-throw semantics,
+        # `streamline.cpp:51-53`). For streamlines the speed field IS the
+        # integrated field, so |k1| is reused (sign does not change the norm).
+        if speed_fn is None:
+            speed = jnp.linalg.norm(k1, axis=-1)
+        else:
+            speed = jnp.linalg.norm(speed_fn(x, *field_args), axis=-1)
+        done = done | (speed > _SINGULAR_SPEED)
+        k2 = f(x + dt_use[:, None] * (_A[0][0] * k1))
+        k3 = f(x + dt_use[:, None] * (_A[1][0] * k1 + _A[1][1] * k2))
+        k4 = f(x + dt_use[:, None] * (_A[2][0] * k1 + _A[2][1] * k2
+                                      + _A[2][2] * k3))
+        k5 = f(x + dt_use[:, None] * (_A[3][0] * k1 + _A[3][1] * k2
+                                      + _A[3][2] * k3 + _A[3][3] * k4))
+        k6 = f(x + dt_use[:, None] * (_A[4][0] * k1 + _A[4][1] * k2
+                                      + _A[4][2] * k3 + _A[4][3] * k4
+                                      + _A[4][4] * k5))
+        stages = (k1, k2, k3, k4, k5, k6)
+        dx5 = sum(b * k for b, k in zip(_B5, stages))
+        dx4 = sum(b * k for b, k in zip(_B4, stages))
+        x5 = x + dt_use[:, None] * dx5
+
+        tol = abs_err + rel_err * (jnp.abs(x) + dt_use[:, None] * jnp.abs(k1))
+        err = jnp.max(dt_use[:, None] * jnp.abs(dx5 - dx4) / tol, axis=-1)
+        err = jnp.maximum(err, 1e-30)
+
+        accept = (err <= 1.0) & ~done
+        new_x = jnp.where(accept[:, None], x5, x)
+        new_t = jnp.where(accept, t + dt_use, t)
+
+        write = accept & (count < S)
+        idx = jnp.clip(count, 0, S - 1)
+        buf_x = buf_x.at[ks, idx].set(
+            jnp.where(write[:, None], new_x, buf_x[ks, idx]))
+        buf_t = buf_t.at[ks, idx].set(
+            jnp.where(write, sign * new_t, buf_t[ks, idx]))
+        count = count + write.astype(jnp.int32)
+
+        fac_dec = jnp.maximum(0.9 * err ** (-1 / 3), 0.2)
+        fac_inc = jnp.minimum(0.9 * err ** (-1 / 5), 5.0)
+        dt = jnp.where(err > 1.0, dt_use * fac_dec,
+                       jnp.where(err < 0.5, dt_use * fac_inc, dt_use))
+
+        eps_t = jnp.asarray(1e-14, dtype) * jnp.maximum(1.0, jnp.abs(t_final))
+        done = done | (new_t >= t_final - eps_t) | (count >= S)
+        return new_x, new_t, dt, (buf_x, buf_t), count, done, it + 1
+
+    carry = (x0, t, dt, (buf_x, buf_t), count, done, jnp.asarray(0, jnp.int32))
+    _, _, _, (buf_x, buf_t), count, _, _ = jax.lax.while_loop(cond, body, carry)
+    return _LineBatch(x=buf_x, time=buf_t, count=count)
+
+
+@lru_cache(maxsize=64)
+def make_vorticity_fn(vel_fn: Callable, eps: float | None = None) -> Callable:
+    """Curl of the velocity field via 6-point central differences
+    (`get_vorticity_at_point`, `streamline.cpp:16-35`). Batched: one velocity
+    evaluation over 6K points per call. Extra args pass through to vel_fn.
+
+    Cached on (vel_fn, eps) so repeated `vortex_lines` calls hand the jit
+    layer a stable function identity (no retrace per call)."""
+
+    def vort(x, *args):
+        x = jnp.atleast_2d(x)
+        e = eps if eps is not None else (1e-7 if x.dtype == jnp.float64 else 1e-3)
+        K = x.shape[0]
+        offs = jnp.array([[1, 0, 0], [-1, 0, 0], [0, 1, 0],
+                          [0, -1, 0], [0, 0, 1], [0, 0, -1]], dtype=x.dtype) * e
+        pts = (x[:, None, :] + offs[None, :, :]).reshape(-1, 3)
+        v = vel_fn(pts, *args).reshape(K, 6, 3)
+        return (0.5 / e) * jnp.stack([
+            (v[:, 2, 2] - v[:, 3, 2]) - (v[:, 4, 1] - v[:, 5, 1]),
+            (v[:, 4, 0] - v[:, 5, 0]) - (v[:, 0, 2] - v[:, 1, 2]),
+            (v[:, 0, 1] - v[:, 1, 1]) - (v[:, 2, 0] - v[:, 3, 0]),
+        ], axis=-1)
+
+    return vort
+
+
+def _assemble(field_fn, speed_fn, x0, dt_init, t_final, abs_err, rel_err,
+              back_integrate, max_steps, val_fn, field_args=()):
+    """Run forward (+ optional backward) passes and join per line on host."""
+    x0 = jnp.atleast_2d(jnp.asarray(x0))
+    if x0.size == 0:
+        return []
+    fwd = _integrate_batch(field_fn, speed_fn, x0, dt_init, t_final,
+                           abs_err, rel_err, 1.0, max_steps=max_steps,
+                           field_args=field_args)
+    fwd_val = val_fn(fwd.x.reshape(-1, 3), *field_args).reshape(fwd.x.shape)
+    parts = [(np.asarray(fwd.x), np.asarray(fwd.time),
+              np.asarray(fwd_val), np.asarray(fwd.count))]
+    if back_integrate:
+        bwd = _integrate_batch(field_fn, speed_fn, x0, dt_init, t_final,
+                               abs_err, rel_err, -1.0, max_steps=max_steps,
+                               field_args=field_args)
+        bwd_val = val_fn(bwd.x.reshape(-1, 3), *field_args).reshape(bwd.x.shape)
+        parts.insert(0, (np.asarray(bwd.x), np.asarray(bwd.time),
+                         np.asarray(bwd_val), np.asarray(bwd.count)))
+
+    lines = []
+    for i in range(x0.shape[0]):
+        if back_integrate:
+            (bx, bt, bv, bc), (fx, ft, fv, fc) = parts
+            nb, nf = int(bc[i]), int(fc[i])
+            # reversed backward leg minus its seed + full forward leg
+            # (`join_back_and_forward`, `streamline.cpp:56-65`)
+            x = np.concatenate([bx[i, :nb][::-1][:-1], fx[i, :nf]])
+            tm = np.concatenate([bt[i, :nb][::-1][:-1], ft[i, :nf]])
+            val = np.concatenate([bv[i, :nb][::-1][:-1], fv[i, :nf]])
+        else:
+            fx, ft, fv, fc = parts[0]
+            nf = int(fc[i])
+            x, tm, val = fx[i, :nf], ft[i, :nf], fv[i, :nf]
+        lines.append({"x": x, "val": val, "time": tm})
+    return lines
+
+
+def streamlines(vel_fn: Callable, x0, *, dt_init: float = 0.1,
+                t_final: float = 1.0, abs_err: float = 1e-10,
+                rel_err: float = 1e-6, back_integrate: bool = True,
+                max_steps: int = 512, field_args=()):
+    """Trace velocity-field streamlines from [K, 3] seeds.
+
+    ``vel_fn(pts, *field_args)`` is the velocity field; keep ``vel_fn`` a
+    stable function and route per-frame data through ``field_args`` to reuse
+    the compiled integrator. Returns a list of dicts
+    {x: [n,3], val: [n,3], time: [n]} per line, matching the reference
+    `StreamLine` wire fields (`streamline.hpp:29`).
+    """
+    return _assemble(vel_fn, None, x0, dt_init, t_final, abs_err, rel_err,
+                     back_integrate, max_steps, val_fn=vel_fn,
+                     field_args=field_args)
+
+
+def vortex_lines(vel_fn: Callable, x0, *, dt_init: float = 0.1,
+                 t_final: float = 1.0, abs_err: float = 1e-10,
+                 rel_err: float = 1e-6, back_integrate: bool = True,
+                 max_steps: int = 512, eps: float | None = None,
+                 field_args=()):
+    """Trace vorticity field lines; val holds the vorticity along each line
+    (`VortexLine::compute`, `streamline.cpp:115-165`). The singularity bailout
+    tests the *velocity* like the reference's shared observer."""
+    vort_fn = make_vorticity_fn(vel_fn, eps)
+    return _assemble(vort_fn, vel_fn, x0, dt_init, t_final, abs_err, rel_err,
+                     back_integrate, max_steps, val_fn=vort_fn,
+                     field_args=field_args)

@@ -46,6 +46,28 @@ class StepInfo(NamedTuple):
     fiber_error: jnp.ndarray
 
 
+def solution_from_state(state: SimState):
+    """Rebuild the flat solver solution vector from component state.
+
+    Inverse of the post-solve advance: fibers contribute [x|y|z|tension] per
+    fiber, the shell its density, bodies their stored solution — matching the
+    reference's reconstruction on resume (`trajectory_reader.cpp:227-249`).
+    """
+    parts = []
+    if state.fibers is not None:
+        f = state.fibers
+        parts.append(jnp.concatenate(
+            [f.x[:, :, 0], f.x[:, :, 1], f.x[:, :, 2], f.tension],
+            axis=1).reshape(-1))
+    if state.shell is not None:
+        parts.append(state.shell.density)
+    if state.bodies is not None:
+        parts.append(state.bodies.solution.reshape(-1))
+    if not parts:
+        raise ValueError("state has no implicit components")
+    return jnp.concatenate(parts)
+
+
 class System:
     """Holds static config; all dynamics flow through pure jit'd functions."""
 
@@ -54,6 +76,7 @@ class System:
         self.shell_shape = shell_shape
         self._solve_jit = jax.jit(self._solve_impl)
         self._collision_jit = jax.jit(self._check_collision)
+        self._vel_jit = jax.jit(self._velocity_at_targets_impl)
 
     # ------------------------------------------------------------- state setup
 
@@ -334,6 +357,77 @@ class System:
         info = StepInfo(converged=result.converged, iters=result.iters,
                         residual=result.residual, fiber_error=fiber_error)
         return new_state, result.x, info
+
+    # -------------------------------------------------------- velocity field
+
+    def _velocity_at_targets_impl(self, state: SimState, solution, r_trg):
+        """Velocity field at arbitrary targets from a solved state
+        (`velocity_at_targets`, `system.cpp:330-384`).
+
+        Sums fiber flow (forces from the solution, plus steric wall forces when
+        `periphery_interaction_flag` is set), body flow driven by fiber link
+        conditions, shell flow from the solved density, and point/background
+        sources; points inside a rigid body are overridden with the body's
+        rigid motion v + omega x dx.
+        """
+        p = self.params
+        fibers, shell, bodies = state.fibers, state.shell, state.bodies
+        fib_size, shell_size, body_size = self._sizes(state)
+        r_trg = jnp.asarray(r_trg, dtype=solution.dtype).reshape(-1, 3)
+        v = jnp.zeros_like(r_trg)
+
+        caches = (fc.update_cache(fibers, state.dt, p.eta)
+                  if fibers is not None else None)
+        body_caches = (bd.update_cache(bodies, p.eta)
+                       if bodies is not None else None)
+
+        x_fib = None
+        if fibers is not None:
+            nf, n = fibers.n_fibers, fibers.n_nodes
+            x_fib = solution[:fib_size].reshape(nf, 4 * n)
+            f_on_fibers = fc.apply_fiber_force(fibers, caches, x_fib)
+            if p.periphery_interaction_flag and shell is not None:
+                f_on_fibers = f_on_fibers + self._periphery_force_fibers(state)
+            v = v + fc.flow(fibers, caches, r_trg, f_on_fibers, p.eta,
+                            subtract_self=False)
+
+        if bodies is not None:
+            nb = bodies.n_bodies
+            x_bodies = solution[fib_size + shell_size:].reshape(nb, -1)
+            if fibers is not None:
+                # like the reference, only the fiber link forces (not the
+                # external force schedule) drive the body flow here
+                _, body_ft = bd.link_conditions(
+                    bodies, body_caches, fibers, caches, x_fib, x_bodies)
+            else:
+                body_ft = jnp.zeros((nb, 6), dtype=solution.dtype)
+            v = v + bd.flow(bodies, body_caches, r_trg, x_bodies, body_ft, p.eta)
+
+        if shell is not None:
+            v = v + peri.flow(shell, r_trg,
+                              solution[fib_size:fib_size + shell_size], p.eta)
+
+        v = v + self._external_flows(state, r_trg)
+
+        if bodies is not None:
+            # rigid-motion override inside bodies (`system.cpp:364-381`);
+            # spherical containment only applies to sphere-kind bodies —
+            # other kinds keep the computed exterior flow until they get a
+            # proper containment test
+            vel6 = x_bodies[:, -6:]
+            dx = r_trg[:, None, :] - bodies.position[None, :, :]
+            inside = ((jnp.linalg.norm(dx, axis=-1) < bodies.radius[None, :])
+                      & bodies.kind_sphere[None, :])
+            u_rigid = vel6[None, :, :3] + jnp.cross(
+                jnp.broadcast_to(vel6[None, :, 3:], dx.shape), dx)
+            idx = jnp.argmax(inside, axis=1)
+            v = jnp.where(inside.any(axis=1)[:, None],
+                          u_rigid[jnp.arange(r_trg.shape[0]), idx], v)
+        return v
+
+    def velocity_at_targets(self, state: SimState, solution, r_trg):
+        """Jitted velocity field evaluation at [n, 3] targets."""
+        return self._vel_jit(state, solution, r_trg)
 
     def _check_collision(self, state: SimState):
         """Fiber/shell + body collision gate (`check_collision`, `system.cpp:576-595`)."""

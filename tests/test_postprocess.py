@@ -1,0 +1,245 @@
+"""Post-processing: velocity_at_targets, streamlines, vortex lines, listener.
+
+Oracles are closed-form flows: uniform background advection for streamlines,
+rigid rotation (omega x r, curl = 2*omega) for vorticity, and a point force's
+Oseen field for velocity_at_targets consistency.
+"""
+
+import io as _io
+import os
+import struct
+
+import jax.numpy as jnp
+import msgpack
+import numpy as np
+import pytest
+
+from skellysim_tpu import builder, cli
+from skellysim_tpu.io import eigen
+from skellysim_tpu.io.trajectory import TrajectoryReader, frame_to_state
+from skellysim_tpu.postprocess import (make_vorticity_fn, streamlines,
+                                       vortex_lines)
+from skellysim_tpu.system.system import solution_from_state
+from skellysim_tpu import listener as listener_mod
+
+
+# ---------------------------------------------------------------- integrator
+
+def test_streamline_uniform_flow_straight_line():
+    u = np.array([0.3, -0.2, 0.1])
+
+    def vel(x):
+        return jnp.broadcast_to(jnp.asarray(u), x.shape)
+
+    x0 = np.array([[0.0, 0.0, 0.0], [1.0, 2.0, 3.0]])
+    lines = streamlines(vel, x0, dt_init=0.1, t_final=1.0, back_integrate=True)
+    assert len(lines) == 2
+    for seed, ln in zip(x0, lines):
+        # x(t) = seed + u t for t in [-1, 1]; times ascend through 0
+        assert ln["time"][0] == pytest.approx(-1.0, abs=1e-8)
+        assert ln["time"][-1] == pytest.approx(1.0, abs=1e-8)
+        assert np.all(np.diff(ln["time"]) > 0)
+        expect = seed[None, :] + ln["time"][:, None] * u[None, :]
+        np.testing.assert_allclose(ln["x"], expect, atol=1e-8)
+        np.testing.assert_allclose(ln["val"], np.tile(u, (len(ln["time"]), 1)),
+                                   atol=1e-12)
+
+
+def test_streamline_forward_only_rotation():
+    # rigid rotation about z: streamlines are circles of constant radius
+    def vel(x):
+        return jnp.stack([-x[:, 1], x[:, 0], jnp.zeros_like(x[:, 0])], axis=-1)
+
+    lines = streamlines(vel, np.array([[1.0, 0.0, 0.5]]), dt_init=0.05,
+                        t_final=2.0, back_integrate=False, rel_err=1e-10,
+                        abs_err=1e-12)
+    ln = lines[0]
+    r = np.linalg.norm(ln["x"][:, :2], axis=1)
+    np.testing.assert_allclose(r, 1.0, atol=1e-7)
+    np.testing.assert_allclose(ln["x"][:, 2], 0.5, atol=1e-12)
+    # reached the requested final time
+    assert ln["time"][-1] == pytest.approx(2.0, abs=1e-8)
+
+
+def test_streamline_singularity_bailout():
+    # speed ramps with |x|; beyond ||v|| > 1e3 the line must stop early
+    def vel(x):
+        return 200.0 * x
+
+    lines = streamlines(vel, np.array([[1.0, 1.0, 1.0]]), dt_init=1e-3,
+                        t_final=5.0, back_integrate=False)
+    ln = lines[0]
+    assert ln["time"][-1] < 5.0  # bailed out before t_final
+    assert np.linalg.norm(200.0 * ln["x"][-1]) > 1e3
+
+
+def test_vorticity_rigid_rotation():
+    omega = np.array([0.0, 0.0, 0.7])
+
+    def vel(x):
+        return jnp.cross(jnp.broadcast_to(jnp.asarray(omega), x.shape), x)
+
+    vort = make_vorticity_fn(vel)
+    w = np.asarray(vort(jnp.asarray([[0.3, -0.2, 0.9], [1.0, 1.0, 1.0]])))
+    np.testing.assert_allclose(w, np.tile(2 * omega, (2, 1)), atol=1e-6)
+
+
+def test_vortex_lines_follow_omega():
+    omega = np.array([0.0, 0.0, 0.5])
+
+    def vel(x):
+        return jnp.cross(jnp.broadcast_to(jnp.asarray(omega), x.shape), x)
+
+    lines = vortex_lines(vel, np.array([[0.2, 0.1, 0.0]]), dt_init=0.1,
+                         t_final=1.0, back_integrate=False)
+    ln = lines[0]
+    # vorticity field is uniform 2*omega: the line goes straight up z
+    np.testing.assert_allclose(ln["x"][:, 0], 0.2, atol=1e-8)
+    np.testing.assert_allclose(ln["x"][:, 1], 0.1, atol=1e-8)
+    assert ln["x"][-1, 2] > 0.9  # advanced ~ 2*0.5*1.0 = 1.0 in z
+    np.testing.assert_allclose(ln["val"], np.tile(2 * omega, (len(ln["time"]), 1)),
+                               atol=1e-6)
+
+
+# ------------------------------------------------------- velocity_at_targets
+
+def _run_fiber_sim(tmp_path):
+    from skellysim_tpu.config import BackgroundSource, Config, Fiber
+
+    cfg = Config()
+    cfg.params.eta = 1.3
+    cfg.params.dt_initial = 0.005
+    cfg.params.dt_write = 0.005
+    cfg.params.t_final = 0.01
+    cfg.params.adaptive_timestep_flag = False
+    fib = Fiber(n_nodes=16, length=1.0, bending_rigidity=0.01)
+    fib.fill_node_positions(np.zeros(3), np.array([0.0, 0.0, 1.0]))
+    cfg.fibers = [fib]
+    cfg.background = BackgroundSource(uniform=[1.0, 0.0, 0.0])
+    path = str(tmp_path / "skelly_config.toml")
+    cfg.save(path)
+    cli.run(path)
+    return path, str(tmp_path / "skelly_sim.out")
+
+
+def test_velocity_at_targets_far_field(tmp_path):
+    """Far from a weakly-forced fiber, velocity ~ background uniform flow."""
+    cfg_path, traj_path = _run_fiber_sim(tmp_path)
+    system, template, _ = builder.build_simulation(cfg_path)
+    reader = TrajectoryReader(traj_path)
+    state = frame_to_state(reader.load_frame(len(reader) - 1), template)
+    solution = solution_from_state(state)
+
+    r_far = np.array([[80.0, 0.0, 0.0], [0.0, 90.0, 10.0]])
+    v = np.asarray(system.velocity_at_targets(state, solution, r_far))
+    np.testing.assert_allclose(v, [[1.0, 0.0, 0.0]] * 2, atol=5e-2)
+    # a freely-advected fiber is force-free: even the near field is the
+    # undisturbed background flow
+    v_near = np.asarray(system.velocity_at_targets(
+        state, solution, np.array([[0.1, 0.0, 0.5]])))
+    np.testing.assert_allclose(v_near[0], [1.0, 0.0, 0.0], atol=1e-8)
+
+
+def test_velocity_inside_body_is_rigid_motion(tmp_path):
+    """Targets inside a rigid body report v + omega x dx (`system.cpp:364-381`)."""
+    from skellysim_tpu.config import Body, ConfigSpherical
+    from skellysim_tpu import precompute
+
+    cfg = ConfigSpherical()
+    cfg.params.eta = 1.0
+    cfg.params.dt_initial = 0.01
+    cfg.params.dt_write = 0.01
+    cfg.params.t_final = 0.02
+    cfg.params.adaptive_timestep_flag = False
+    cfg.periphery.n_nodes = 100
+    cfg.periphery.radius = 4.0
+    body = Body(position=[0.0, 0.0, 0.0], shape="sphere", radius=0.5,
+                n_nodes=100, external_force=[0.0, 0.0, 1.0])
+    cfg.bodies = [body]
+    path = str(tmp_path / "skelly_config.toml")
+    cfg.save(path)
+    precompute.precompute_from_config(path, verbose=False)
+    cli.run(path)
+
+    system, template, _ = builder.build_simulation(path)
+    reader = TrajectoryReader(str(tmp_path / "skelly_sim.out"))
+    state = frame_to_state(reader.load_frame(len(reader) - 1), template)
+    solution = solution_from_state(state)
+
+    center = np.asarray(state.bodies.position)[0]
+    v_in = np.asarray(system.velocity_at_targets(
+        state, solution, center[None, :] + [[0.0, 0.0, 0.1]]))
+    v_body = np.asarray(state.bodies.solution)[0, -6:-3]
+    omega = np.asarray(state.bodies.solution)[0, -3:]
+    np.testing.assert_allclose(v_in[0], v_body + np.cross(omega, [0.0, 0.0, 0.1]),
+                               atol=1e-12)
+    # drag force upward -> body moves upward
+    assert v_body[2] > 0
+
+
+# ----------------------------------------------------------------- listener
+
+def test_listener_server_roundtrip(tmp_path):
+    """Full request/response through the in-process server loop."""
+    cfg_path, traj_path = _run_fiber_sim(tmp_path)
+
+    req = {
+        "frame_no": 1,
+        "evaluator": "CPU",
+        "streamlines": {"dt_init": 0.05, "t_final": 0.2, "abs_err": 1e-8,
+                        "rel_err": 1e-6, "back_integrate": True,
+                        "x0": eigen.pack_matrix(np.array([[2.0, 0.0, 0.5]]))},
+        "vortexlines": {"x0": eigen.pack_matrix(np.zeros((0, 3)))},
+        "velocity_field": {"x": eigen.pack_matrix(np.array([[50.0, 0.0, 0.0]]))},
+    }
+    msg = msgpack.packb(req)
+    stdin = _io.BytesIO(struct.pack("<Q", len(msg)) + msg + struct.pack("<Q", 0))
+    stdout = _io.BytesIO()
+    listener_mod.serve(cfg_path, traj_path, stdin=stdin, stdout=stdout)
+
+    stdout.seek(0)
+    (size,) = struct.unpack("<Q", stdout.read(8))
+    assert size > 0
+    res = eigen.decode_tree(msgpack.unpackb(stdout.read(size), raw=False))
+    assert res["i_frame"] == 1
+    assert res["n_frames"] == len(TrajectoryReader(traj_path))
+    assert len(res["streamlines"]) == 1
+    ln = res["streamlines"][0]
+    assert ln["x"].shape[1] == 3 and ln["x"].shape[0] == len(ln["time"])
+    assert res["vortexlines"] == []
+    # far-field velocity ~ background (single point decodes 1-D per the
+    # reference's __eigen__ convention)
+    np.testing.assert_allclose(
+        np.asarray(res["velocity_field"]).reshape(-1, 3)[0],
+        [1.0, 0.0, 0.0], atol=5e-2)
+
+
+def test_listener_client_subprocess(tmp_path, monkeypatch):
+    """The Python client drives a real --listen server subprocess
+    (`reader.py:126-194` semantics)."""
+    from skellysim_tpu.io import Listener, Request, VelocityFieldRequest
+
+    cfg_path, traj_path = _run_fiber_sim(tmp_path)
+    monkeypatch.chdir(tmp_path)  # server resolves skelly_sim.out next to config
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("PYTHONPATH", repo_root)
+    with Listener(toml_file=cfg_path) as listener:
+        req = Request(frame_no=0)
+        req.velocity_field = VelocityFieldRequest(
+            x=np.array([[60.0, 0.0, 0.0], [0.0, 70.0, 0.0]]))
+        res = listener.request(req)
+        assert res["i_frame"] == 0 and res["n_frames"] >= 2
+        np.testing.assert_allclose(np.asarray(res["velocity_field"]),
+                                   [[1.0, 0.0, 0.0]] * 2, atol=5e-2)
+        assert listener.request(Request(frame_no=512)) is None
+
+
+def test_listener_invalid_frame_returns_empty(tmp_path):
+    cfg_path, traj_path = _run_fiber_sim(tmp_path)
+    msg = msgpack.packb({"frame_no": 9999})
+    stdin = _io.BytesIO(struct.pack("<Q", len(msg)) + msg + struct.pack("<Q", 0))
+    stdout = _io.BytesIO()
+    listener_mod.serve(cfg_path, traj_path, stdin=stdin, stdout=stdout)
+    stdout.seek(0)
+    (size,) = struct.unpack("<Q", stdout.read(8))
+    assert size == 0
