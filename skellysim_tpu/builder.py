@@ -73,6 +73,13 @@ def build_bodies(cfg_bodies: list, config_dir: str, dtype) -> bd.BodyGroup | Non
         raise ValueError("all bodies must share n_nucleation_sites")
     ns = site_counts.pop()
 
+    def runtime_quat(b):
+        # TOML orientation follows the schema/Eigen-coeffs order [x, y, z, w]
+        # (`skelly_config.py:729`, default [0,0,0,1]); runtime + trajectory
+        # wire use (w, x, y, z) (`eigen_quaternion_plugin.h:27-36`)
+        x, y, z, w = np.asarray(b.orientation, dtype=float)
+        return np.array([w, x, y, z])
+
     def sites_ref(b):
         # config nucleation sites are lab-frame at t=0; body-frame storage must
         # undo the configured orientation (lab = pos + R(q) @ ref,
@@ -80,7 +87,7 @@ def build_bodies(cfg_bodies: list, config_dir: str, dtype) -> bd.BodyGroup | Non
         from .utils import quaternion as quat
 
         s = np.asarray(b.nucleation_sites, dtype=float).reshape(ns, 3)
-        R = np.asarray(quat.rotation_matrix(np.asarray(b.orientation, dtype=float)))
+        R = np.asarray(quat.rotation_matrix(runtime_quat(b)))
         return (s - np.asarray(b.position)) @ R  # (R^T @ d^T)^T = d @ R
 
     shapes = {b.shape for b in cfg_bodies}
@@ -96,7 +103,7 @@ def build_bodies(cfg_bodies: list, config_dir: str, dtype) -> bd.BodyGroup | Non
         np.stack([p["node_normals_ref"] for p in pre]),
         np.stack([p["node_weights"] for p in pre]),
         position=np.stack([b.position for b in cfg_bodies]),
-        orientation=np.stack([b.orientation for b in cfg_bodies]),
+        orientation=np.stack([runtime_quat(b) for b in cfg_bodies]),
         nucleation_sites_ref=np.stack([sites_ref(b) for b in cfg_bodies]),
         external_force=np.stack([b.external_force for b in cfg_bodies]),
         external_torque=np.stack([b.external_torque for b in cfg_bodies]),
@@ -153,18 +160,28 @@ def build_background(cfg_bg, dtype) -> BackgroundFlow | None:
                                scale=cfg_bg.scale_factor, dtype=dtype)
 
 
-def build_simulation(config, config_dir: str = ".", dtype=jnp.float64):
-    """Config (object or TOML path) → (System, SimState, SimRNG)."""
+def build_simulation(config, config_dir: str = ".", dtype=jnp.float64,
+                     mesh=None):
+    """Config (object or TOML path) → (System, SimState, SimRNG).
+
+    ``mesh`` enables the ring pair evaluator when the config selects
+    pair_evaluator = "ring"; without one the dense direct path runs.
+    """
     if isinstance(config, (str, os.PathLike)):
         config_dir = os.path.dirname(os.path.abspath(config)) or "."
         config = schema.load_config(str(config))
 
     params = schema.to_runtime_params(config.params)
+    if params.pair_evaluator == "ring" and mesh is None:
+        import warnings
+
+        warnings.warn("config selects pair_evaluator='ring' but no mesh was "
+                      "given to build_simulation; using the direct evaluator")
     shell, shape = (None, None)
     if getattr(config, "periphery", None) is not None:
         shell, shape = build_periphery(config.periphery, config_dir, dtype)
 
-    system = System(params, shell_shape=shape)
+    system = System(params, shell_shape=shape, mesh=mesh)
     state = system.make_state(
         fibers=build_fibers(config.fibers, dtype),
         points=build_point_sources(config.point_sources, dtype),

@@ -544,6 +544,9 @@ def to_runtime_params(p: Params) -> runtime_params.Params:
         seed=p.seed,
         implicit_motor_activation_delay=p.implicit_motor_activation_delay,
         periphery_interaction_flag=p.periphery_interaction_flag,
+        # reference evaluator names (CPU/GPU/FMM/TPU) all map to the dense
+        # direct path; "ring" opts into the collective-permute ring kernels
+        pair_evaluator="ring" if p.pair_evaluator.lower() == "ring" else "direct",
         dynamic_instability=runtime_params.DynamicInstability(
             **dataclasses.asdict(p.dynamic_instability)),
         periphery_binding=runtime_params.PeripheryBinding(

@@ -178,15 +178,26 @@ def weighted_forces(group: FiberGroup, forces) -> jnp.ndarray:
 
 
 def flow(group: FiberGroup, caches: FiberCaches, r_trg, forces, eta,
-         subtract_self: bool = True) -> jnp.ndarray:
+         subtract_self: bool = True, evaluator: str = "direct",
+         mesh=None) -> jnp.ndarray:
     """Velocity at targets from all fiber nodes (`flow`, `:172-214`).
 
     ``forces`` is [nf, n, 3]; when ``subtract_self`` the first nf*n targets are
     assumed to be the fiber nodes themselves and each fiber's dense
     self-interaction is subtracted (it is handled by the SBT mobility instead).
+    ``evaluator="ring"`` (with a mesh) rotates source blocks around the ICI
+    ring instead of the GSPMD all-gather — the reference's pair_evaluator seam
+    (`fiber_container_base.cpp:20-33`).
     """
     wf = weighted_forces(group, forces)
-    vel = kernels.stokeslet_direct(node_positions(group), r_trg, wf.reshape(-1, 3), eta)
+    if evaluator == "ring" and mesh is not None:
+        from ..parallel.ring import ring_stokeslet
+
+        vel = ring_stokeslet(node_positions(group), r_trg, wf.reshape(-1, 3),
+                             eta, mesh=mesh)
+    else:
+        vel = kernels.stokeslet_direct(node_positions(group), r_trg,
+                                       wf.reshape(-1, 3), eta)
     if subtract_self:
         self_vel = jnp.einsum("fiajb,fjb->fia", caches.stokeslet, wf)
         nfn = group.n_fibers * group.n_nodes

@@ -70,6 +70,42 @@ def _blocked_target_sum(kernel_fn, r_trg, block_size):
     return u.reshape(nb * block_size, 3)[:n_trg]
 
 
+def stokeslet_block(trg, src, f_src):
+    """Unscaled Stokeslet partial sum of one (target-block, source-block) pair.
+
+    Shared by the blocked single-program path and the ring evaluator
+    (`parallel/ring.py`) so the masking/regularization semantics cannot
+    diverge between backends.
+    """
+    d = trg[:, None, :] - src[None, :, :]
+    r2 = jnp.sum(d * d, axis=-1)
+    mask = r2 > 0.0
+    rinv = jnp.where(mask, lax.rsqrt(jnp.where(mask, r2, 1.0)), 0.0)
+    rinv3 = rinv * rinv * rinv
+    df = jnp.einsum("tsk,sk->ts", d, f_src)
+    return jnp.einsum("ts,sk->tk", rinv, f_src) + jnp.einsum("ts,tsk->tk", df * rinv3, d)
+
+
+def stresslet_block(trg, src, S):
+    """Unscaled stresslet partial sum of one (target-block, source-block) pair."""
+    d = trg[:, None, :] - src[None, :, :]
+    r2 = jnp.sum(d * d, axis=-1)
+    mask = r2 > 0.0
+    rinv = jnp.where(mask, lax.rsqrt(jnp.where(mask, r2, 1.0)), 0.0)
+    rinv5 = rinv * rinv * rinv * rinv * rinv
+    dSd = jnp.einsum("tsi,sij,tsj->ts", d, S, d)
+    return jnp.einsum("ts,tsk->tk", -3.0 * dSd * rinv5, d)
+
+
+def oseen_block(trg, src, density, eta, reg, epsilon_distance):
+    """Regularized-Oseen partial sum (already eta-scaled via fr/gr)."""
+    d = trg[:, None, :] - src[None, :, :]
+    r2 = jnp.sum(d * d, axis=-1)
+    fr, gr = _regularized_frgr(r2, eta, reg, epsilon_distance)
+    df = jnp.einsum("tsk,sk->ts", d, density)
+    return jnp.einsum("ts,sk->tk", fr, density) + jnp.einsum("ts,tsk->tk", gr * df, d)
+
+
 @partial(jax.jit, static_argnames=("block_size",))
 def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096):
     """Singular Stokeslet sum: [n_src,3] sources, [n_trg,3] targets -> [n_trg,3].
@@ -78,18 +114,8 @@ def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096):
     `pvfmm::stokes_vel` / `src/core/kernels.cu:17-41`.
     """
     factor = 1.0 / (8.0 * math.pi)
-
-    def block(trg):
-        d = trg[:, None, :] - r_src[None, :, :]
-        r2 = jnp.sum(d * d, axis=-1)
-        mask = r2 > 0.0
-        rinv = jnp.where(mask, lax.rsqrt(jnp.where(mask, r2, 1.0)), 0.0)
-        rinv3 = rinv * rinv * rinv
-        df = jnp.einsum("tsk,sk->ts", d, f_src)
-        u = jnp.einsum("ts,sk->tk", rinv, f_src) + jnp.einsum("ts,tsk->tk", df * rinv3, d)
-        return u
-
-    u = _blocked_target_sum(block, r_trg, block_size)
+    u = _blocked_target_sum(lambda trg: stokeslet_block(trg, r_src, f_src),
+                            r_trg, block_size)
     return u * (factor / eta)
 
 
@@ -101,18 +127,8 @@ def stresslet_direct(r_dl, r_trg, f_dl, eta, *, block_size: int = 4096):
     reference's sxx..szz, i.e. ``f_dl[s, i, j] = S_ij``); returns [n_trg, 3].
     """
     factor = 1.0 / (8.0 * math.pi)
-
-    def block(trg):
-        d = trg[:, None, :] - r_dl[None, :, :]
-        r2 = jnp.sum(d * d, axis=-1)
-        mask = r2 > 0.0
-        rinv = jnp.where(mask, lax.rsqrt(jnp.where(mask, r2, 1.0)), 0.0)
-        rinv5 = rinv * rinv * rinv * rinv * rinv
-        dSd = jnp.einsum("tsi,sij,tsj->ts", d, f_dl, d)
-        common = -3.0 * dSd * rinv5
-        return jnp.einsum("ts,tsk->tk", common, d)
-
-    u = _blocked_target_sum(block, r_trg, block_size)
+    u = _blocked_target_sum(lambda trg: stresslet_block(trg, r_dl, f_dl),
+                            r_trg, block_size)
     return u * (factor / eta)
 
 
@@ -155,15 +171,9 @@ def oseen_contract(r_src, r_trg, density, eta, reg=DEFAULT_REG,
 
     Mirror of `kernels::oseen_tensor_contract_direct` (`src/core/kernels.cpp:85-131`).
     """
-
-    def block(trg):
-        d = trg[:, None, :] - r_src[None, :, :]
-        r2 = jnp.sum(d * d, axis=-1)
-        fr, gr = _regularized_frgr(r2, eta, reg, epsilon_distance)
-        df = jnp.einsum("tsk,sk->ts", d, density)
-        return jnp.einsum("ts,sk->tk", fr, density) + jnp.einsum("ts,tsk->tk", gr * df, d)
-
-    return _blocked_target_sum(block, r_trg, block_size)
+    return _blocked_target_sum(
+        lambda trg: oseen_block(trg, r_src, density, eta, reg, epsilon_distance),
+        r_trg, block_size)
 
 
 @jax.jit

@@ -1,1 +1,3 @@
-from .mesh import make_mesh, shard_state  # noqa: F401
+from .mesh import FIBER_AXIS, make_mesh, shard_state  # noqa: F401
+from .ring import (ring_oseen_contract, ring_stokeslet,  # noqa: F401
+                   ring_stresslet)

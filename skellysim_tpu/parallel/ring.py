@@ -1,0 +1,108 @@
+"""Ring-pass pairwise Stokes kernels over a device mesh.
+
+Multi-chip evaluation of the all-to-all N-body sums (the framework's hottest
+op, SURVEY.md §2.3/§5.7): instead of all-gathering every source onto every
+chip (the GSPMD default for the dense kernels, and the analogue of the
+reference FMM's cross-rank coupling, `/root/reference/include/kernels.hpp:78-122`),
+each chip keeps its target block resident and the source blocks rotate
+neighbor-to-neighbor around the ICI ring with `lax.ppermute` — structurally
+identical to ring attention's KV-block rotation, applied to Stokes kernels.
+Peak per-chip memory is O(N/D) instead of O(N), and every hop is a
+nearest-neighbor ICI transfer that overlaps with the local block computation.
+
+All functions take sources/targets/densities sharded along their leading axis
+over ``mesh`` (pad to a multiple of the mesh size) and return targets with the
+same sharding. The per-block math is shared with `ops.kernels`
+(stokeslet_block / stresslet_block / oseen_block), so the self-term masking
+and regularization semantics are identical by construction; coincident-point
+masking works across blocks because coincidence is a property of the
+coordinates, not the block layout.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.kernels import (DEFAULT_EPS, DEFAULT_REG, oseen_block,
+                           stokeslet_block, stresslet_block)
+from .mesh import FIBER_AXIS
+
+
+def _ring_accumulate(block_fn, axis_name: str, n_dev: int, u0, *rotating):
+    """Accumulate ``block_fn(*rotating)`` over all ring positions.
+
+    ``rotating`` arrays hop to the ring neighbor before each of the
+    iterations 1..n_dev-1 (the final position's blocks are consumed in place —
+    no wasted trailing hop).
+    """
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+
+    def step(i, carry):
+        u, rot = carry
+        rot = jax.tree_util.tree_map(
+            lambda a: lax.ppermute(a, axis_name, perm), rot)
+        u = u + block_fn(*rot)
+        return u, rot
+
+    u0 = u0 + block_fn(*rotating)
+    if n_dev == 1:
+        return u0
+    u, _ = lax.fori_loop(1, n_dev, step, (u0, tuple(rotating)))
+    return u
+
+
+def _ring_eval(block_fn, mesh: Mesh, axis_name: str, specs, scale, *operands):
+    """shard_map a ring accumulation: operands[0] = targets (stay resident),
+    operands[1:] rotate."""
+    n_dev = mesh.shape[axis_name]
+
+    def local(trg_l, *rot_l):
+        u = _ring_accumulate(lambda *r: block_fn(trg_l, *r), axis_name, n_dev,
+                             jnp.zeros_like(trg_l), *rot_l)
+        return u * scale
+
+    return jax.shard_map(local, mesh=mesh, in_specs=specs,
+                         out_specs=P(axis_name))(*operands)
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis_name"))
+def ring_stokeslet(r_src, r_trg, f_src, eta, *, mesh: Mesh,
+                   axis_name: str = FIBER_AXIS):
+    """Ring-parallel singular Stokeslet sum (`ops.kernels.stokeslet_direct`).
+
+    Leading axes of ``r_src``/``f_src``/``r_trg`` must be divisible by the
+    mesh size.
+    """
+    spec = P(axis_name)
+    return _ring_eval(stokeslet_block, mesh, axis_name, (spec, spec, spec),
+                      1.0 / (8.0 * math.pi * eta), r_trg, r_src, f_src)
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis_name"))
+def ring_stresslet(r_dl, r_trg, f_dl, eta, *, mesh: Mesh,
+                   axis_name: str = FIBER_AXIS):
+    """Ring-parallel stresslet (double-layer) sum
+    (`ops.kernels.stresslet_direct`); ``f_dl`` is [n_src, 3, 3]."""
+    spec = P(axis_name)
+    return _ring_eval(stresslet_block, mesh, axis_name,
+                      (spec, spec, P(axis_name, None, None)),
+                      1.0 / (8.0 * math.pi * eta), r_trg, r_dl, f_dl)
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis_name"))
+def ring_oseen_contract(r_src, r_trg, density, eta, reg=DEFAULT_REG,
+                        epsilon_distance=DEFAULT_EPS, *, mesh: Mesh,
+                        axis_name: str = FIBER_AXIS):
+    """Ring-parallel regularized Oseen contraction
+    (`ops.kernels.oseen_contract`)."""
+    spec = P(axis_name)
+    return _ring_eval(
+        lambda trg, src, rho: oseen_block(trg, src, rho, eta, reg,
+                                          epsilon_distance),
+        mesh, axis_name, (spec, spec, spec), 1.0, r_trg, r_src, density)

@@ -56,6 +56,12 @@ class Params:
     gmres_maxiter: int = 1000
     fiber_error_tol: float = 1e-1
     seed: int = 1
+    # pairwise-kernel backend, mirroring the reference's params.pair_evaluator
+    # ("CPU"/"GPU"/"FMM", `include/params.hpp:50`): "direct" = dense blocked
+    # kernels (GSPMD inserts all-gathers on a mesh); "ring" = source blocks
+    # rotate the ICI ring via collective-permute (free-space fiber systems on
+    # a mesh; falls back to direct when a shell/bodies are present)
+    pair_evaluator: str = "direct"
     implicit_motor_activation_delay: float = 0.0
     periphery_interaction_flag: bool = False
     dynamic_instability: DynamicInstability = field(default_factory=DynamicInstability)

@@ -173,19 +173,22 @@ def _assemble(field_fn, speed_fn, x0, dt_init, t_final, abs_err, rel_err,
     x0 = jnp.atleast_2d(jnp.asarray(x0))
     if x0.size == 0:
         return []
-    fwd = _integrate_batch(field_fn, speed_fn, x0, dt_init, t_final,
-                           abs_err, rel_err, 1.0, max_steps=max_steps,
-                           field_args=field_args)
-    fwd_val = val_fn(fwd.x.reshape(-1, 3), *field_args).reshape(fwd.x.shape)
-    parts = [(np.asarray(fwd.x), np.asarray(fwd.time),
-              np.asarray(fwd_val), np.asarray(fwd.count))]
+
+    def run(sign):
+        batch = _integrate_batch(field_fn, speed_fn, x0, dt_init, t_final,
+                                 abs_err, rel_err, sign, max_steps=max_steps,
+                                 field_args=field_args)
+        # evaluate val only over the recorded extent, not the padded buffer
+        # (short lines would otherwise pay max_steps/n_samples x the kernel cost)
+        used = max(int(batch.count.max()), 1)
+        x_used = batch.x[:, :used]
+        val = val_fn(x_used.reshape(-1, 3), *field_args).reshape(x_used.shape)
+        return (np.asarray(x_used), np.asarray(batch.time[:, :used]),
+                np.asarray(val), np.asarray(batch.count))
+
+    parts = [run(1.0)]
     if back_integrate:
-        bwd = _integrate_batch(field_fn, speed_fn, x0, dt_init, t_final,
-                               abs_err, rel_err, -1.0, max_steps=max_steps,
-                               field_args=field_args)
-        bwd_val = val_fn(bwd.x.reshape(-1, 3), *field_args).reshape(bwd.x.shape)
-        parts.insert(0, (np.asarray(bwd.x), np.asarray(bwd.time),
-                         np.asarray(bwd_val), np.asarray(bwd.count)))
+        parts.insert(0, run(-1.0))
 
     lines = []
     for i in range(x0.shape[0]):

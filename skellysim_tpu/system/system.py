@@ -71,12 +71,28 @@ def solution_from_state(state: SimState):
 class System:
     """Holds static config; all dynamics flow through pure jit'd functions."""
 
-    def __init__(self, params: Params, shell_shape: PeripheryShape | None = None):
+    def __init__(self, params: Params, shell_shape: PeripheryShape | None = None,
+                 mesh=None):
         self.params = params
         self.shell_shape = shell_shape
+        # device mesh for the ring pair evaluator (params.pair_evaluator="ring");
+        # GSPMD sharding via parallel.shard_state needs no mesh here
+        self.mesh = mesh
         self._solve_jit = jax.jit(self._solve_impl)
         self._collision_jit = jax.jit(self._check_collision)
         self._vel_jit = jax.jit(self._velocity_at_targets_impl)
+
+    def _fiber_flow(self, state: SimState, caches, r_trg, forces,
+                    subtract_self: bool = True):
+        """Fiber-source flow through the selected pair evaluator. The ring
+        path needs every target block sharded along the fiber axis, so it only
+        engages for pure-fiber systems (no shell/body target rows)."""
+        ring_ok = (self.params.pair_evaluator == "ring" and self.mesh is not None
+                   and state.shell is None and state.bodies is None)
+        return fc.flow(state.fibers, caches, r_trg, forces, self.params.eta,
+                       subtract_self=subtract_self,
+                       evaluator="ring" if ring_ok else "direct",
+                       mesh=self.mesh if ring_ok else None)
 
     # ------------------------------------------------------------- state setup
 
@@ -204,7 +220,7 @@ class System:
                               fc.generate_constant_force(fibers, caches),
                               jnp.zeros_like(fibers.x))
 
-            v_all = v_all + fc.flow(fibers, caches, r_all, external, p.eta)
+            v_all = v_all + self._fiber_flow(state, caches, r_all, external)
 
         if state.bodies is not None:
             body_caches = bd.update_cache(state.bodies, p.eta)
@@ -251,7 +267,8 @@ class System:
             nf, n = fibers.n_fibers, fibers.n_nodes
             x_fib = x_flat[:fib_size].reshape(nf, 4 * n)
             fw = fc.apply_fiber_force(fibers, caches, x_fib)
-            v_all = v_all + fc.flow(fibers, caches, r_all, fw, p.eta, subtract_self=True)
+            v_all = v_all + self._fiber_flow(state, caches, r_all, fw,
+                                             subtract_self=True)
 
         if shell is not None and (fibers is not None or bodies is not None):
             # shell flow is evaluated at fiber and body nodes only; the shell

@@ -1,0 +1,81 @@
+"""Ring-pass kernels vs dense direct kernels on the 8-device virtual mesh.
+
+The TPU analogue of the reference's kernel-backend consistency matrix
+(`/root/reference/tests/core/kernel_test.cpp:1-120`): every backend must agree
+with the ground-truth direct evaluation to tight tolerance (the reference
+gates at 5e-9 in f64).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skellysim_tpu.ops import kernels
+from skellysim_tpu.parallel import (make_mesh, ring_oseen_contract,
+                                    ring_stokeslet, ring_stresslet)
+
+N_DEV = 8
+GATE = 5e-9  # `kernel_test.cpp:93`
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() >= N_DEV
+    return make_mesh(N_DEV)
+
+
+@pytest.fixture(scope="module")
+def cloud():
+    rng = np.random.default_rng(7)
+    n_src, n_trg = 4 * N_DEV * 3, 4 * N_DEV * 2
+    r_src = jnp.asarray(rng.uniform(-1, 1, (n_src, 3)))
+    r_trg = jnp.asarray(rng.uniform(-1, 1, (n_trg, 3)))
+    f = jnp.asarray(rng.standard_normal((n_src, 3)))
+    return r_src, r_trg, f
+
+
+def _rel_err(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return np.abs(a - b).max() / max(np.abs(b).max(), 1e-300)
+
+
+def test_ring_stokeslet_matches_direct(mesh, cloud):
+    r_src, r_trg, f = cloud
+    u_ring = ring_stokeslet(r_src, r_trg, f, 1.7, mesh=mesh)
+    u_direct = kernels.stokeslet_direct(r_src, r_trg, f, 1.7)
+    assert _rel_err(u_ring, u_direct) < GATE
+
+
+def test_ring_stokeslet_self_term_masked(mesh):
+    """Coincident source/target pairs must drop even across ring blocks."""
+    rng = np.random.default_rng(3)
+    pts = jnp.asarray(rng.uniform(-1, 1, (2 * N_DEV, 3)))
+    f = jnp.asarray(rng.standard_normal((2 * N_DEV, 3)))
+    u_ring = ring_stokeslet(pts, pts, f, 1.0, mesh=mesh)
+    u_direct = kernels.stokeslet_direct(pts, pts, f, 1.0)
+    assert np.all(np.isfinite(np.asarray(u_ring)))
+    assert _rel_err(u_ring, u_direct) < GATE
+
+
+def test_ring_stresslet_matches_direct(mesh, cloud):
+    r_src, r_trg, _ = cloud
+    rng = np.random.default_rng(11)
+    S = jnp.asarray(rng.standard_normal((r_src.shape[0], 3, 3)))
+    u_ring = ring_stresslet(r_src, r_trg, S, 0.9, mesh=mesh)
+    u_direct = kernels.stresslet_direct(r_src, r_trg, S, 0.9)
+    assert _rel_err(u_ring, u_direct) < GATE
+
+
+def test_ring_oseen_contract_matches_direct(mesh, cloud):
+    r_src, r_trg, f = cloud
+    u_ring = ring_oseen_contract(r_src, r_trg, f, 1.2, mesh=mesh)
+    u_direct = kernels.oseen_contract(r_src, r_trg, f, 1.2)
+    assert _rel_err(u_ring, u_direct) < GATE
+
+
+def test_ring_output_sharding(mesh, cloud):
+    """The result stays sharded over the mesh (no implicit gather)."""
+    r_src, r_trg, f = cloud
+    u = ring_stokeslet(r_src, r_trg, f, 1.0, mesh=mesh)
+    assert len(u.sharding.device_set) == N_DEV

@@ -1,0 +1,53 @@
+"""Full implicit solve through the ring evaluator == direct evaluator.
+
+Distributed-correctness strategy per SURVEY.md §4.3: real sharded execution on
+the virtual 8-device mesh, compared against the single-program ground truth —
+no mocks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skellysim_tpu.fibers import container as fc
+from skellysim_tpu.params import Params
+from skellysim_tpu.parallel import make_mesh, shard_state
+from skellysim_tpu.system import BackgroundFlow, System
+
+N_DEV = 8
+
+
+def _state(system, n_fibers=2 * N_DEV, n_nodes=16):
+    rng = np.random.default_rng(5)
+    t = np.linspace(0, 1, n_nodes)
+    origins = rng.uniform(-4.0, 4.0, size=(n_fibers, 3))
+    dirs = rng.normal(size=(n_fibers, 3))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    x = origins[:, None, :] + t[None, :, None] * dirs[:, None, :]
+    fibers = fc.make_group(x, lengths=1.0, bending_rigidity=0.01, radius=0.0125,
+                           dtype=jnp.float64)
+    return system.make_state(
+        fibers=fibers,
+        background=BackgroundFlow.make(uniform=(1.0, 0.0, 0.0),
+                                       dtype=jnp.float64))
+
+
+def test_ring_solve_matches_direct_solve():
+    mesh = make_mesh(N_DEV)
+    params = dict(eta=1.0, dt_initial=1e-3, t_final=1e-2, gmres_tol=1e-10,
+                  adaptive_timestep_flag=False)
+
+    sys_direct = System(Params(**params))
+    s_direct, sol_direct, info_direct = sys_direct.step(_state(sys_direct))
+
+    sys_ring = System(Params(**params, pair_evaluator="ring"), mesh=mesh)
+    state = shard_state(_state(sys_ring), mesh)
+    with jax.set_mesh(mesh):
+        s_ring, sol_ring, info_ring = sys_ring.step(state)
+        jax.block_until_ready(s_ring)
+
+    assert bool(info_ring.converged)
+    np.testing.assert_allclose(np.asarray(s_ring.fibers.x),
+                               np.asarray(s_direct.fibers.x), atol=5e-11)
+    np.testing.assert_allclose(np.asarray(sol_ring), np.asarray(sol_direct),
+                               atol=5e-9)
