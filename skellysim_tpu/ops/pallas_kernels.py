@@ -1,0 +1,200 @@
+"""Pallas TPU kernels for the hot pairwise Stokes sums.
+
+The XLA path (`ops.kernels`) materializes [block, n_src] displacement tensors
+in HBM between fused ops; these kernels keep the whole interaction tile in
+VMEM: coordinates live transposed as [3, N] so the source axis is the 128-wide
+lane dimension, each grid cell computes a [TILE_T, TILE_S] interaction block
+with pure VPU arithmetic (~20 flops/pair, no MXU dependency), and target tiles
+accumulate across the sequential source-tile grid axis.
+
+Numerics follow `ops.kernels.stokeslet_block` exactly: coincident pairs (r == 0)
+contribute zero. Padded sources contribute exactly zero because their
+force/stresslet densities are zero-padded (every additive term carries a
+density factor); the large-but-finite coordinate sentinel only guarantees the
+intermediate r^2/rsqrt stay finite so no NaN/Inf can propagate into real rows.
+A kernel added on this pattern MUST keep every term density-scaled.
+
+These kernels are float32 (the TPU-resident hot path); the f64 accuracy-gated
+path stays on the XLA kernels. `interpret=True` runs them on CPU for the
+backend-consistency tests (SURVEY.md §4.1).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# sentinel for padded source coordinates: far enough that rinv underflows to
+# exactly 0 in f32, small enough that r^2 stays finite
+_PAD_SENTINEL = 1e18
+
+DEFAULT_TILE_T = 256
+DEFAULT_TILE_S = 512
+
+
+def _pad_to(a, n, axis, value=0.0):
+    pad = n - a.shape[axis]
+    if pad == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(a, widths, constant_values=value)
+
+
+def _stokeslet_kernel(trg_ref, src_ref, f_ref, out_ref):
+    """One [TILE_T, TILE_S] interaction tile; accumulates over grid axis 1."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tx, ty, tz = trg_ref[0, :], trg_ref[1, :], trg_ref[2, :]
+    sx, sy, sz = src_ref[0, :], src_ref[1, :], src_ref[2, :]
+    fx, fy, fz = f_ref[0, :], f_ref[1, :], f_ref[2, :]
+
+    dx = tx[:, None] - sx[None, :]
+    dy = ty[:, None] - sy[None, :]
+    dz = tz[:, None] - sz[None, :]
+    r2 = dx * dx + dy * dy + dz * dz
+    mask = r2 > 0.0
+    rinv = jnp.where(mask, lax.rsqrt(jnp.where(mask, r2, 1.0)), 0.0)
+    rinv3 = rinv * rinv * rinv
+
+    df = dx * fx[None, :] + dy * fy[None, :] + dz * fz[None, :]
+    common = df * rinv3
+
+    ux = jnp.sum(rinv * fx[None, :] + common * dx, axis=1)
+    uy = jnp.sum(rinv * fy[None, :] + common * dy, axis=1)
+    uz = jnp.sum(rinv * fz[None, :] + common * dz, axis=1)
+    out_ref[0, :] += ux
+    out_ref[1, :] += uy
+    out_ref[2, :] += uz
+
+
+@partial(jax.jit, static_argnames=("tile_t", "tile_s", "interpret"))
+def stokeslet_pallas(r_src, r_trg, f_src, eta, *, tile_t: int = DEFAULT_TILE_T,
+                     tile_s: int = DEFAULT_TILE_S, interpret: bool = False):
+    """Singular Stokeslet sum as a fused Pallas kernel.
+
+    Same contract as `ops.kernels.stokeslet_direct`: [n_src, 3] sources,
+    [n_trg, 3] targets, [n_src, 3] forces -> [n_trg, 3] velocities.
+    """
+    n_trg, n_src = r_trg.shape[0], r_src.shape[0]
+    if n_trg == 0 or n_src == 0:
+        return jnp.zeros_like(r_trg)
+    dtype = r_trg.dtype
+
+    nt = pl.cdiv(n_trg, tile_t) * tile_t
+    ns = pl.cdiv(n_src, tile_s) * tile_s
+
+    trg_T = _pad_to(r_trg.T, nt, axis=1)
+    src_T = _pad_to(r_src.T, ns, axis=1, value=_PAD_SENTINEL)
+    f_T = _pad_to(f_src.T, ns, axis=1)
+
+    grid = (nt // tile_t, ns // tile_s)
+    u_T = pl.pallas_call(
+        _stokeslet_kernel,
+        out_shape=jax.ShapeDtypeStruct((3, nt), dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((3, tile_t), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, tile_s), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, tile_s), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((3, tile_t), lambda i, j: (0, i),
+                               memory_space=pltpu.VMEM),
+        cost_estimate=pl.CostEstimate(
+            flops=22 * nt * ns, bytes_accessed=4 * 3 * (nt + 2 * ns + nt),
+            transcendentals=nt * ns),
+        interpret=interpret,
+    )(trg_T, src_T, f_T)
+
+    factor = 1.0 / (8.0 * math.pi)
+    return u_T.T[:n_trg] * (factor / eta)
+
+
+def _stresslet_kernel(trg_ref, src_ref, s_ref, out_ref):
+    """Stresslet tile: s_ref holds the 9 source components [9, TILE_S]."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tx, ty, tz = trg_ref[0, :], trg_ref[1, :], trg_ref[2, :]
+    sx, sy, sz = src_ref[0, :], src_ref[1, :], src_ref[2, :]
+
+    dx = tx[:, None] - sx[None, :]
+    dy = ty[:, None] - sy[None, :]
+    dz = tz[:, None] - sz[None, :]
+    r2 = dx * dx + dy * dy + dz * dz
+    mask = r2 > 0.0
+    rinv = jnp.where(mask, lax.rsqrt(jnp.where(mask, r2, 1.0)), 0.0)
+    rinv2 = rinv * rinv
+    rinv5 = rinv2 * rinv2 * rinv
+
+    # d^T S d over the 9 components (S row-major: Sxx..Szz)
+    dSd = (dx * dx * s_ref[0, :][None, :] + dx * dy * s_ref[1, :][None, :]
+           + dx * dz * s_ref[2, :][None, :] + dy * dx * s_ref[3, :][None, :]
+           + dy * dy * s_ref[4, :][None, :] + dy * dz * s_ref[5, :][None, :]
+           + dz * dx * s_ref[6, :][None, :] + dz * dy * s_ref[7, :][None, :]
+           + dz * dz * s_ref[8, :][None, :])
+    common = -3.0 * dSd * rinv5
+
+    out_ref[0, :] += jnp.sum(common * dx, axis=1)
+    out_ref[1, :] += jnp.sum(common * dy, axis=1)
+    out_ref[2, :] += jnp.sum(common * dz, axis=1)
+
+
+@partial(jax.jit, static_argnames=("tile_t", "tile_s", "interpret"))
+def stresslet_pallas(r_dl, r_trg, f_dl, eta, *, tile_t: int = DEFAULT_TILE_T,
+                     tile_s: int = DEFAULT_TILE_S, interpret: bool = False):
+    """Singular stresslet sum as a fused Pallas kernel.
+
+    Same contract as `ops.kernels.stresslet_direct`: ``f_dl`` is [n_src, 3, 3].
+    """
+    n_trg, n_src = r_trg.shape[0], r_dl.shape[0]
+    if n_trg == 0 or n_src == 0:
+        return jnp.zeros_like(r_trg)
+    dtype = r_trg.dtype
+
+    nt = pl.cdiv(n_trg, tile_t) * tile_t
+    ns = pl.cdiv(n_src, tile_s) * tile_s
+
+    trg_T = _pad_to(r_trg.T, nt, axis=1)
+    src_T = _pad_to(r_dl.T, ns, axis=1, value=_PAD_SENTINEL)
+    s_T = _pad_to(f_dl.reshape(n_src, 9).T, ns, axis=1)
+
+    grid = (nt // tile_t, ns // tile_s)
+    u_T = pl.pallas_call(
+        _stresslet_kernel,
+        out_shape=jax.ShapeDtypeStruct((3, nt), dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((3, tile_t), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, tile_s), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((9, tile_s), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((3, tile_t), lambda i, j: (0, i),
+                               memory_space=pltpu.VMEM),
+        cost_estimate=pl.CostEstimate(
+            flops=40 * nt * ns, bytes_accessed=4 * (3 * nt + 12 * ns + 3 * nt),
+            transcendentals=nt * ns),
+        interpret=interpret,
+    )(trg_T, src_T, s_T)
+
+    factor = 1.0 / (8.0 * math.pi)
+    return u_T.T[:n_trg] * (factor / eta)

@@ -25,8 +25,17 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 
 
 def shard_state(state, mesh: Mesh):
-    """Place a SimState: fiber-batch leaves sharded over the mesh, rest replicated."""
+    """Place a SimState on the mesh.
+
+    - fiber-batch leaves: sharded along the fiber axis;
+    - shell dense operators (stresslet_plus_complementary, M_inv): row-sharded
+      — the analogue of the reference's Scatterv'd shell rows
+      (`periphery.cpp:408-442`), whose matvec becomes all-gather(density) +
+      local row-block GEMV (`periphery.cpp:21-47`), inserted by GSPMD;
+    - everything else (small body state, scalars, shell vectors): replicated.
+    """
     fib_sharding = NamedSharding(mesh, P(FIBER_AXIS))
+    row_sharding = NamedSharding(mesh, P(FIBER_AXIS, None))
     rep_sharding = NamedSharding(mesh, P())
 
     nf = state.fibers.n_fibers if state.fibers is not None else 0
@@ -37,4 +46,20 @@ def shard_state(state, mesh: Mesh):
             return jax.device_put(leaf, fib_sharding)
         return jax.device_put(leaf, rep_sharding)
 
-    return jax.tree_util.tree_map(place, state)
+    # place the O(n^2) shell operators straight to their final sharding (never
+    # replicate them first — peak per-device memory would be the full matrix);
+    # pjit rejects uneven shardings, so rows distribute only when the mesh
+    # size divides 3*n_nodes (pick shell n_nodes accordingly)
+    shell = state.shell
+    state = jax.tree_util.tree_map(place, state._replace(shell=None))
+    if shell is not None:
+        big = (row_sharding if shell.M_inv.shape[0] % mesh.size == 0
+               else rep_sharding)
+        rest = jax.tree_util.tree_map(
+            place, shell._replace(stresslet_plus_complementary=None,
+                                  M_inv=None))
+        shell = rest._replace(
+            stresslet_plus_complementary=jax.device_put(
+                shell.stresslet_plus_complementary, big),
+            M_inv=jax.device_put(shell.M_inv, big))
+    return state._replace(shell=shell)

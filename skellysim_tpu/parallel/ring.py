@@ -37,24 +37,24 @@ from .mesh import FIBER_AXIS
 def _ring_accumulate(block_fn, axis_name: str, n_dev: int, u0, *rotating):
     """Accumulate ``block_fn(*rotating)`` over all ring positions.
 
-    ``rotating`` arrays hop to the ring neighbor before each of the
-    iterations 1..n_dev-1 (the final position's blocks are consumed in place —
-    no wasted trailing hop).
+    Each iteration launches the permute of the *next* blocks before computing
+    on the current ones — the two are data-independent, so the ICI hop
+    overlaps with the local block computation. The final position is consumed
+    outside the loop: n_dev-1 hops total, no wasted trailing transfer.
     """
+    if n_dev == 1:
+        return u0 + block_fn(*rotating)
     perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
 
     def step(i, carry):
         u, rot = carry
-        rot = jax.tree_util.tree_map(
+        nxt = jax.tree_util.tree_map(
             lambda a: lax.ppermute(a, axis_name, perm), rot)
         u = u + block_fn(*rot)
-        return u, rot
+        return u, nxt
 
-    u0 = u0 + block_fn(*rotating)
-    if n_dev == 1:
-        return u0
-    u, _ = lax.fori_loop(1, n_dev, step, (u0, tuple(rotating)))
-    return u
+    u, rot = lax.fori_loop(0, n_dev - 1, step, (u0, tuple(rotating)))
+    return u + block_fn(*rot)
 
 
 def _ring_eval(block_fn, mesh: Mesh, axis_name: str, specs, scale, *operands):

@@ -179,8 +179,10 @@ def _assemble(field_fn, speed_fn, x0, dt_init, t_final, abs_err, rel_err,
                                  abs_err, rel_err, sign, max_steps=max_steps,
                                  field_args=field_args)
         # evaluate val only over the recorded extent, not the padded buffer
-        # (short lines would otherwise pay max_steps/n_samples x the kernel cost)
-        used = max(int(batch.count.max()), 1)
+        # (short lines would otherwise pay max_steps/n_samples x the kernel
+        # cost); bucket to a multiple of 64 so val_fn sees a bounded set of
+        # shapes instead of recompiling per distinct line length
+        used = min(-(-max(int(batch.count.max()), 1) // 64) * 64, max_steps)
         x_used = batch.x[:, :used]
         val = val_fn(x_used.reshape(-1, 3), *field_args).reshape(x_used.shape)
         return (np.asarray(x_used), np.asarray(batch.time[:, :used]),

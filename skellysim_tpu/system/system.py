@@ -73,6 +73,10 @@ class System:
 
     def __init__(self, params: Params, shell_shape: PeripheryShape | None = None,
                  mesh=None):
+        if params.pair_evaluator not in ("direct", "ring"):
+            raise ValueError(
+                f"unknown pair_evaluator {params.pair_evaluator!r}; "
+                "runtime values are 'direct' or 'ring'")
         self.params = params
         self.shell_shape = shell_shape
         # device mesh for the ring pair evaluator (params.pair_evaluator="ring");
@@ -89,6 +93,14 @@ class System:
         engages for pure-fiber systems (no shell/body target rows)."""
         ring_ok = (self.params.pair_evaluator == "ring" and self.mesh is not None
                    and state.shell is None and state.bodies is None)
+        if self.params.pair_evaluator == "ring" and not ring_ok:
+            # trace-time (not per-step) diagnostic: silent degradation would
+            # surprise a user expecting O(N/D) per-chip memory
+            import warnings
+
+            why = ("no mesh was configured" if self.mesh is None else
+                   "shell/body target rows require the direct evaluator")
+            warnings.warn(f"pair_evaluator='ring' falls back to 'direct': {why}")
         return fc.flow(state.fibers, caches, r_trg, forces, self.params.eta,
                        subtract_self=subtract_self,
                        evaluator="ring" if ring_ok else "direct",

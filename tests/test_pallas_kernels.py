@@ -1,0 +1,66 @@
+"""Pallas kernels vs XLA direct kernels (interpret mode on CPU).
+
+Extends the backend-consistency matrix (SURVEY.md §4.1) to the Pallas
+backend; on real TPU hardware the same comparisons run compiled.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skellysim_tpu.ops import kernels
+from skellysim_tpu.ops.pallas_kernels import stokeslet_pallas, stresslet_pallas
+
+GATE_F64 = 5e-9   # `kernel_test.cpp:93`
+GATE_F32 = 2e-4   # f32 accumulation over ~1k sources
+
+
+@pytest.fixture(scope="module")
+def cloud():
+    rng = np.random.default_rng(21)
+    n_src, n_trg = 700, 300   # deliberately not tile multiples
+    r_src = rng.uniform(-2, 2, (n_src, 3))
+    r_trg = rng.uniform(-2, 2, (n_trg, 3))
+    f = rng.standard_normal((n_src, 3))
+    return r_src, r_trg, f
+
+
+def _rel_err(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return np.abs(a - b).max() / max(np.abs(b).max(), 1e-300)
+
+
+@pytest.mark.parametrize("dtype,gate", [(jnp.float64, GATE_F64),
+                                        (jnp.float32, GATE_F32)])
+def test_stokeslet_pallas_matches_direct(cloud, dtype, gate):
+    r_src, r_trg, f = (jnp.asarray(a, dtype=dtype) for a in cloud)
+    u_p = stokeslet_pallas(r_src, r_trg, f, 1.3, tile_t=128, tile_s=256,
+                           interpret=True)
+    u_d = kernels.stokeslet_direct(r_src, r_trg, f, 1.3)
+    assert _rel_err(u_p, u_d) < gate
+
+
+def test_stokeslet_pallas_self_term(cloud):
+    """Coincident points drop; padded sources contribute exactly zero."""
+    r_src, _, f = cloud
+    pts = jnp.asarray(r_src, dtype=jnp.float64)
+    ff = jnp.asarray(f, dtype=jnp.float64)
+    u_p = stokeslet_pallas(pts, pts, ff, 1.0, tile_t=128, tile_s=256,
+                           interpret=True)
+    u_d = kernels.stokeslet_direct(pts, pts, ff, 1.0)
+    assert np.all(np.isfinite(np.asarray(u_p)))
+    assert _rel_err(u_p, u_d) < GATE_F64
+
+
+@pytest.mark.parametrize("dtype,gate", [(jnp.float64, GATE_F64),
+                                        (jnp.float32, 5e-4)])
+def test_stresslet_pallas_matches_direct(cloud, dtype, gate):
+    r_src, r_trg, _ = cloud
+    rng = np.random.default_rng(33)
+    S = jnp.asarray(rng.standard_normal((r_src.shape[0], 3, 3)), dtype=dtype)
+    r_src = jnp.asarray(r_src, dtype=dtype)
+    r_trg = jnp.asarray(r_trg, dtype=dtype)
+    u_p = stresslet_pallas(r_src, r_trg, S, 0.8, tile_t=128, tile_s=256,
+                           interpret=True)
+    u_d = kernels.stresslet_direct(r_src, r_trg, S, 0.8)
+    assert _rel_err(u_p, u_d) < gate
