@@ -1,0 +1,109 @@
+"""Parametric kernel-timing harness (not asserted).
+
+Counterpart of the reference's performance drivers
+(`/root/reference/tests/core/performance_hydrodynamics_combined.cpp:36-150`):
+times the pairwise Stokeslet/stresslet backends over log-spaced sizes and
+prints a table of pair-throughput (src*trg pairs/sec). Backends:
+
+  xla     - ops.kernels blocked dense kernels (any platform)
+  pallas  - ops.pallas_kernels fused tiles (TPU; interpret elsewhere unless
+            --allow-interpret, which is orders of magnitude slower)
+  ring    - parallel.ring over all visible devices
+
+Usage:
+  python benchmarks/perf_kernels.py [--n-min 1024] [--n-max 65536]
+      [--ntrials 3] [--kernel stokeslet|stresslet] [--backends xla,pallas]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def _time_call(fn, *args, ntrials=3, **kw):
+    import jax
+
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(ntrials):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / ntrials
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-min", type=int, default=1024)
+    ap.add_argument("--n-max", type=int, default=65536)
+    ap.add_argument("--ntrials", type=int, default=3)
+    ap.add_argument("--kernel", default="stokeslet",
+                    choices=["stokeslet", "stresslet"])
+    ap.add_argument("--backends", default="xla,pallas")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--allow-interpret", action="store_true",
+                    help="run the pallas backend in interpret mode off-TPU")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from skellysim_tpu.ops import kernels, pallas_kernels
+    from skellysim_tpu import parallel
+
+    backends = args.backends.split(",")
+    platform = jax.default_backend()
+    dtype = jnp.dtype(args.dtype)
+    if "pallas" in backends and platform != "tpu" and not args.allow_interpret:
+        print(f"# dropping pallas backend on platform={platform} "
+              "(pass --allow-interpret to keep it, slowly)")
+        backends = [b for b in backends if b != "pallas"]
+
+    mesh = parallel.make_mesh() if "ring" in backends else None
+
+    sizes = []
+    n = args.n_min
+    while n <= args.n_max:
+        sizes.append(n)
+        n *= 2
+
+    rng = np.random.default_rng(0)
+    print(f"# platform={platform} devices={jax.device_count()} "
+          f"kernel={args.kernel} dtype={dtype.name} ntrials={args.ntrials}")
+    print(f"{'n':>8} {'backend':>8} {'sec/eval':>12} {'pairs/sec':>14}")
+
+    for n in sizes:
+        r = jnp.asarray(rng.uniform(-5, 5, (n, 3)), dtype=dtype)
+        if args.kernel == "stokeslet":
+            f = jnp.asarray(rng.standard_normal((n, 3)), dtype=dtype)
+            calls = {
+                "xla": lambda: kernels.stokeslet_direct(r, r, f, 1.0),
+                "pallas": lambda: pallas_kernels.stokeslet_pallas(
+                    r, r, f, 1.0, interpret=(platform != "tpu")),
+                "ring": (lambda: parallel.ring_stokeslet(r, r, f, 1.0,
+                                                         mesh=mesh))
+                if mesh and n % mesh.size == 0 else None,
+            }
+        else:
+            S = jnp.asarray(rng.standard_normal((n, 3, 3)), dtype=dtype)
+            calls = {
+                "xla": lambda: kernels.stresslet_direct(r, r, S, 1.0),
+                "pallas": lambda: pallas_kernels.stresslet_pallas(
+                    r, r, S, 1.0, interpret=(platform != "tpu")),
+                "ring": (lambda: parallel.ring_stresslet(r, r, S, 1.0,
+                                                         mesh=mesh))
+                if mesh and n % mesh.size == 0 else None,
+            }
+        for b in backends:
+            call = calls.get(b)
+            if call is None:
+                continue
+            dt = _time_call(lambda: call(), ntrials=args.ntrials)
+            print(f"{n:>8} {b:>8} {dt:>12.3e} {n * n / dt:>14.3e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Deformable body: declared interface, deliberately unimplemented.
+
+Parity marker for the reference's `DeformableBody` stub
+(`/root/reference/include/body_deformable.hpp:17-47`,
+`src/core/body_deformable.cpp:13-41`): the reference declares a 4-unknowns-
+per-node deformable surface but every method is an empty body and
+`flow_deformable` throws (`body_container.cpp:449-463`). We keep the same
+surface so configs selecting it fail loudly at build time rather than
+silently producing a rigid body.
+"""
+
+from __future__ import annotations
+
+
+class DeformableBodyNotImplemented(NotImplementedError):
+    pass
+
+
+SOLUTION_PER_NODE = 4  # `body_deformable.hpp:35`: get_solution_size = 4 * n
+
+
+def make_group(*args, **kwargs):
+    raise DeformableBodyNotImplemented(
+        "deformable bodies are declared but not implemented (matching the "
+        "reference stub: `body_deformable.cpp:13-41`, flow_deformable throws "
+        "at `body_container.cpp:449-463`)")

@@ -63,6 +63,10 @@ def build_fibers(cfg_fibers: list, dtype) -> fc.FiberGroup | None:
 def build_bodies(cfg_bodies: list, config_dir: str, dtype) -> bd.BodyGroup | None:
     if not cfg_bodies:
         return None
+    if any(b.shape == "deformable" for b in cfg_bodies):
+        from .bodies import deformable
+
+        deformable.make_group()  # raises: declared-but-unimplemented parity stub
     pre = [_load_npz(os.path.join(config_dir, b.precompute_file), "body")
            for b in cfg_bodies]
     n_nodes = {p["node_positions_ref"].shape[0] for p in pre}

@@ -136,6 +136,36 @@ class TrajectoryWriter:
         self.close()
 
 
+class FieldWriter:
+    """Appends velocity-field frames {time, dt, x_grid, v_grid} readable by
+    `paraview_utils/field_reader.py` (the reference's `skelly_sim.vf` layout:
+    point clouds in the 3 x n ``__eigen__`` encoding)."""
+
+    def __init__(self, path: str = "skelly_sim.vf", *, append: bool = False):
+        self.path = path
+        self._fh = open(path, "ab" if append else "wb")
+
+    def write_frame(self, time: float, positions, velocities, dt: float = 0.0):
+        x = np.asarray(positions, dtype=np.float64).reshape(-1, 3)
+        v = np.asarray(velocities, dtype=np.float64).reshape(-1, 3)
+        self._fh.write(msgpack.packb({
+            "time": float(time),
+            "dt": float(dt),
+            "x_grid": eigen.pack_matrix(x),
+            "v_grid": eigen.pack_matrix(v),
+        }))
+        self._fh.flush()
+
+    def close(self):
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 # --------------------------------------------------------------------- index
 
 def _scan_native(path: str):

@@ -1,0 +1,89 @@
+"""ParaView reader support: standalone trajectory utility + field writer.
+
+The vtk-dependent reader scripts can't run here; the shared indexer/loader
+and the wire-format helpers they consume are tested against trajectories
+written by this framework.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+
+from skellysim_tpu.io.trajectory import FieldWriter
+
+
+def _load_utility():
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "skellysim_tpu", "paraview_utils", "trajectory_utility.py")
+    spec = importlib.util.spec_from_file_location("trajectory_utility", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)   # standalone, like ParaView would exec it
+    return mod
+
+
+def _write_sim(tmp_path):
+    from skellysim_tpu.config import BackgroundSource, Config, Fiber
+    from skellysim_tpu import cli
+
+    cfg = Config()
+    cfg.params.dt_initial = 0.005
+    cfg.params.dt_write = 0.005
+    cfg.params.t_final = 0.015
+    cfg.params.adaptive_timestep_flag = False
+    fib = Fiber(n_nodes=16, length=1.0, bending_rigidity=0.01)
+    fib.fill_node_positions(np.zeros(3), np.array([0.0, 0.0, 1.0]))
+    cfg.fibers = [fib]
+    cfg.background = BackgroundSource(uniform=[1.0, 0.0, 0.0])
+    path = str(tmp_path / "skelly_config.toml")
+    cfg.save(path)
+    cli.run(path)
+    return str(tmp_path / "skelly_sim.out")
+
+
+def test_get_frame_info_and_load_frame(tmp_path):
+    traj = _write_sim(tmp_path)
+    util = _load_utility()
+    fhs, fpos, times = util.get_frame_info([traj])
+    assert len(times) >= 2 and times == sorted(times)
+
+    frame = util.load_frame(fhs, fpos, len(times) - 1)
+    assert frame["time"] == times[-1]
+    assert len(frame["fibers"]) == 1
+    pts = util.eigen_points(frame["fibers"][0]["x_"])
+    assert len(pts) == 16 and len(pts[0]) == 3
+    # advected by the uniform background: x-coordinate moved forward
+    assert pts[0][0] > 0.0
+    for fh in fhs:
+        fh.close()
+
+
+def test_field_writer_roundtrip(tmp_path):
+    util = _load_utility()
+    path = str(tmp_path / "skelly_sim.vf")
+    x = np.arange(12.0).reshape(4, 3)
+    v = np.ones((4, 3)) * [1.0, 2.0, 3.0]
+    with FieldWriter(path) as fw:
+        fw.write_frame(0.0, x, v)
+        fw.write_frame(1.0, x + 1, v)
+
+    fhs, fpos, times = util.get_frame_info([path])
+    assert times == [0.0, 1.0]
+    frames = util.load_field_frame(fhs, fpos, 0)
+    assert frames[0]["x_grid"][2] == 4  # cols of the 3 x n encoding
+    np.testing.assert_allclose(frames[0]["x_grid"][3:6], x[0])
+    np.testing.assert_allclose(frames[0]["v_grid"][3:6], [1.0, 2.0, 3.0])
+    for fh in fhs:
+        fh.close()
+
+
+def test_deformable_body_stub_raises(tmp_path):
+    from skellysim_tpu import builder
+    from skellysim_tpu.bodies.deformable import DeformableBodyNotImplemented
+    from skellysim_tpu.config import Body
+
+    import pytest
+
+    with pytest.raises(DeformableBodyNotImplemented):
+        builder.build_bodies([Body(shape="deformable")], str(tmp_path),
+                             np.float64)
