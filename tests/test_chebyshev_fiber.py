@@ -1,0 +1,173 @@
+"""Chebyshev spectral machinery + penalty fiber.
+
+Oracles: numpy.polynomial.chebyshev for the spectral operators (the reference
+validates against committed Julia results from the same formulas,
+`unit_test_skelly_chebyshev.cpp`); structural identities (derivative of the
+integral, reconstruction of known polynomials) for the integrated
+representation; and physical behavior (clamped end pinned, deflection in
+shear, near-inextensibility) for the Newton-evolved fiber
+(`jnewton_fiberpenalty_test.cpp:34-80`).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import numpy.polynomial.chebyshev as npcheb
+import pytest
+
+from skellysim_tpu.fibers import chebyshev as cheb
+from skellysim_tpu.fibers import chebyshev_fiber as cf
+
+
+# ------------------------------------------------------------- spectral ops
+
+def test_chebyshev_points_are_reversed_gauss_nodes():
+    n = 16
+    pts = cheb.chebyshev_points(n)
+    # same set as numpy's first-kind Gauss points, in ascending order
+    np.testing.assert_allclose(pts, sorted(npcheb.chebpts1(n)), atol=1e-14)
+    assert np.all(np.diff(pts) > 0)
+
+    scaled = cheb.chebyshev_points(n, 0.0, 2.0)
+    np.testing.assert_allclose(scaled, pts + 1.0, atol=1e-14)
+
+
+def test_vandermonde_matches_numpy_chebvander():
+    n = 12
+    x = cheb.chebyshev_points(n)
+    np.testing.assert_allclose(cheb.vander(x, n - 1), npcheb.chebvander(x, n - 1),
+                               atol=1e-13)
+    np.testing.assert_allclose(cheb.vandermonde(n) @ cheb.inverse_vandermonde(n),
+                               np.eye(n), atol=1e-10)
+
+
+def test_derivative_coeffs_match_numpy_chebder():
+    rng = np.random.default_rng(3)
+    for size in (2, 5, 9, 16):
+        p = rng.standard_normal(size)
+        np.testing.assert_allclose(cheb.derivative_coeffs(p),
+                                   npcheb.chebder(p), atol=1e-12)
+
+
+def test_derivative_matrix_differentiates():
+    n = 14
+    rng = np.random.default_rng(5)
+    p = rng.standard_normal(n)
+    D1 = cheb.derivative_matrix(n, 1)
+    np.testing.assert_allclose(D1 @ p, npcheb.chebder(p), atol=1e-11)
+    D2 = cheb.derivative_matrix(n, 2)
+    np.testing.assert_allclose(D2 @ p, npcheb.chebder(p, 2), atol=1e-10)
+    # scale factor applies per derivative order
+    D2s = cheb.derivative_matrix(n, 2, scale_factor=3.0)
+    np.testing.assert_allclose(D2s @ p, 9.0 * npcheb.chebder(p, 2), atol=1e-9)
+
+
+def test_integration_matrix_inverts_derivative():
+    n = 12
+    rng = np.random.default_rng(7)
+    p = rng.standard_normal(n)
+    IM = cheb.integration_matrix(n)
+    D1 = cheb.derivative_matrix(n, 1)
+    # d/dx of the antiderivative recovers the series (up to truncation)
+    q = IM @ p
+    np.testing.assert_allclose(D1 @ q, p[:-1], atol=1e-10)
+    # the IntegrationMatrix construction pins the value at x = -1 via its
+    # bottom input row; the value row of the inverse reproduces it
+    np.testing.assert_allclose(npcheb.chebval(-1.0, q), p[-1], atol=1e-10)
+
+
+def test_multiply_matches_numpy_chebmul():
+    rng = np.random.default_rng(9)
+    a, b = rng.standard_normal(6), rng.standard_normal(6)
+    full = npcheb.chebmul(a, b)
+    got = np.asarray(cheb.multiply(jnp.asarray(a), jnp.asarray(b), "c", "c", "c",
+                                   n_out=11, nm=16))
+    np.testing.assert_allclose(got, full, atol=1e-12)
+
+
+def test_evalpoly_clenshaw():
+    rng = np.random.default_rng(11)
+    p = rng.standard_normal(8)
+    for x in (-1.0, -0.3, 0.5, 1.0):
+        np.testing.assert_allclose(float(cheb.evalpoly(x, jnp.asarray(p))),
+                                   npcheb.chebval(x, p), atol=1e-12)
+
+
+# ---------------------------------------------- integrated representation
+
+def test_divide_and_construct_derivative_chain():
+    """The constructed caches satisfy d/ds X^(k) = X^(k+1) with the [0, L]
+    arclength scaling."""
+    N, L = 16, 2.0
+    solver = cf.FiberSolverChebyshevPenalty(N, N - 2, N - 4, N - 6)
+    rng = np.random.default_rng(13)
+    XX = jnp.asarray(rng.standard_normal(solver.solution_size))
+    div = solver.divide_and_construct(XX, L)
+
+    Neq = solver.n_equations
+    scale = 2.0 / L  # d/ds = (2/L) d/dx on the mapped domain
+    D1 = cheb.derivative_matrix(Neq, 1, scale_factor=scale)
+    for lo, hi in [(div.XC, div.XsC), (div.XsC, div.XssC),
+                   (div.XssC, div.XsssC), (div.XsssC, div.XssssC),
+                   (div.YC, div.YsC), (div.TC, div.TsC), (div.TsC, div.TssC)]:
+        D = cheb.derivative_matrix(lo.shape[0], 1, scale_factor=scale)
+        np.testing.assert_allclose(np.asarray(D @ lo),
+                                   np.asarray(hi)[:lo.shape[0] - 1], atol=1e-9)
+
+
+def test_initial_state_is_straight_vertical_fiber():
+    N, L = 20, 1.0
+    solver, XX = cf.setup_solver_initialstate(N, L)
+    x, y = cf.node_positions(solver, XX, L)
+    np.testing.assert_allclose(np.asarray(x), 0.0, atol=1e-12)
+    # y runs over [0, L] along the arclength nodes
+    np.testing.assert_allclose(np.asarray(y),
+                               cheb.chebyshev_points(N - 4, 0.0, L), atol=1e-10)
+    err = float(cf.extensibility_error(solver, XX, L))
+    assert err < 1e-12
+
+
+# ------------------------------------------------------- Newton + evolution
+
+def test_newton_shear_evolution():
+    """Single-Newton backward Euler in shear flow: the clamped end stays
+    pinned with vertical director, the free end deflects downstream, and the
+    penalty keeps the fiber nearly inextensible
+    (`jnewton_fiberpenalty_test.cpp:68-120` behavior)."""
+    N, L, zeta, dt = 20, 1.0, 1.0, 0.005
+    solver, XX = cf.setup_solver_initialstate(N, L)
+
+    final, ext_errors = cf.evolve(solver, XX, L=L, zeta=zeta, dt=dt, n_steps=20)
+    div = solver.divide_and_construct(final, L)
+
+    # clamp: x(0) = y(0) = 0, (xs, ys)(0) = (0, 1)
+    assert abs(float(cheb.left_eval(div.XC))) < 1e-8
+    assert abs(float(cheb.left_eval(div.YC))) < 1e-8
+    assert abs(float(cheb.left_eval(div.XsC))) < 1e-6
+    assert abs(float(cheb.left_eval(div.YsC)) - 1.0) < 1e-6
+
+    # shear pushes the free end in +x; the tip still sits near height L
+    assert float(cheb.right_eval(div.XC)) > 1e-3
+    assert float(cheb.right_eval(div.YC)) > 0.9 * L
+
+    # penalty inextensibility
+    assert float(ext_errors[-1]) < 5e-2
+    assert np.all(np.isfinite(np.asarray(final)))
+
+
+def test_single_newton_step_solves_linearized_system_exactly():
+    """The penalty objective pairs every current-state factor with old-state
+    coefficients, so it is linear in XX and one Newton step lands at machine
+    precision — the property the reference's single-Newton backward Euler
+    (`jnewton_fiberpenalty_test.cpp:55-66`) relies on."""
+    N, L, zeta, dt = 16, 1.0, 0.5, 0.01
+    solver, XX = cf.setup_solver_initialstate(N, L)
+
+    old = XX
+    r0 = np.abs(np.asarray(
+        cf.sheer_deflection_objective(XX, solver, old, L, zeta, dt))).max()
+    x1 = cf.newton_step(solver, XX, old, L, zeta, dt)
+    r1 = np.abs(np.asarray(
+        cf.sheer_deflection_objective(x1, solver, old, L, zeta, dt))).max()
+    assert r0 > 1e-6      # the un-updated state does not satisfy the step
+    assert r1 < 1e-10     # one Newton solve does, exactly
