@@ -50,14 +50,22 @@ def vander(x: np.ndarray, n: int) -> np.ndarray:
     return A
 
 
+def _frozen(a: np.ndarray) -> np.ndarray:
+    """Cached builders hand out read-only arrays: a caller mutating the
+    result would otherwise poison the cache process-wide. Callers that need
+    to edit (e.g. zeroing the integration matrix's T_0 row) must copy."""
+    a.setflags(write=False)
+    return a
+
+
 @lru_cache(maxsize=None)
 def vandermonde(order: int) -> np.ndarray:
-    return vander(chebyshev_points(order), order - 1)
+    return _frozen(vander(chebyshev_points(order), order - 1))
 
 
 @lru_cache(maxsize=None)
 def inverse_vandermonde(order: int) -> np.ndarray:
-    return np.linalg.inv(vandermonde(order))
+    return _frozen(np.linalg.inv(vandermonde(order)))
 
 
 def toggle_representation_matrix(op: np.ndarray, op_in: str, op_out: str,
@@ -114,7 +122,7 @@ def derivative_matrix(n: int, D: int, in_type: str = C, out_type: str = C,
         col = nth_derivative_of_Tn(i, D)
         DM[:len(col), i] = col[:n - D]
     DM = DM * scale_factor ** D
-    return toggle_representation_matrix(DM, C, C, in_type, out_type)
+    return _frozen(toggle_representation_matrix(DM, C, C, in_type, out_type))
 
 
 @lru_cache(maxsize=None)
@@ -125,8 +133,8 @@ def integration_matrix(order: int, in_type: str = C, out_type: str = C,
     DMat = derivative_matrix(order, 1, C, C, scale_factor)
     VM = vander(np.array([-1.0]), order - 1)
     A = np.vstack([DMat, VM])
-    return toggle_representation_matrix(np.linalg.inv(A), C, C, in_type,
-                                        out_type)
+    return _frozen(toggle_representation_matrix(np.linalg.inv(A), C, C,
+                                                in_type, out_type))
 
 
 # ------------------------------------------------- runtime (jnp) vector ops

@@ -18,6 +18,7 @@ exercised-by-tests experimental component.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -155,10 +156,11 @@ def fiber_evolution(AFxC, AFyC, div: FiberState, odiv: FiberState, UC, VC,
     return eqXC, eqYC
 
 
-def fiber_penalty_tension(div: FiberState, odiv: FiberState, UsC, VsC, oUsC,
-                          oVsC, dt: float, n_eq_T: int):
+def fiber_penalty_tension(div: FiberState, odiv: FiberState, UsC, VsC,
+                          dt: float, n_eq_T: int):
     """Penalty tension residual (`FiberPenaltyTension`,
-    `skelly_fiber.hpp:84-130`)."""
+    `skelly_fiber.hpp:84-130`; the reference's vestigial nUsC/nVsC arguments
+    are unused there and dropped here)."""
     m = cheb.multiply
     WXC = (7.0 * m(odiv.XssC, div.XssssC, "c", "c", "c", n_eq_T)
            + 6.0 * m(odiv.XsssC, div.XsssC, "c", "c", "c", n_eq_T))
@@ -212,11 +214,9 @@ def sheer_deflection_objective(XX, solver: FiberSolverChebyshevPenalty, oldXX,
     VC = jnp.zeros_like(div.YC)
     UsC = zeta * div.YsC
     VsC = jnp.zeros_like(div.YsC)
-    oUsC = zeta * odiv.YsC
-    oVsC = jnp.zeros_like(odiv.YsC)
 
     teqXC, teqYC = fiber_evolution(AFxC, AFyC, div, odiv, UC, VC, dt)
-    teqTC = fiber_penalty_tension(div, odiv, UsC, VsC, oUsC, oVsC, dt,
+    teqTC = fiber_penalty_tension(div, odiv, UsC, VsC, dt,
                                   solver.n_equations_tension)
 
     cpos = jnp.zeros((2,), dtype=XX.dtype)
@@ -258,41 +258,45 @@ def newton_step(solver: FiberSolverChebyshevPenalty, XX, oldXX, L, zeta, dt):
     return XX - jnp.linalg.solve(J, F)
 
 
+@partial(jax.jit, static_argnames=("solver", "n_steps", "newton_iterations"))
+def _evolve_impl(solver, XX, L, zeta, dt, n_steps, newton_iterations):
+    def step(carry, _):
+        x = carry
+        old = x
+        for _ in range(newton_iterations):
+            x = newton_step(solver, x, old, L, zeta, dt)
+        return x, _extensibility_error_state(solver.divide_and_construct(x, L))
+
+    return jax.lax.scan(step, XX, None, length=n_steps)
+
+
 def evolve(solver: FiberSolverChebyshevPenalty, XX, *, L: float, zeta: float,
            dt: float, n_steps: int, newton_iterations: int = 1):
     """Backward-Euler time loop with single (or multi) Newton updates per step
     (`UpdateSingleNewtonBackwardEuler`, `jnewton_fiberpenalty_test.cpp:55-66`).
-    jit'd as one lax.scan program."""
+    One jit'd lax.scan program, cached per (solver, n_steps) so parameter
+    sweeps compile once."""
+    return _evolve_impl(solver, XX, L, zeta, dt, n_steps, newton_iterations)
 
-    @jax.jit
-    def run(XX):
-        def step(carry, _):
-            x = carry
-            old = x
-            for _ in range(newton_iterations):
-                x = newton_step(solver, x, old, L, zeta, dt)
-            return x, extensibility_error(solver, x, L)
 
-        return jax.lax.scan(step, XX, None, length=n_steps)
-
-    return run(XX)
+def _extensibility_error_state(div: FiberState):
+    m = cheb.multiply
+    W = (m(div.XsC, div.XsC, "c", "c", "n") + m(div.YsC, div.YsC, "c", "c", "n")
+         - 1.0)
+    return jnp.max(jnp.abs(W))
 
 
 def extricate(solver: FiberSolverChebyshevPenalty, XX, L: float):
     """(XC, YC, TC, extensibility error) (`Extricate`,
     `fiber_chebyshev_penalty_autodiff.hpp:266-274`)."""
     div = solver.divide_and_construct(XX, L)
-    return div.XC, div.YC, div.TC, extensibility_error(solver, XX, L)
+    return div.XC, div.YC, div.TC, _extensibility_error_state(div)
 
 
 def extensibility_error(solver: FiberSolverChebyshevPenalty, XX, L: float):
     """max |Xs.Xs + Ys.Ys - 1| (`ExtensibilityError`,
     `skelly_fiber.hpp:216-236`)."""
-    div = solver.divide_and_construct(XX, L)
-    m = cheb.multiply
-    W = (m(div.XsC, div.XsC, "c", "c", "n") + m(div.YsC, div.YsC, "c", "c", "n")
-         - 1.0)
-    return jnp.max(jnp.abs(W))
+    return _extensibility_error_state(solver.divide_and_construct(XX, L))
 
 
 def node_positions(solver: FiberSolverChebyshevPenalty, XX, L: float):

@@ -9,11 +9,9 @@ shear, near-inextensibility) for the Newton-evolved fiber
 (`jnewton_fiberpenalty_test.cpp:34-80`).
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import numpy.polynomial.chebyshev as npcheb
-import pytest
 
 from skellysim_tpu.fibers import chebyshev as cheb
 from skellysim_tpu.fibers import chebyshev_fiber as cf
@@ -104,9 +102,7 @@ def test_divide_and_construct_derivative_chain():
     XX = jnp.asarray(rng.standard_normal(solver.solution_size))
     div = solver.divide_and_construct(XX, L)
 
-    Neq = solver.n_equations
     scale = 2.0 / L  # d/ds = (2/L) d/dx on the mapped domain
-    D1 = cheb.derivative_matrix(Neq, 1, scale_factor=scale)
     for lo, hi in [(div.XC, div.XsC), (div.XsC, div.XssC),
                    (div.XssC, div.XsssC), (div.XsssC, div.XssssC),
                    (div.YC, div.YsC), (div.TC, div.TsC), (div.TsC, div.TssC)]:
