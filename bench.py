@@ -58,16 +58,32 @@ def main():
     u = kernels.stokeslet_direct(r, r, f, 1.0)
     u.block_until_ready()  # compile + warm
     trials = 3
-    t0 = time.perf_counter()
-    for _ in range(trials):
-        u = kernels.stokeslet_direct(r, r, f, 1.0)
-        u.block_until_ready()
-    dt = (time.perf_counter() - t0) / trials
-    pairs_per_s = n * n / dt
+
+    def rate(fn):
+        fn().block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            out = fn()
+        out.block_until_ready()
+        return n * n * trials / (time.perf_counter() - t0)
+
+    pairs_per_s = rate(lambda: kernels.stokeslet_direct(r, r, f, 1.0))
+    backend = "xla"
+    if jax.default_backend() == "tpu":
+        # the fused Pallas tiles usually beat the blocked XLA kernel on-chip;
+        # report whichever wins so the headline tracks the best path
+        from skellysim_tpu.ops.pallas_kernels import stokeslet_pallas
+
+        try:
+            pallas_rate = rate(lambda: stokeslet_pallas(r, r, f, 1.0))
+            if pallas_rate > pairs_per_s:
+                pairs_per_s, backend = pallas_rate, "pallas"
+        except Exception as e:
+            print(f"# pallas path failed ({e}); keeping xla", flush=True)
 
     baseline = _numpy_pairs_per_s()
     print(json.dumps({
-        "metric": f"stokeslet_mobility_matvec_throughput_n{n}",
+        "metric": f"stokeslet_mobility_matvec_throughput_n{n}_{backend}",
         "value": round(pairs_per_s / 1e9, 4),
         "unit": "Gpairs/s/chip",
         "vs_baseline": round(pairs_per_s / baseline, 2),

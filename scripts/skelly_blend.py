@@ -1,0 +1,151 @@
+"""Blender rendering script for skellysim_tpu trajectories.
+
+Counterpart of the reference's `scripts/skelly_blend.py` (rendering toolkit,
+SURVEY.md §2.2 P12): run inside Blender's Python
+(`blender --python scripts/skelly_blend.py -- --traj skelly_sim.out`), it
+builds animated curve objects for fibers, UV spheres for rigid bodies, and a
+transparent shell for a spherical periphery, with one keyframe per trajectory
+frame. Only needs msgpack/toml (auto-installed into Blender's Python on first
+run, like the reference script does).
+"""
+
+import argparse
+import os
+import site
+import subprocess
+import sys
+
+try:
+    import bpy
+except ImportError:
+    sys.exit("run inside Blender: blender --python scripts/skelly_blend.py "
+             "-- --traj skelly_sim.out")
+
+site_dir = site.getusersitepackages()
+if site_dir not in sys.path:
+    sys.path.append(site_dir)
+
+try:
+    import msgpack
+    import toml
+except ImportError:
+    PYTHON = sys.executable
+    subprocess.call([PYTHON, "-m", "ensurepip"])
+    subprocess.call([PYTHON, "-m", "pip", "install", "--user", "msgpack", "toml"])
+    import msgpack
+    import toml
+
+
+def read_frames(path):
+    """All trajectory frames (skips the header)."""
+    frames = []
+    with open(path, "rb") as fh:
+        unpacker = msgpack.Unpacker(fh, raw=False)
+        for obj in unpacker:
+            if isinstance(obj, dict) and "time" in obj:
+                frames.append(obj)
+    return frames
+
+
+def eigen_points(field):
+    rows, cols = field[1], field[2]
+    flat = field[3:]
+    n = cols if rows == 3 else len(flat) // 3
+    return [flat[3 * i:3 * i + 3] for i in range(n)]
+
+
+def make_material(name, rgba, alpha=1.0):
+    mat = bpy.data.materials.get(name) or bpy.data.materials.new(name)
+    mat.use_nodes = True
+    bsdf = mat.node_tree.nodes["Principled BSDF"]
+    bsdf.inputs["Base Color"].default_value = rgba
+    bsdf.inputs["Alpha"].default_value = alpha
+    mat.blend_method = "BLEND" if alpha < 1.0 else "OPAQUE"
+    return mat
+
+
+def add_fiber_curve(name, points, radius, mat):
+    curve = bpy.data.curves.new(name, type="CURVE")
+    curve.dimensions = "3D"
+    curve.bevel_depth = radius
+    spline = curve.splines.new("POLY")
+    spline.points.add(len(points) - 1)
+    for p, xyz in zip(spline.points, points):
+        p.co = (*xyz, 1.0)
+    obj = bpy.data.objects.new(name, curve)
+    obj.data.materials.append(mat)
+    bpy.context.collection.objects.link(obj)
+    return obj
+
+
+def add_sphere(name, center, radius, mat, segments=32):
+    bpy.ops.mesh.primitive_uv_sphere_add(radius=radius, location=center,
+                                         segments=segments)
+    obj = bpy.context.active_object
+    obj.name = name
+    obj.data.materials.append(mat)
+    bpy.ops.object.shade_smooth()
+    return obj
+
+
+def animate(frames, config, fiber_radius_scale):
+    fiber_mat = make_material("skelly_fiber", (0.8, 0.2, 0.2, 1.0))
+    body_mat = make_material("skelly_body", (0.2, 0.4, 0.8, 1.0))
+    shell_mat = make_material("skelly_shell", (0.9, 0.9, 0.9, 1.0), alpha=0.15)
+
+    periphery = config.get("periphery")
+    if periphery and periphery.get("shape", "sphere") == "sphere":
+        add_sphere("periphery", (0, 0, 0), periphery.get("radius", 1.0),
+                   shell_mat, segments=64)
+
+    body_cfgs = config.get("bodies", [])
+    first = frames[0]
+    fiber_objs, body_objs = [], []
+    for i, fib in enumerate(first["fibers"][1]):
+        pts = eigen_points(fib["x_"])
+        radius = fiber_radius_scale * fib.get("radius_", 0.0125)
+        fiber_objs.append(add_fiber_curve(f"fiber_{i}", pts, radius, fiber_mat))
+    bodies0 = [b for sub in first["bodies"] for b in sub]
+    for i, body in enumerate(bodies0):
+        radius = body_cfgs[i]["radius"] if i < len(body_cfgs) else body.get("radius_", 0.5)
+        body_objs.append(add_sphere(f"body_{i}", body["position_"][3:6],
+                                    radius, body_mat))
+
+    scene = bpy.context.scene
+    scene.frame_start = 1
+    scene.frame_end = len(frames)
+    for f_idx, frame in enumerate(frames, start=1):
+        scene.frame_set(f_idx)
+        for i, fib in enumerate(frame["fibers"][1]):
+            if i >= len(fiber_objs):
+                break
+            pts = eigen_points(fib["x_"])
+            spline = fiber_objs[i].data.splines[0]
+            for p, xyz in zip(spline.points, pts):
+                p.co = (*xyz, 1.0)
+                p.keyframe_insert("co", frame=f_idx)
+        bodies = [b for sub in frame["bodies"] for b in sub]
+        for i, body in enumerate(bodies):
+            if i >= len(body_objs):
+                break
+            body_objs[i].location = body["position_"][3:6]
+            body_objs[i].keyframe_insert("location", frame=f_idx)
+
+
+def main():
+    argv = sys.argv[sys.argv.index("--") + 1:] if "--" in sys.argv else []
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--traj", default="skelly_sim.out")
+    ap.add_argument("--config", default="skelly_config.toml")
+    ap.add_argument("--fiber-radius-scale", type=float, default=1.0)
+    args = ap.parse_args(argv)
+
+    frames = read_frames(args.traj)
+    if not frames:
+        sys.exit(f"no frames in {args.traj}")
+    config = toml.load(args.config) if os.path.exists(args.config) else {}
+    animate(frames, config, args.fiber_radius_scale)
+    print(f"Built {len(frames)} animation frames from {args.traj}")
+
+
+main()

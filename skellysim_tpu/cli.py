@@ -32,7 +32,8 @@ def _snapshot_path(traj: str, suffix: str) -> str:
 
 
 def run(config_file: str, resume: bool = False, overwrite: bool = False,
-        trajectory_path: str | None = None) -> None:
+        trajectory_path: str | None = None,
+        metrics_path: str | None = None) -> None:
     traj = trajectory_path or os.path.join(
         os.path.dirname(os.path.abspath(config_file)) or ".", TRAJECTORY_FILE)
 
@@ -59,7 +60,8 @@ def run(config_file: str, resume: bool = False, overwrite: bool = False,
         writer.write_frame(state, rng_state=rng.dump_state())
 
     with writer:
-        final = system.run(state, writer=writer.write_frame, rng=rng)
+        final = system.run(state, writer=writer.write_frame, rng=rng,
+                           metrics_path=metrics_path)
 
     shutil.copyfile(config_file, _snapshot_path(traj, "final_config"))
     print(f"Finished at t={float(final.time):.6g}")
@@ -76,13 +78,25 @@ def main(argv=None) -> None:
                     help="overwrite an existing trajectory")
     ap.add_argument("--listen", action="store_true",
                     help="post-processing server: msgpack requests on stdin")
+    ap.add_argument("--metrics-file", default=None,
+                    help="append one JSON line of step metrics per trial step")
+    ap.add_argument("--log-level", default=os.environ.get("SKELLYSIM_LOG", "INFO"),
+                    help="log level for the skellysim_tpu logger "
+                         "(the reference reads SPDLOG_LEVEL similarly)")
     args = ap.parse_args(argv)
+
+    import logging
+
+    logging.basicConfig(level=args.log_level.upper(),
+                        format="[%(asctime)s] [%(levelname)s] %(message)s",
+                        stream=sys.stderr)
 
     if args.listen:
         from .listener import serve  # deferred: heavy post-processing imports
         serve(args.config_file)
         return
-    run(args.config_file, resume=args.resume, overwrite=args.overwrite)
+    run(args.config_file, resume=args.resume, overwrite=args.overwrite,
+        metrics_path=args.metrics_file)
 
 
 if __name__ == "__main__":

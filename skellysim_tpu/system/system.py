@@ -13,10 +13,15 @@ The solution vector layout matches the reference (`system.cpp:75-96`):
 
 from __future__ import annotations
 
+import json
+import logging
+import time as _time
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+
+logger = logging.getLogger("skellysim_tpu")
 
 from ..bodies import bodies as bd
 from ..fibers import container as fc
@@ -487,7 +492,7 @@ class System:
         return self._solve_jit(state)
 
     def run(self, state: SimState, *, writer=None, max_steps: int | None = None,
-            rng=None):
+            rng=None, metrics_path: str | None = None):
         """Adaptive time loop (`run`, `system.cpp:516-571`).
 
         Host-side control flow around the jit'd step: accept/reject on fiber
@@ -498,18 +503,27 @@ class System:
         dynamic instability when `params.dynamic_instability.n_nodes > 0`
         (`prep_state_for_solver`, `system.cpp:403`); like the reference, a
         rejected step does not rewind the RNG.
+
+        Each trial step is logged (the reference's per-step spdlog lines,
+        `system.cpp:474,567`); ``metrics_path`` additionally appends one JSON
+        line per step {t, dt, iters, residual, fiber_error, accepted, wall_s}
+        — the structured-metrics upgrade SURVEY.md §5.1 calls for.
         """
         from .dynamic_instability import apply_dynamic_instability
 
         p = self.params
         n_steps = 0
+        metrics_fh = open(metrics_path, "a") if metrics_path else None
         while float(state.time) < p.t_final:
             if max_steps is not None and n_steps >= max_steps:
                 break
             backup = state
             if rng is not None and p.dynamic_instability.n_nodes > 0:
                 state = apply_dynamic_instability(state, p, rng)
+            wall0 = _time.perf_counter()
             new_state, solution, info = self.step(state)
+            jax.block_until_ready(info.residual)
+            wall_s = _time.perf_counter() - wall0
             n_steps += 1
             converged = bool(info.converged)
             fiber_error = float(info.fiber_error)
@@ -533,6 +547,19 @@ class System:
                 if dt_new < p.dt_min:
                     raise RuntimeError("Timestep smaller than dt_min")
 
+            logger.info(
+                "step t=%.6g dt=%.4g iters=%d residual=%.3e fiber_error=%.3e "
+                "%s (%.3fs)", float(state.time), dt, int(info.iters),
+                float(info.residual), fiber_error,
+                "accepted" if accept else "rejected", wall_s)
+            if metrics_fh is not None:
+                metrics_fh.write(json.dumps({
+                    "t": float(state.time), "dt": dt, "iters": int(info.iters),
+                    "residual": float(info.residual),
+                    "fiber_error": fiber_error, "accepted": accept,
+                    "wall_s": round(wall_s, 4)}) + "\n")
+                metrics_fh.flush()
+
             if accept:
                 t_new = float(state.time) + dt
                 state = new_state._replace(
@@ -546,4 +573,6 @@ class System:
                         writer(state, solution)
             else:
                 state = backup._replace(dt=jnp.asarray(dt_new, dtype=state.dt.dtype))
+        if metrics_fh is not None:
+            metrics_fh.close()
         return state

@@ -33,6 +33,22 @@ def _free_fiber_config(tmp_path, n_nodes=16):
     return path
 
 
+def test_cli_metrics_file(tmp_path):
+    """--metrics-file appends one JSON step record per trial step
+    (structured metrics, SURVEY.md §5.1/§5.5)."""
+    import json
+
+    cfg_path = _free_fiber_config(tmp_path)
+    metrics = str(tmp_path / "metrics.jsonl")
+    cli.run(cfg_path, metrics_path=metrics)
+    lines = [json.loads(ln) for ln in open(metrics)]
+    assert len(lines) >= 2
+    for rec in lines:
+        assert set(rec) == {"t", "dt", "iters", "residual", "fiber_error",
+                            "accepted", "wall_s"}
+        assert rec["accepted"] and rec["residual"] < 1e-8
+
+
 def test_cli_run_free_fiber_uniform_background(tmp_path):
     """Fiber advected by uniform background: x advances by u*t (the reference's
     `test_fiber_uniform_background.py` oracle)."""
