@@ -91,6 +91,67 @@ def test_ellipsoid_as_sphere_matches_sphere_drag():
     assert abs(1 - v / v_theory) < 1e-3
 
 
+def _ellipsoid_velocity(a, b, c, force_axis, eta=1.0, n_nodes=600):
+    """Rigid-velocity response of an ellipsoid to a unit force on one axis."""
+    force = [0.0, 0.0, 0.0]
+    force[force_axis] = 1.0
+    pre = precompute_body("ellipsoid", n_nodes, a=a, b=b, c=c)
+    group = bd.make_group(pre["node_positions_ref"], pre["node_normals_ref"],
+                          pre["node_weights"], kind="ellipsoid",
+                          external_force=force)
+    params = Params(eta=eta, dt_initial=0.05, t_final=0.05, gmres_tol=1e-10,
+                    adaptive_timestep_flag=False)
+    system = System(params)
+    state, _, info = system.step(system.make_state(bodies=group))
+    assert bool(info.converged)
+    return float(state.bodies.velocity[0, force_axis])
+
+
+def test_prolate_spheroid_perrin_mobility():
+    """Prolate spheroid drag along/perpendicular to the symmetry axis vs the
+    exact Perrin results F_par = 16 pi eta a e^3 v / ((1+e^2) L - 2e),
+    F_perp = 32 pi eta a e^3 v / ((3e^2-1) L + 2e) with
+    L = ln((1+e)/(1-e)) (`tests/combined/bodies/` prolate mobility)."""
+    eta = 1.0
+    a_ax, b_ax = 0.6, 0.3  # symmetry axis along x (precompute a-axis)
+    e = np.sqrt(a_ax**2 - b_ax**2) / a_ax
+    L = np.log((1 + e) / (1 - e))
+
+    v_par = _ellipsoid_velocity(a_ax, b_ax, b_ax, force_axis=0, eta=eta)
+    v_perp = _ellipsoid_velocity(a_ax, b_ax, b_ax, force_axis=1, eta=eta)
+
+    v_par_theory = ((1 + e**2) * L - 2 * e) / (16 * np.pi * eta * a_ax * e**3)
+    v_perp_theory = ((3 * e**2 - 1) * L + 2 * e) / (32 * np.pi * eta * a_ax * e**3)
+
+    assert abs(1 - v_par / v_par_theory) < 5e-3
+    assert abs(1 - v_perp / v_perp_theory) < 5e-3
+    # anisotropy: drag along the long axis is lower
+    assert v_par > v_perp
+
+
+def test_oblate_spheroid_perrin_mobility():
+    """Oblate spheroid (a < b = c) mobility vs the exact result
+    F_par = 8 pi eta c e^3 v / (e sqrt(1-e^2) - (1-2e^2) asin(e)) along the
+    short (symmetry) axis, F_perp = 16 pi eta c e^3 v /
+    ((1+2e^2) asin(e) - e sqrt(1-e^2)) across it, e = sqrt(c^2-a^2)/c."""
+    eta = 1.0
+    a_ax, c_ax = 0.3, 0.6  # symmetry axis x short; b = c = 0.6
+    e = np.sqrt(c_ax**2 - a_ax**2) / c_ax
+
+    v_par = _ellipsoid_velocity(a_ax, c_ax, c_ax, force_axis=0, eta=eta)
+    v_perp = _ellipsoid_velocity(a_ax, c_ax, c_ax, force_axis=1, eta=eta)
+
+    v_par_theory = (e * np.sqrt(1 - e**2) - (1 - 2 * e**2) * np.arcsin(e)) / (
+        8 * np.pi * eta * c_ax * e**3)
+    v_perp_theory = ((1 + 2 * e**2) * np.arcsin(e) - e * np.sqrt(1 - e**2)) / (
+        16 * np.pi * eta * c_ax * e**3)
+
+    assert abs(1 - v_par / v_par_theory) < 5e-3
+    assert abs(1 - v_perp / v_perp_theory) < 5e-3
+    # the flat face moving broadside drags more
+    assert v_perp > v_par
+
+
 def test_fiber_body_link_holds():
     """A fiber bound to a body stays pinned to its nucleation site as the
     body translates under force."""

@@ -1,0 +1,66 @@
+"""Golden regression: committed final fiber positions.
+
+Mirror of the reference's regression tier
+(`tests/combined/regression_tests/test_body_fdfiber_compression.py` with
+`fdfiber_compression_finalpositions.npz`): a deterministic coupled sim whose
+final state is compared bit-tightly against a committed npz. Regenerate after
+an intentional physics change with:
+
+    python tests/test_golden_regression.py --regen
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "golden", "shear_motor_finalpositions.npz")
+
+
+def _run():
+    from skellysim_tpu.fibers import container as fc
+    from skellysim_tpu.params import Params
+    from skellysim_tpu.system import BackgroundFlow, System
+
+    rng = np.random.default_rng(17)
+    nf, n = 4, 16
+    t = np.linspace(0, 1, n)
+    origins = rng.uniform(-1.0, 1.0, size=(nf, 3))
+    dirs = rng.normal(size=(nf, 3))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    x = origins[:, None, :] + t[None, :, None] * dirs[:, None, :]
+
+    fibers = fc.make_group(x, lengths=1.0, bending_rigidity=0.01,
+                           radius=0.0125, force_scale=-0.05,
+                           dtype=jnp.float64)
+    params = Params(eta=1.0, dt_initial=0.005, t_final=0.05, gmres_tol=1e-12,
+                    adaptive_timestep_flag=False)
+    system = System(params)
+    state = system.make_state(
+        fibers=fibers,
+        background=BackgroundFlow.make(uniform=(0.0, 0.0, 0.0),
+                                       components=(1, 0, 2),
+                                       scale=(0.5, 0.0, 0.0),
+                                       dtype=jnp.float64))
+    final = system.run(state)
+    return np.asarray(final.fibers.x), np.asarray(final.fibers.tension)
+
+
+def test_golden_final_positions():
+    x, tension = _run()
+    assert os.path.exists(GOLDEN), (
+        f"golden file missing; regenerate with python {__file__} --regen")
+    with np.load(GOLDEN) as z:
+        np.testing.assert_allclose(x, z["x"], atol=1e-10)
+        np.testing.assert_allclose(tension, z["tension"], atol=1e-8)
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--regen" in sys.argv:
+        x, tension = _run()
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        np.savez(GOLDEN, x=x, tension=tension)
+        print(f"wrote {GOLDEN}")

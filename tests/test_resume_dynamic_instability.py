@@ -1,0 +1,89 @@
+"""Resume with dynamic instability enabled.
+
+The reference cannot do this (nucleated/clamp state is not restored,
+`trajectory_reader.cpp:180-185`, SURVEY.md §5.4 'resume broken with dynamic
+instability'); here the full fiber state, binding-site occupancy, and RNG
+stream round-trip through the trajectory, so a resumed run continues cleanly.
+"""
+
+import numpy as np
+
+from skellysim_tpu import builder, cli, precompute
+from skellysim_tpu.config import Body, Config
+from skellysim_tpu.io.trajectory import TrajectoryReader
+
+
+def _di_config(tmp_path, t_final):
+    cfg = Config()
+    cfg.params.eta = 1.0
+    cfg.params.dt_initial = 0.05
+    cfg.params.dt_write = 0.05
+    cfg.params.t_final = t_final
+    cfg.params.adaptive_timestep_flag = False
+    cfg.params.seed = 3
+    cfg.params.dynamic_instability.n_nodes = 16
+    cfg.params.dynamic_instability.v_growth = 0.2
+    cfg.params.dynamic_instability.f_catastrophe = 0.5
+    cfg.params.dynamic_instability.nucleation_rate = 50.0
+    cfg.params.dynamic_instability.min_length = 0.4
+    cfg.params.dynamic_instability.radius = 0.0125
+    cfg.params.dynamic_instability.bending_rigidity = 0.01
+
+    rng = np.random.default_rng(11)
+    sites = rng.standard_normal((12, 3))
+    sites = 0.5 * sites / np.linalg.norm(sites, axis=1, keepdims=True)
+    body = Body(position=[0.0, 0.0, 0.0], shape="sphere", radius=0.5,
+                n_nodes=100, nucleation_sites=sites.ravel().tolist())
+    cfg.bodies = [body]
+    path = str(tmp_path / "skelly_config.toml")
+    cfg.save(path)
+    return path
+
+
+def test_resume_with_dynamic_instability(tmp_path):
+    cfg_path = _di_config(tmp_path, t_final=0.3)
+    precompute.precompute_from_config(cfg_path, verbose=False)
+    cli.run(cfg_path)
+
+    traj = str(tmp_path / "skelly_sim.out")
+    r1 = TrajectoryReader(traj)
+    n_frames_1 = len(r1)
+    last_before = r1.load_frame(n_frames_1 - 1)
+    fibers_before = last_before["fibers"][1]
+    assert len(fibers_before) > 0, "nucleation never fired"
+    r1.close()
+
+    # extend t_final and resume
+    _di_config(tmp_path, t_final=0.6)
+    cli.run(cfg_path, resume=True)
+
+    r2 = TrajectoryReader(traj)
+    assert len(r2) > n_frames_1, "resume appended no frames"
+    # the resume point's fiber state is continued, not reset: the first
+    # appended frame's fiber count can only differ by DI events of one step
+    first_after = r2.load_frame(n_frames_1)
+    assert first_after["time"] > last_before["time"]
+    fibers_after = first_after["fibers"][1]
+    # a site occupied before the resume either carries its surviving fiber
+    # (length continued from the pre-resume value) or was freed by a
+    # catastrophe and re-nucleated at min_length — never a reset mid-fiber
+    min_length = 0.4
+    by_site_before = {tuple(f["binding_site_"]): f["length_"]
+                      for f in fibers_before}
+    continued = 0
+    for f in fibers_after:
+        site = tuple(f["binding_site_"])
+        if site not in by_site_before:
+            continue
+        if f["length_"] >= by_site_before[site] - 1e-12:
+            continued += 1
+        else:
+            assert f["length_"] <= min_length + 0.25 * 0.05 + 1e-12, (
+                "fiber length shrank without a re-nucleation")
+    assert continued > 0, "no fiber state survived across the resume boundary"
+    r2.close()
+
+    # final frame simulated out to the extended horizon
+    r3 = TrajectoryReader(traj)
+    assert r3.times[-1] >= 0.55
+    r3.close()
