@@ -1,0 +1,36 @@
+#!/usr/bin/env python3
+"""Trajectory analysis walkthrough (`/root/reference/examples/analysis_example.py`):
+read frames, extract fiber/body state, and evaluate the velocity field at
+targets from a loaded frame."""
+
+import sys
+
+import numpy as np
+
+from skellysim_tpu import builder
+from skellysim_tpu.io.trajectory import TrajectoryReader, frame_to_state
+from skellysim_tpu.system.system import solution_from_state
+
+config_file = sys.argv[1] if len(sys.argv) > 1 else "skelly_config.toml"
+traj_file = sys.argv[2] if len(sys.argv) > 2 else "skelly_sim.out"
+
+reader = TrajectoryReader(traj_file)
+print(f"{len(reader)} frames, t in [{reader.times[0]:.3f}, {reader.times[-1]:.3f}]")
+
+frame = reader.load_frame(len(reader) - 1)
+fibers = frame["fibers"][1]
+bodies = [b for sub in frame["bodies"] for b in sub]
+print(f"last frame: {len(fibers)} fibers, {len(bodies)} bodies")
+if fibers:
+    x0 = np.asarray(fibers[0]["x_"])
+    print(f"fiber 0: {fibers[0]['n_nodes_']} nodes, "
+          f"minus end at {x0[0]}, plus end at {x0[-1]}")
+
+# velocity field from the solved state
+system, template, _ = builder.build_simulation(config_file)
+state = frame_to_state(frame, template)
+solution = solution_from_state(state)
+targets = np.array([[0.5, 0.0, 0.5], [1.0, 0.0, 0.5], [2.0, 0.0, 0.5]])
+u = np.asarray(system.velocity_at_targets(state, solution, targets))
+for r, v in zip(targets, u):
+    print(f"u({r}) = {v}")

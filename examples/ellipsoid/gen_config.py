@@ -1,0 +1,33 @@
+#!/usr/bin/env python3
+"""Ellipsoidal periphery + 2000 surface-clamped fibers with motor forcing.
+
+Counterpart of `/root/reference/examples/ellipsoid/gen_config.py`.
+"""
+
+import sys
+
+import numpy as np
+
+from skellysim_tpu.config import ConfigEllipsoidal, Fiber
+
+config_file = sys.argv[1] if len(sys.argv) > 1 else "skelly_config.toml"
+rng = np.random.default_rng(100)
+
+n_fibers = 2000
+
+config = ConfigEllipsoidal()
+config.params.dt_write = 0.1
+config.params.dt_initial = 8e-3
+config.params.dt_max = 8e-3
+
+config.fibers = [
+    Fiber(length=1.0, bending_rigidity=2.5e-3, parent_body=-1,
+          force_scale=-0.05, minus_clamped=True, n_nodes=64)
+    for _ in range(n_fibers)
+]
+
+config.periphery.n_nodes = 8000
+config.periphery.move_fibers_to_surface(config.fibers, ds_min=0.1, rng=rng)
+
+config.save(config_file)
+print(f"wrote {config_file}; next: python -m skellysim_tpu.precompute")

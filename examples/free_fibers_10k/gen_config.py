@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""10k free fibers in free space: the dense-Stokeslet scale-out config
+(BASELINE.json #4, north-star: dense O(N^2) on a TPU mesh vs 32-rank FMM).
+
+640k hydrodynamic nodes at 64 nodes/fiber. On a multi-chip mesh, run with
+pair_evaluator = "ring" so source blocks rotate the ICI ring instead of
+all-gathering (`skellysim_tpu/parallel/ring.py`).
+"""
+
+import sys
+
+import numpy as np
+
+from skellysim_tpu.config import Config, Fiber
+
+config_file = sys.argv[1] if len(sys.argv) > 1 else "skelly_config.toml"
+rng = np.random.default_rng(100)
+
+n_fibers = 10_000
+box = 20.0
+
+config = Config()
+config.params.dt_write = 0.05
+config.params.dt_initial = 5e-3
+config.params.dt_max = 5e-3
+config.params.gmres_tol = 1e-8
+config.params.pair_evaluator = "ring"
+
+config.fibers = []
+for _ in range(n_fibers):
+    fib = Fiber(length=1.0, bending_rigidity=2.5e-3, force_scale=-0.05,
+                n_nodes=64)
+    origin = rng.uniform(-box / 2, box / 2, 3)
+    direction = rng.normal(size=3)
+    direction /= np.linalg.norm(direction)
+    fib.fill_node_positions(origin, direction)
+    config.fibers.append(fib)
+
+config.save(config_file)
+print(f"wrote {config_file} ({n_fibers} fibers); run: python -m skellysim_tpu")

@@ -1,0 +1,25 @@
+#!/usr/bin/env python3
+"""Small sim to drive with the listener-mode post-processing server
+(`/root/reference/examples/listener_mode/gen_config.py`)."""
+
+import sys
+
+import numpy as np
+
+from skellysim_tpu.config import BackgroundSource, Config, Fiber
+
+config_file = sys.argv[1] if len(sys.argv) > 1 else "skelly_config.toml"
+
+config = Config()
+config.params.dt_initial = 0.01
+config.params.dt_write = 0.02
+config.params.t_final = 0.2
+config.params.adaptive_timestep_flag = False
+
+fib = Fiber(length=1.0, bending_rigidity=1e-2, n_nodes=32)
+fib.fill_node_positions(np.zeros(3), np.array([0.0, 0.0, 1.0]))
+config.fibers = [fib]
+config.background = BackgroundSource(uniform=[0.5, 0.0, 0.0])
+
+config.save(config_file)
+print(f"wrote {config_file}; run the sim, then listener_example.py")

@@ -1,0 +1,34 @@
+#!/usr/bin/env python3
+"""Drive the --listen post-processing server: streamlines, vortex lines, and a
+velocity field slice from an existing trajectory
+(`/root/reference/examples/listener_mode/listener_example.py`)."""
+
+import numpy as np
+
+from skellysim_tpu.io import Listener, Request, StreamlinesRequest, \
+    VelocityFieldRequest
+
+with Listener(toml_file="skelly_config.toml") as listener:
+    req = Request(frame_no=0)
+
+    # streamlines seeded on a small ring around the fiber
+    theta = np.linspace(0, 2 * np.pi, 8, endpoint=False)
+    req.streamlines = StreamlinesRequest(
+        dt_init=0.05, t_final=0.5, back_integrate=True,
+        x0=np.stack([0.3 * np.cos(theta), 0.3 * np.sin(theta),
+                     0.5 * np.ones_like(theta)], axis=1))
+
+    # velocity field on a coarse y=0 slice
+    xs, zs = np.meshgrid(np.linspace(-1, 1, 11), np.linspace(-0.5, 1.5, 11))
+    req.velocity_field = VelocityFieldRequest(
+        x=np.stack([xs.ravel(), np.zeros(xs.size), zs.ravel()], axis=1))
+
+    res = listener.request(req)
+
+print(f"frame {res['i_frame']}/{res['n_frames']} at t={res['time']:.3f}")
+for i, line in enumerate(res["streamlines"]):
+    print(f"  streamline {i}: {line['x'].shape[0]} points, "
+          f"t in [{line['time'][0]:.3f}, {line['time'][-1]:.3f}]")
+vf = np.asarray(res["velocity_field"]).reshape(-1, 3)
+print(f"  velocity field: {vf.shape[0]} points, "
+      f"max |u| = {np.linalg.norm(vf, axis=1).max():.4f}")

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Surface-of-revolution (oocyte) periphery + 3000 clamped fibers.
+
+Counterpart of `/root/reference/examples/oocyte/gen_config.py`: the envelope
+height function is revolved around x, fibers nucleate on the surface.
+"""
+
+import sys
+
+import numpy as np
+
+from skellysim_tpu.config import ConfigRevolution, Fiber
+
+config_file = sys.argv[1] if len(sys.argv) > 1 else "skelly_config.toml"
+rng = np.random.default_rng(100)
+
+n_fibers = 3000
+
+config = ConfigRevolution()
+config.params.dt_write = 0.1
+config.params.dt_initial = 1e-2
+config.params.dt_max = 1e-2
+config.params.periphery_interaction_flag = False
+config.params.seed = 350
+config.params.eta = 1.0
+
+config.fibers = [
+    Fiber(length=1.0, bending_rigidity=2.5e-3, force_scale=-0.05,
+          minus_clamped=True, n_nodes=32)
+    for _ in range(n_fibers)
+]
+
+config.periphery.envelope.n_nodes_target = 6000
+config.periphery.envelope.lower_bound = -3.75
+config.periphery.envelope.upper_bound = 3.75
+config.periphery.envelope.height = \
+    "0.5 * T * ((1 + 2*x/length)**p1) * ((1 - 2*x/length)**p2) * length"
+config.periphery.envelope.T = 0.72
+config.periphery.envelope.p1 = 0.4
+config.periphery.envelope.p2 = 0.2
+config.periphery.envelope.length = 7.5
+
+config.periphery.move_fibers_to_surface(config.fibers, ds_min=0.1, rng=rng)
+
+config.save(config_file)
+print(f"wrote {config_file}; next: python -m skellysim_tpu.precompute")

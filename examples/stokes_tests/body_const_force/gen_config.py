@@ -1,0 +1,22 @@
+#!/usr/bin/env python3
+"""Rigid sphere under constant force: the 6 pi eta R v Stokes-drag oracle
+(`/root/reference/tests/combined/test_body_const_force.py` setup)."""
+
+import sys
+
+from skellysim_tpu.config import Body, Config
+
+config_file = sys.argv[1] if len(sys.argv) > 1 else "skelly_config.toml"
+
+config = Config()
+config.params.eta = 1.0
+config.params.dt_initial = 0.1
+config.params.dt_write = 0.1
+config.params.t_final = 3.0
+config.params.adaptive_timestep_flag = False
+
+config.bodies = [Body(position=[0.0, 0.0, 0.0], shape="sphere", radius=0.5,
+                      n_nodes=600, external_force=[0.0, 0.0, 1.0])]
+
+config.save(config_file)
+print(f"wrote {config_file}; next: python -m skellysim_tpu.precompute")

@@ -1,0 +1,25 @@
+#!/usr/bin/env python3
+"""Single 64-node fiber sedimenting under a uniform background flow
+(BASELINE.json #2; `/root/reference/examples/stokes_tests/fiber_const_force`)."""
+
+import sys
+
+import numpy as np
+
+from skellysim_tpu.config import BackgroundSource, Config, Fiber
+
+config_file = sys.argv[1] if len(sys.argv) > 1 else "skelly_config.toml"
+
+config = Config()
+config.params.dt_initial = 0.01
+config.params.dt_write = 0.01
+config.params.t_final = 0.5
+config.params.adaptive_timestep_flag = False
+
+fib = Fiber(length=1.0, bending_rigidity=1e-2, n_nodes=64)
+fib.fill_node_positions(np.zeros(3), np.array([0.0, 0.0, 1.0]))
+config.fibers = [fib]
+config.background = BackgroundSource(uniform=[0.1, 0.0, 0.0])
+
+config.save(config_file)
+print(f"wrote {config_file}; run: python -m skellysim_tpu")
