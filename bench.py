@@ -1,23 +1,70 @@
-"""Benchmark: mobility-matvec throughput (source-target pairs/sec/chip).
+"""Benchmark: the BASELINE.md matrix, un-crashable, on the best available backend.
 
-Per BASELINE.md, the reference publishes no numbers, so the baseline is
-self-measured: the reference's ground-truth backend is the single-threaded
-direct CPU kernel (`tests/core/kernel_test.cpp` uses it as the oracle;
-`performance_hydrodynamics_combined.cpp` times it). We measure the same
-quantity here: pairwise Stokeslet evaluations per second, on the default
-device (TPU under axon; CPU in dev runs), at the 10k-fiber scale's kernel
-shape (N = 65536 sources == targets, f32), against a single-core NumPy
-direct evaluation measured on this host and extrapolated per-pair.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "backend",
+"extra"} no matter what happens: the TPU backend is probed in a subprocess with
+a timeout (the session's axon plugin can either raise UNAVAILABLE or block on
+its tunnel — both killed round 1's bench), and every measurement section is
+individually guarded, falling back to nulls in "extra" rather than crashing.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Measured sections (see BASELINE.md "Metrics to measure"):
+  - stokeslet mobility-matvec throughput, f32 and f64 (pairs/s/chip), vs a
+    single-core NumPy direct evaluation (the reference's oracle backend,
+    `/root/reference/tests/core/kernel_test.cpp`), plus an MFU estimate;
+  - single-fiber implicit solve (64 nodes, free space): wall/solve + iters;
+  - the reference docs-walkthrough-scale coupled solve — 1 fiber + 1 body
+    (400 nodes) + spherical periphery (6000 nodes on an accelerator) — against
+    the reference's published footprint: GMRES 7 iters, 0.328 s/solve
+    (`/root/reference/docs/source/getting_started.rst:96-100`).
+
+Headline: coupled-solve wall time when the walkthrough-scale config ran
+(vs_baseline = ref_wall / our_wall, >1 means faster than the reference);
+otherwise f32 kernel throughput vs the NumPy oracle.
+
+Bench-only shortcut: shell quadrature weights are uniform (4*pi*R^2/N on
+Fibonacci nodes) instead of the Reeger-Fornberg RBF weights, and the dense
+shell operator + its inverse are assembled/inverted on-device — the host here
+has one CPU core, where the production scipy path (`periphery.build_shell_operator`)
+takes ~5 min at 6000 nodes. Solver structure, shapes, and flop profile are
+identical to production; only quadrature accuracy (irrelevant for timing)
+differs.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+#: reference walkthrough: GMRES 7 iters, 0.328 s/solve, tol 4.6e-11
+#: (docs/source/getting_started.rst:96-100; 1 fiber + body(400) + shell(6000))
+REF_SOLVE_WALL_S = 0.328
+REF_SOLVE_ITERS = 7
+
+#: direct stokeslet arithmetic per source-target pair (3 sub, 5 r^2, ~4 rsqrt,
+#: 2 rinv^3, 5 f.d dot, ~11 accumulate) — for the MFU estimate only
+STOKESLET_FLOPS_PER_PAIR = 30
+
+#: per-chip dense peak (flops/s) by device_kind substring, bf16 for TPUs
+PEAK_FLOPS = [("v6", 918e12), ("v5p", 459e12), ("v5", 197e12), ("v4", 275e12)]
+
+
+def _probe_backend(timeout_s: float = 240.0):
+    """Ask a subprocess for the default backend so a wedged TPU plugin can
+    never hang or crash the bench process. Returns a backend name or None."""
+    code = "import jax; print('BACKEND=' + jax.default_backend())"
+    try:
+        p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s)
+        for line in (p.stdout or "").splitlines():
+            if line.startswith("BACKEND="):
+                return line.split("=", 1)[1].strip()
+    except Exception:
+        pass
+    return None
 
 
 def _numpy_pairs_per_s(n=1024, trials=3):
@@ -43,52 +90,265 @@ def _numpy_pairs_per_s(n=1024, trials=3):
     return n * n / dt
 
 
-def main():
+def _rate(fn, n_pairs, trials=3):
+    """pairs/s of a nullary kernel call: compile+warm once, then time."""
+    fn().block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        out = fn()
+    out.block_until_ready()
+    return n_pairs * trials / (time.perf_counter() - t0)
+
+
+def _kernel_inputs(dtype, n):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    r = jnp.asarray(rng.uniform(-5, 5, size=(n, 3)), dtype=dtype)
+    f = jnp.asarray(rng.standard_normal((n, 3)), dtype=dtype)
+    return r, f
+
+
+def _kernel_rate(dtype, n):
+    from skellysim_tpu.ops import kernels
+
+    r, f = _kernel_inputs(dtype, n)
+    return _rate(lambda: kernels.stokeslet_direct(r, r, f, 1.0), n * n)
+
+
+def _bench_single_fiber(dtype, tol, trials=3):
+    """1 fiber x 64 nodes in free space, background-driven implicit solve."""
+    import dataclasses
+
     import jax
+
+    from __graft_entry__ import _make_system
+
+    system, state = _make_system(n_fibers=1, n_nodes=64, dtype=dtype)
+    system.params = dataclasses.replace(system.params, gmres_tol=tol)
+    step = jax.jit(system._solve_impl)
+    _, _, info = step(state)
+    jax.block_until_ready(info.residual)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        _, _, info = step(state)
+    jax.block_until_ready(info.residual)
+    wall = (time.perf_counter() - t0) / trials
+    return {"wall_s": round(wall, 4), "iters": int(info.iters),
+            "residual": float(info.residual), "tol": tol,
+            "solves_per_s": round(1.0 / wall, 2)}
+
+
+def _device_shell_operator(nodes, normals, weights, dtype):
+    """Dense second-kind shell operator + inverse, assembled on-device.
+
+    Same math as `periphery.build_shell_operator` (stresslet x normal blocks,
+    singularity subtraction, -1/w diagonal, n (x) n complementary term) with
+    the O(N^2) assembly and O(N^3) inverse on the accelerator instead of
+    host LAPACK.
+    """
     import jax.numpy as jnp
 
     from skellysim_tpu.ops import kernels
 
-    # full 10k-fiber kernel shape on an accelerator; small smoke size on CPU
-    n = 65536 if jax.default_backend() != "cpu" else 8192
-    rng = np.random.default_rng(1)
-    r = jnp.asarray(rng.uniform(-5, 5, size=(n, 3)), dtype=jnp.float32)
-    f = jnp.asarray(rng.standard_normal((n, 3)), dtype=jnp.float32)
+    N = len(nodes)
+    nodes_d = jnp.asarray(nodes, dtype=dtype)
+    normals_d = jnp.asarray(normals, dtype=dtype)
+    w_d = jnp.asarray(weights, dtype=dtype)
 
-    u = kernels.stokeslet_direct(r, r, f, 1.0)
-    u.block_until_ready()  # compile + warm
-    trials = 3
+    M = jnp.asarray(kernels.stresslet_times_normal(nodes_d, normals_d, 1.0),
+                    dtype=dtype).reshape(3 * N, 3 * N)
 
-    def rate(fn):
-        fn().block_until_ready()  # compile + warm
-        t0 = time.perf_counter()
-        for _ in range(trials):
-            out = fn()
-        out.block_until_ready()
-        return n * n * trials / (time.perf_counter() - t0)
+    svs = []
+    for k in range(3):
+        e = jnp.zeros((N, 3), dtype=dtype).at[:, k].set(w_d)
+        svs.append(kernels.stresslet_times_normal_times_density(
+            nodes_d, normals_d, e, 1.0))
+    C = jnp.stack(svs, axis=-1) / w_d[:, None, None]  # [N, 3row, 3col]
 
-    pairs_per_s = rate(lambda: kernels.stokeslet_direct(r, r, f, 1.0))
-    backend = "xla"
-    if jax.default_backend() == "tpu":
-        # the fused Pallas tiles usually beat the blocked XLA kernel on-chip;
-        # report whichever wins so the headline tracks the best path
-        from skellysim_tpu.ops.pallas_kernels import stokeslet_pallas
+    M4 = M.reshape(N, 3, N, 3)
+    i = jnp.arange(N)[:, None, None]
+    M4 = M4.at[i, jnp.arange(3)[None, :, None], i,
+               jnp.arange(3)[None, None, :]].add(-C)
+    M = M4.reshape(3 * N, 3 * N)
+    d = jnp.arange(3 * N)
+    M = M.at[d, d].add(-jnp.repeat(1.0 / w_d, 3))
+    M = M + jnp.outer(normals_d.reshape(-1), normals_d.reshape(-1))
+    M_inv = jnp.linalg.inv(M)
+    return M, M_inv
 
+
+def _bench_coupled(shell_n, body_n, dtype, tol, trials=3):
+    """Walkthrough-scale coupled solve: 1 fiber + 1 body + spherical shell."""
+    import jax
+
+    from skellysim_tpu.bodies import bodies as bd
+    from skellysim_tpu.fibers import container as fc
+    from skellysim_tpu.params import Params
+    from skellysim_tpu.periphery import periphery as peri
+    from skellysim_tpu.periphery.precompute import precompute_body
+    from skellysim_tpu.periphery.shapes import sphere_shape
+    from skellysim_tpu.system import System
+
+    t_setup = time.perf_counter()
+    radius = 6.0
+    spec = sphere_shape(shell_n, radius=radius * 1.04)
+    normals = -spec.node_normals  # shell normals point inward
+    weights = np.full(shell_n, 4 * np.pi * (radius * 1.04) ** 2 / shell_n)
+    op, M_inv = _device_shell_operator(spec.nodes, normals, weights, dtype)
+    shell = peri.make_state(spec.nodes, normals, weights, op, M_inv, dtype=dtype)
+
+    body_pre = precompute_body("sphere", body_n, radius=0.5)
+    bodies = bd.make_group(
+        body_pre["node_positions_ref"], body_pre["node_normals_ref"],
+        body_pre["node_weights"], position=np.zeros((1, 3)),
+        external_force=np.array([[0.0, 0.0, 0.5]]), radius=np.array([0.5]),
+        kind="sphere", dtype=dtype)
+
+    t = np.linspace(0, 1, 64)
+    x = np.array([0.0, 3.0, 0.0])[None, :] + t[:, None] * np.array([0.0, 0.0, 1.0])
+    fibers = fc.make_group(x[None], lengths=1.0, bending_rigidity=0.01,
+                           radius=0.0125, dtype=dtype)
+
+    params = Params(eta=1.0, dt_initial=0.1, t_final=1.0, gmres_tol=tol,
+                    gmres_restart=60, gmres_maxiter=120,
+                    adaptive_timestep_flag=False)
+    system = System(params, shell_shape=peri.PeripheryShape(kind="sphere",
+                                                            radius=radius))
+    state = system.make_state(fibers=fibers, shell=shell, bodies=bodies)
+    setup_s = time.perf_counter() - t_setup
+
+    step = jax.jit(system._solve_impl)
+    _, _, info = step(state)
+    jax.block_until_ready(info.residual)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        _, _, info = step(state)
+    jax.block_until_ready(info.residual)
+    wall = (time.perf_counter() - t0) / trials
+    return {"wall_s": round(wall, 4), "iters": int(info.iters),
+            "residual": float(info.residual), "tol": tol,
+            "shell_n": shell_n, "body_n": body_n,
+            "setup_s": round(setup_s, 2),
+            "ref_wall_s": REF_SOLVE_WALL_S, "ref_iters": REF_SOLVE_ITERS,
+            "vs_ref": round(REF_SOLVE_WALL_S / wall, 2)}
+
+
+def main():
+    extra = {}
+
+    probed = _probe_backend()
+    if probed in (None, "cpu"):
+        from skellysim_tpu.utils.bootstrap import force_cpu_devices
+
+        force_cpu_devices()
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    backend = jax.default_backend()
+    on_acc = backend != "cpu"
+    extra["backend"] = backend
+    try:
+        extra["device_kind"] = jax.devices()[0].device_kind
+    except Exception:
+        extra["device_kind"] = None
+
+    import jax.numpy as jnp
+
+    # --- kernel throughput, f32 + f64 ---------------------------------------
+    n32 = 65536 if on_acc else 8192
+    n64 = 16384 if on_acc else 4096
+    rate32 = rate64 = None
+    try:
+        rate32 = _kernel_rate(jnp.float32, n32)
+        extra["stokeslet_f32"] = {"n": n32, "gpairs_per_s": round(rate32 / 1e9, 4)}
+    except Exception as e:
+        extra["stokeslet_f32"] = {"error": repr(e)}
+    try:
+        rate64 = _kernel_rate(jnp.float64, n64)
+        extra["stokeslet_f64"] = {"n": n64, "gpairs_per_s": round(rate64 / 1e9, 4)}
+    except Exception as e:
+        extra["stokeslet_f64"] = {"error": repr(e)}
+
+    # Pallas fused tiles (accelerator only): report whichever path wins
+    if on_acc and rate32 is not None:
         try:
-            pallas_rate = rate(lambda: stokeslet_pallas(r, r, f, 1.0))
-            if pallas_rate > pairs_per_s:
-                pairs_per_s, backend = pallas_rate, "pallas"
-        except Exception as e:
-            print(f"# pallas path failed ({e}); keeping xla", flush=True)
+            from skellysim_tpu.ops.pallas_kernels import stokeslet_pallas
 
-    baseline = _numpy_pairs_per_s()
-    print(json.dumps({
-        "metric": f"stokeslet_mobility_matvec_throughput_n{n}_{backend}",
-        "value": round(pairs_per_s / 1e9, 4),
-        "unit": "Gpairs/s/chip",
-        "vs_baseline": round(pairs_per_s / baseline, 2),
-    }))
+            rng = np.random.default_rng(1)
+            r = jnp.asarray(rng.uniform(-5, 5, (n32, 3)), dtype=jnp.float32)
+            f = jnp.asarray(rng.standard_normal((n32, 3)), dtype=jnp.float32)
+            stokeslet_pallas(r, r, f, 1.0).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(3):
+                out = stokeslet_pallas(r, r, f, 1.0)
+            out.block_until_ready()
+            prate = n32 * n32 * 3 / (time.perf_counter() - t0)
+            extra["stokeslet_f32_pallas"] = {"gpairs_per_s": round(prate / 1e9, 4)}
+            rate32 = max(rate32, prate)
+        except Exception as e:
+            extra["stokeslet_f32_pallas"] = {"error": repr(e)}
+
+    # MFU estimate against the chip's dense peak (bf16 for TPUs)
+    if rate32 is not None and extra.get("device_kind"):
+        kind = str(extra["device_kind"]).lower()
+        peak = next((p for sub, p in PEAK_FLOPS if sub in kind), None)
+        if peak:
+            extra["mfu_f32_est"] = round(
+                rate32 * STOKESLET_FLOPS_PER_PAIR / peak, 4)
+            extra["mfu_assumed_peak_tflops"] = peak / 1e12
+
+    # --- single-fiber implicit solve ----------------------------------------
+    dtype = jnp.float32 if on_acc else jnp.float64
+    tol = 1e-8 if on_acc else 1e-10
+    try:
+        extra["single_fiber"] = _bench_single_fiber(dtype, tol)
+    except Exception as e:
+        extra["single_fiber"] = {"error": repr(e)}
+
+    # --- walkthrough-scale coupled solve ------------------------------------
+    shell_n = 6000 if on_acc else 600
+    try:
+        extra["coupled_solve"] = _bench_coupled(shell_n, 400, dtype, tol)
+    except Exception as e:
+        extra["coupled_solve"] = {"error": repr(e)}
+        if on_acc:  # e.g. device OOM: retry once at CPU-fallback scale
+            try:
+                shell_n = 600
+                extra["coupled_solve"] = _bench_coupled(shell_n, 400, dtype, tol)
+            except Exception as e2:
+                extra["coupled_solve"] = {"error": repr(e2)}
+
+    # --- headline ------------------------------------------------------------
+    coupled = extra.get("coupled_solve", {})
+    if "wall_s" in coupled and coupled.get("shell_n") == 6000:
+        line = {
+            "metric": "coupled_solve_walkthrough_wall_s",
+            "value": coupled["wall_s"],
+            "unit": "s/solve",
+            "vs_baseline": coupled["vs_ref"],
+        }
+    elif rate32 is not None:
+        baseline = _numpy_pairs_per_s()
+        extra["numpy_baseline_gpairs_per_s"] = round(baseline / 1e9, 5)
+        line = {
+            "metric": f"stokeslet_mobility_matvec_throughput_n{n32}_f32",
+            "value": round(rate32 / 1e9, 4),
+            "unit": "Gpairs/s/chip",
+            "vs_baseline": round(rate32 / baseline, 2),
+        }
+    else:
+        line = {"metric": "bench_failed", "value": 0.0, "unit": "",
+                "vs_baseline": 0.0}
+    line["backend"] = backend
+    line["extra"] = extra
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # absolute backstop: the driver must see valid JSON
+        print(json.dumps({"metric": "bench_failed", "value": 0.0, "unit": "",
+                          "vs_baseline": 0.0, "error": repr(e)}))
+        sys.exit(0)

@@ -265,6 +265,23 @@ class EllipsoidalPeriphery(Periphery):
                 print(f"Inserted fiber {i} at {x0}")
 
 
+class EnvelopeConfig(dict):
+    """Envelope table with attribute-style access (reference API parity:
+    `config.periphery.envelope.n_nodes_target = ...` works like the
+    reference's `Envelope` dataclass, `skelly_config.py:609-716`) while
+    remaining a plain dict for TOML round-tripping and the precompute
+    pipeline."""
+
+    def __getattr__(self, key):
+        try:
+            return self[key]
+        except KeyError:
+            raise AttributeError(key) from None
+
+    def __setattr__(self, key, value):
+        self[key] = value
+
+
 @dataclass
 class RevolutionPeriphery(Periphery):
     """Surface of revolution of a height function h(x) around the x axis.
@@ -272,10 +289,16 @@ class RevolutionPeriphery(Periphery):
     `envelope` keys (reference `RevolutionPeriphery`, `skelly_config.py:609-716`):
     height (a one-line expression of x), lower_bound, upper_bound,
     n_nodes_target, plus free parameters referenced by the expression.
+    Both dict-style (`envelope["height"]`) and attribute-style
+    (`envelope.height`) access work.
     """
     shape: str = "surface_of_revolution"
     n_nodes: int = 0
-    envelope: dict = field(default_factory=dict)
+    envelope: dict = field(default_factory=EnvelopeConfig)
+
+    def __post_init__(self):
+        if not isinstance(self.envelope, EnvelopeConfig):
+            self.envelope = EnvelopeConfig(self.envelope)
 
     def move_fibers_to_surface(self, fibers, ds_min, verbose=True, rng=None):
         from ..periphery.shapes import Envelope

@@ -24,7 +24,7 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devs), (FIBER_AXIS,))
 
 
-def shard_state(state, mesh: Mesh):
+def shard_state(state, mesh: Mesh, *, allow_replicated_shell: bool = False):
     """Place a SimState on the mesh.
 
     - fiber-batch leaves: sharded along the fiber axis;
@@ -33,6 +33,13 @@ def shard_state(state, mesh: Mesh):
       (`periphery.cpp:408-442`), whose matvec becomes all-gather(density) +
       local row-block GEMV (`periphery.cpp:21-47`), inserted by GSPMD;
     - everything else (small body state, scalars, shell vectors): replicated.
+
+    pjit rejects uneven shardings, so the shell rows can only distribute when
+    the mesh size divides 3*n_nodes. Anything else raises: silently
+    replicating an O(n_nodes^2) matrix per device turns the expected O(N/D)
+    footprint into D copies of the full operator, an OOM a user would only
+    find with a profiler. Pass ``allow_replicated_shell=True`` to opt in for
+    small shells.
     """
     fib_sharding = NamedSharding(mesh, P(FIBER_AXIS))
     row_sharding = NamedSharding(mesh, P(FIBER_AXIS, None))
@@ -47,14 +54,23 @@ def shard_state(state, mesh: Mesh):
         return jax.device_put(leaf, rep_sharding)
 
     # place the O(n^2) shell operators straight to their final sharding (never
-    # replicate them first — peak per-device memory would be the full matrix);
-    # pjit rejects uneven shardings, so rows distribute only when the mesh
-    # size divides 3*n_nodes (pick shell n_nodes accordingly)
+    # replicate them first — peak per-device memory would be the full matrix)
     shell = state.shell
     state = jax.tree_util.tree_map(place, state._replace(shell=None))
     if shell is not None:
-        big = (row_sharding if shell.M_inv.shape[0] % mesh.size == 0
-               else rep_sharding)
+        rows = shell.M_inv.shape[0]
+        if rows % mesh.size == 0:
+            big = row_sharding
+        elif allow_replicated_shell:
+            big = rep_sharding
+        else:
+            raise ValueError(
+                f"shell operator rows (3*n_nodes = {rows}) are not divisible "
+                f"by the mesh size ({mesh.size}), so the O(n_nodes^2) dense "
+                "operators cannot be row-sharded and would be fully replicated "
+                "on every device. Pick a shell n_nodes that is a multiple of "
+                f"{mesh.size}, or pass allow_replicated_shell=True to accept "
+                "the per-device memory cost.")
         rest = jax.tree_util.tree_map(
             place, shell._replace(stresslet_plus_complementary=None,
                                   M_inv=None))

@@ -122,11 +122,30 @@ def update_RHS(v_on_shell):
     return -v_on_shell.reshape(-1)
 
 
-def flow(shell: PeripheryState, r_trg, density, eta):
+def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct",
+         mesh=None):
     """Shell -> target velocities via the double-layer stresslet
-    (`periphery.cpp:55-79`): f_dl = 2 eta n (x) rho."""
+    (`periphery.cpp:55-79`): f_dl = 2 eta n (x) rho.
+
+    ``evaluator="ring"`` (with a mesh) rotates shell-node source blocks around
+    the ICI ring — the same pair-evaluator seam as `fibers.container.flow`
+    (reference: one evaluator serves all components, `kernels.hpp:78-122`).
+    Zero-strength far-point pads make the node count mesh-divisible; callers
+    pad the *target* rows (see `System._ring_pad_targets`).
+    """
     rho = density.reshape(-1, 3)
     f_dl = 2.0 * eta * shell.normals[:, :, None] * rho[:, None, :]
+    if evaluator == "ring" and mesh is not None:
+        from ..parallel.ring import ring_stresslet
+
+        src = shell.nodes
+        pad = (-src.shape[0]) % mesh.size
+        if pad:
+            src = jnp.concatenate(
+                [src, jnp.full((pad, 3), 1e7, dtype=src.dtype)], axis=0)
+            f_dl = jnp.concatenate(
+                [f_dl, jnp.zeros((pad, 3, 3), dtype=f_dl.dtype)], axis=0)
+        return ring_stresslet(src, r_trg, f_dl, eta, mesh=mesh)
     return kernels.stresslet_direct(shell.nodes, r_trg, f_dl, eta)
 
 

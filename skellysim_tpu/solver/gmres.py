@@ -31,8 +31,14 @@ from jax import lax
 class GmresResult(NamedTuple):
     x: jnp.ndarray          # solution
     iters: jnp.ndarray      # int32, total inner iterations
-    residual: jnp.ndarray   # implicit relative residual at exit
+    residual: jnp.ndarray   # implicit (Givens) relative residual at exit
     converged: jnp.ndarray  # bool
+    #: explicit relative residual ||b - A x|| / ||b|| from one extra matvec
+    #: after exit — the reference's post-solve check (`solver_hydro.cpp:81-92`,
+    #: `include/solver.hpp:38`). With restarts + a right preconditioner the
+    #: implicit residual can drift from the true one; compare the two to
+    #: detect loss of accuracy.
+    residual_true: jnp.ndarray
 
 
 def _icgs(V, w, k, n_restart):
@@ -49,13 +55,17 @@ def _icgs(V, w, k, n_restart):
     return w, h
 
 
-@partial(jax.jit, static_argnames=("matvec", "precond", "restart", "maxiter"))
+@partial(jax.jit, static_argnames=("matvec", "precond", "restart", "maxiter",
+                                   "debug"))
 def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
-          tol: float = 1e-10, restart: int = 100, maxiter: int = 1000) -> GmresResult:
+          tol: float = 1e-10, restart: int = 100, maxiter: int = 1000,
+          debug: bool = False) -> GmresResult:
     """Solve ``matvec(x) = b`` with right-preconditioned restarted GMRES.
 
     ``precond`` approximates A^-1 (applied on the right). Initial guess is zero,
     like the reference's freshly constructed solution vector each step.
+    ``debug=True`` prints the implicit residual after each restart cycle (the
+    analogue of Belos' per-iteration verbosity, `solver_hydro.cpp:73-83`).
     """
     n = b.shape[0]
     dtype = b.dtype
@@ -138,10 +148,16 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
     def outer_body(state):
         x, _, total_iters, cycles = state
         x, resid, k = arnoldi_cycle(x)
+        if debug:
+            jax.debug.print(
+                "gmres restart {c}: iters={i} implicit residual={r:.3e}",
+                c=cycles + 1, i=total_iters + k, r=resid)
         return x, resid, total_iters + k, cycles + 1
 
     x0 = jnp.zeros_like(b)
     init_resid = jnp.where(b_norm > 0.0, jnp.array(jnp.inf, dtype=dtype), jnp.array(0.0, dtype=dtype))
     x, resid, iters, _ = lax.while_loop(
         outer_cond, outer_body, (x0, init_resid, jnp.int32(0), jnp.int32(0)))
-    return GmresResult(x=x, iters=iters, residual=resid, converged=resid <= tol)
+    resid_true = jnp.linalg.norm(b - matvec(x)) / safe_b_norm
+    return GmresResult(x=x, iters=iters, residual=resid, converged=resid <= tol,
+                       residual_true=resid_true)

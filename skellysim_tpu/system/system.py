@@ -47,8 +47,14 @@ class SimState(NamedTuple):
 class StepInfo(NamedTuple):
     converged: jnp.ndarray
     iters: jnp.ndarray
-    residual: jnp.ndarray
+    residual: jnp.ndarray       # implicit (Givens) relative residual
     fiber_error: jnp.ndarray
+    #: explicit ||b - A x|| / ||b|| from one post-solve matvec
+    #: (`solver_hydro.cpp:81-92`); nan until populated by a solve
+    residual_true: jnp.ndarray = jnp.nan
+    #: converged by the implicit residual but the explicit one disagrees by
+    #: >10x tol — Belos' loss-of-accuracy analogue (`solver_hydro.cpp:85-92`)
+    loss_of_accuracy: jnp.ndarray = False
 
 
 def solution_from_state(state: SimState):
@@ -91,25 +97,62 @@ class System:
         self._collision_jit = jax.jit(self._check_collision)
         self._vel_jit = jax.jit(self._velocity_at_targets_impl)
 
-    def _fiber_flow(self, state: SimState, caches, r_trg, forces,
-                    subtract_self: bool = True):
-        """Fiber-source flow through the selected pair evaluator. The ring
-        path needs every target block sharded along the fiber axis, so it only
-        engages for pure-fiber systems (no shell/body target rows)."""
-        ring_ok = (self.params.pair_evaluator == "ring" and self.mesh is not None
-                   and state.shell is None and state.bodies is None)
-        if self.params.pair_evaluator == "ring" and not ring_ok:
+    def _ring_active(self) -> bool:
+        ring = self.params.pair_evaluator == "ring"
+        if ring and self.mesh is None:
             # trace-time (not per-step) diagnostic: silent degradation would
             # surprise a user expecting O(N/D) per-chip memory
             import warnings
 
-            why = ("no mesh was configured" if self.mesh is None else
-                   "shell/body target rows require the direct evaluator")
-            warnings.warn(f"pair_evaluator='ring' falls back to 'direct': {why}")
-        return fc.flow(state.fibers, caches, r_trg, forces, self.params.eta,
-                       subtract_self=subtract_self,
-                       evaluator="ring" if ring_ok else "direct",
-                       mesh=self.mesh if ring_ok else None)
+            warnings.warn("pair_evaluator='ring' falls back to 'direct': "
+                          "no mesh was configured")
+            return False
+        return ring
+
+    def _ring_pad_targets(self, r_trg):
+        """Pad the target rows to a mesh-size multiple (shard_map needs even
+        blocks). Pad points sit at 1e6 — far from any geometry, never
+        coincident with the 1e7 source pads — and their rows are sliced off."""
+        T = r_trg.shape[0]
+        pad = (-T) % self.mesh.size
+        if pad:
+            far = jnp.full((pad, 3), 1e6, dtype=r_trg.dtype)
+            r_trg = jnp.concatenate([r_trg, far], axis=0)
+        return r_trg, T
+
+    def _fiber_flow(self, state: SimState, caches, r_trg, forces,
+                    subtract_self: bool = True):
+        """Fiber-source flow through the selected pair evaluator
+        (the reference's `params.pair_evaluator` seam,
+        `fiber_container_base.cpp:20-33`). The ring path pads the target rows
+        to a mesh multiple and rotates fiber-node source blocks around the ICI
+        ring; shell/body target rows ride along in the padded target set."""
+        if not self._ring_active():
+            return fc.flow(state.fibers, caches, r_trg, forces, self.params.eta,
+                           subtract_self=subtract_self, evaluator="direct")
+        nfn = state.fibers.n_fibers * state.fibers.n_nodes
+        if nfn % self.mesh.size != 0:
+            raise ValueError(
+                f"pair_evaluator='ring' requires n_fibers*n_nodes ({nfn}) to be "
+                f"divisible by the mesh size ({self.mesh.size}); round the "
+                f"fiber batch up to a multiple of {self.mesh.size} fibers "
+                "(inactive padding fibers are free)")
+        r_pad, T = self._ring_pad_targets(r_trg)
+        vel = fc.flow(state.fibers, caches, r_pad, forces, self.params.eta,
+                      subtract_self=subtract_self, evaluator="ring",
+                      mesh=self.mesh)
+        return vel[:T]
+
+    def _shell_flow(self, state: SimState, r_trg, density):
+        """Shell -> target flow through the pair-evaluator seam
+        (`include/kernels.hpp:78-122`: one evaluator serves all components).
+        The density->f_dl math and source padding live in `peri.flow`; only
+        the target padding is System's job."""
+        if not self._ring_active():
+            return peri.flow(state.shell, r_trg, density, self.params.eta)
+        r_pad, T = self._ring_pad_targets(r_trg)
+        return peri.flow(state.shell, r_pad, density, self.params.eta,
+                         evaluator="ring", mesh=self.mesh)[:T]
 
     # ------------------------------------------------------------- state setup
 
@@ -292,7 +335,7 @@ class System:
             # self-interaction lives in the dense operator (`system.cpp:301-315`)
             r_fibbody = jnp.concatenate(
                 [r_all[:nf_nodes], r_all[nf_nodes + ns_nodes:]], axis=0)
-            v_shell2fibbody = peri.flow(shell, r_fibbody, x_shell, p.eta)
+            v_shell2fibbody = self._shell_flow(state, r_fibbody, x_shell)
             v_all = v_all.at[:nf_nodes].add(v_shell2fibbody[:nf_nodes])
             v_all = v_all.at[nf_nodes + ns_nodes:].add(v_shell2fibbody[nf_nodes:])
 
@@ -389,7 +432,11 @@ class System:
             fiber_error = fc.fiber_error(new_state.fibers)
 
         info = StepInfo(converged=result.converged, iters=result.iters,
-                        residual=result.residual, fiber_error=fiber_error)
+                        residual=result.residual, fiber_error=fiber_error,
+                        residual_true=result.residual_true,
+                        loss_of_accuracy=(result.converged
+                                          & (result.residual_true
+                                             > 10.0 * p.gmres_tol)))
         return new_state, result.x, info
 
     # -------------------------------------------------------- velocity field
@@ -509,11 +556,20 @@ class System:
         line per step {t, dt, iters, residual, fiber_error, accepted, wall_s}
         — the structured-metrics upgrade SURVEY.md §5.1 calls for.
         """
+        metrics_fh = open(metrics_path, "a") if metrics_path else None
+        try:
+            state = self._run_loop(state, writer=writer, max_steps=max_steps,
+                                   rng=rng, metrics_fh=metrics_fh)
+        finally:
+            if metrics_fh is not None:
+                metrics_fh.close()
+        return state
+
+    def _run_loop(self, state: SimState, *, writer, max_steps, rng, metrics_fh):
         from .dynamic_instability import apply_dynamic_instability
 
         p = self.params
         n_steps = 0
-        metrics_fh = open(metrics_path, "a") if metrics_path else None
         while float(state.time) < p.t_final:
             if max_steps is not None and n_steps >= max_steps:
                 break
@@ -548,14 +604,25 @@ class System:
                     raise RuntimeError("Timestep smaller than dt_min")
 
             logger.info(
-                "step t=%.6g dt=%.4g iters=%d residual=%.3e fiber_error=%.3e "
-                "%s (%.3fs)", float(state.time), dt, int(info.iters),
-                float(info.residual), fiber_error,
+                "step t=%.6g dt=%.4g iters=%d residual=%.3e (true %.3e) "
+                "fiber_error=%.3e %s (%.3fs)", float(state.time), dt,
+                int(info.iters), float(info.residual),
+                float(info.residual_true), fiber_error,
                 "accepted" if accept else "rejected", wall_s)
+            if bool(info.loss_of_accuracy):
+                # `solver_hydro.cpp:85-92`: implicit convergence with a
+                # drifted explicit residual means the answer is worse than
+                # the solver claims
+                logger.warning(
+                    "GMRES loss of accuracy: implicit residual %.3e converged "
+                    "but explicit ||b-Ax||/||b|| = %.3e (> 10x tol %.1e)",
+                    float(info.residual), float(info.residual_true),
+                    p.gmres_tol)
             if metrics_fh is not None:
                 metrics_fh.write(json.dumps({
                     "t": float(state.time), "dt": dt, "iters": int(info.iters),
                     "residual": float(info.residual),
+                    "residual_true": float(info.residual_true),
                     "fiber_error": fiber_error, "accepted": accept,
                     "wall_s": round(wall_s, 4)}) + "\n")
                 metrics_fh.flush()
@@ -573,6 +640,4 @@ class System:
                         writer(state, solution)
             else:
                 state = backup._replace(dt=jnp.asarray(dt_new, dtype=state.dt.dtype))
-        if metrics_fh is not None:
-            metrics_fh.close()
         return state

@@ -7,8 +7,12 @@ and the reference's minimal Python quaternion (`src/skelly_sim/quaternion.py`).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
-IDENTITY = jnp.asarray([1.0, 0.0, 0.0, 0.0])
+# host-side constant: creating a device array at import time would trigger JAX
+# backend init as a side effect of `import skellysim_tpu` (before callers can
+# pin a platform); consumers jnp.asarray() it with their own dtype
+IDENTITY = np.asarray([1.0, 0.0, 0.0, 0.0])
 
 
 def multiply(q1, q2):

@@ -11,25 +11,10 @@ minutes, so CPU test runs unregister it entirely before JAX initializes any
 backend.
 """
 
-import os
+from skellysim_tpu.utils.bootstrap import force_cpu_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # override: the session env pins axon (TPU)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
-# Unregister the axon factory outright: JAX_PLATFORMS=cpu alone was observed NOT
-# to prevent the axon client init (the sitecustomize hook routes get_backend
-# through backends(), which then initializes axon and can block on the tunnel).
-# Private API, so guard against jax-version drift.
-try:
-    import jax._src.xla_bridge as _xb  # noqa: E402
-
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
+force_cpu_devices(8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

@@ -44,9 +44,10 @@ def test_cli_metrics_file(tmp_path):
     lines = [json.loads(ln) for ln in open(metrics)]
     assert len(lines) >= 2
     for rec in lines:
-        assert set(rec) == {"t", "dt", "iters", "residual", "fiber_error",
-                            "accepted", "wall_s"}
+        assert set(rec) == {"t", "dt", "iters", "residual", "residual_true",
+                            "fiber_error", "accepted", "wall_s"}
         assert rec["accepted"] and rec["residual"] < 1e-8
+        assert rec["residual_true"] < 1e-7
 
 
 def test_cli_run_free_fiber_uniform_background(tmp_path):

@@ -54,3 +54,40 @@ def test_gmres_exact_in_n_iterations():
     assert int(res.iters) <= 30
     explicit = np.linalg.norm(A @ np.asarray(res.x) - b) / np.linalg.norm(b)
     assert explicit < 1e-11
+
+
+def test_gmres_explicit_residual_agrees_with_implicit():
+    """The post-solve explicit residual (`solver_hydro.cpp:81-92` analogue)
+    must agree with the implicit Givens residual to ~10x tol on a conditioned
+    problem, and must equal a hand-computed ||b - Ax|| / ||b||."""
+    A, b = _system(120, 3, cond_boost=3.0)
+    M = np.linalg.inv(np.diag(np.diag(A)))
+    res = gmres(lambda v: jnp.asarray(A) @ v, jnp.asarray(b),
+                precond=lambda v: jnp.asarray(M) @ v, tol=1e-10, restart=40,
+                maxiter=400)
+    assert bool(res.converged)
+    hand = np.linalg.norm(A @ np.asarray(res.x) - b) / np.linalg.norm(b)
+    np.testing.assert_allclose(float(res.residual_true), hand, rtol=1e-6)
+    assert float(res.residual_true) <= 10.0 * 1e-10
+    # implicit and explicit agree to within an order of magnitude
+    assert float(res.residual_true) <= 10.0 * max(float(res.residual), 1e-16)
+
+
+def test_step_info_carries_true_residual():
+    from skellysim_tpu.params import Params
+    from skellysim_tpu.system import BackgroundFlow, System
+    from skellysim_tpu.fibers import container as fc
+
+    t = np.linspace(0, 1, 16)
+    x = np.array([2.0, 0.0, 0.0])[None, :] + t[:, None] * np.array([0.0, 0.0, 1.0])
+    fibers = fc.make_group(x[None], lengths=1.0, bending_rigidity=0.01,
+                           radius=0.0125, dtype=jnp.float64)
+    system = System(Params(eta=1.0, dt_initial=1e-3, t_final=1e-2,
+                           gmres_tol=1e-10, adaptive_timestep_flag=False))
+    state = system.make_state(
+        fibers=fibers,
+        background=BackgroundFlow.make(uniform=(1.0, 0.0, 0.0), dtype=jnp.float64))
+    _, _, info = system.step(state)
+    assert np.isfinite(float(info.residual_true))
+    assert float(info.residual_true) <= 10.0 * 1e-10
+    assert not bool(info.loss_of_accuracy)

@@ -52,8 +52,10 @@ def test_golden_final_positions():
     assert os.path.exists(GOLDEN), (
         f"golden file missing; regenerate with python {__file__} --regen")
     with np.load(GOLDEN) as z:
-        np.testing.assert_allclose(x, z["x"], atol=1e-10)
-        np.testing.assert_allclose(tension, z["tension"], atol=1e-8)
+        # relative-ish tolerance: an adaptive f64 sim can shift by BLAS /
+        # platform / jax version; the golden is not platform-pinned
+        np.testing.assert_allclose(x, z["x"], rtol=1e-8, atol=1e-8)
+        np.testing.assert_allclose(tension, z["tension"], rtol=1e-6, atol=1e-6)
 
 
 if __name__ == "__main__":

@@ -65,3 +65,23 @@ def test_sharded_shell_solve_matches_replicated():
                                np.asarray(s_ref.fibers.x), atol=1e-11)
     np.testing.assert_allclose(np.asarray(s_sh.shell.density),
                                np.asarray(s_ref.shell.density), atol=1e-9)
+
+
+def test_indivisible_shell_rows_raise():
+    """Silent O(n^2)-replication fallback is forbidden (VERDICT weak #3): an
+    indivisible shell row count must fail with an actionable message."""
+    import pytest
+
+    shell_data = precompute_periphery("sphere", n_nodes=100, radius=4.0,
+                                      eta=1.0)  # 300 rows % 8 != 0
+    params = Params(eta=1.0, dt_initial=1e-3, t_final=1e-2, gmres_tol=1e-10,
+                    adaptive_timestep_flag=False)
+    shape = peri.PeripheryShape(kind="sphere", radius=4.0)
+    sys_sh = System(params, shell_shape=shape)
+    mesh = make_mesh(N_DEV)
+    state = _coupled_state(sys_sh, shell_data)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        shard_state(state, mesh)
+    # explicit opt-in replicates instead
+    sharded = shard_state(state, mesh, allow_replicated_shell=True)
+    assert len(sharded.shell.M_inv.sharding.device_set) in (1, N_DEV)
