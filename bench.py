@@ -1,10 +1,18 @@
 """Benchmark: the BASELINE.md matrix, un-crashable, on the best available backend.
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "backend",
-"extra"} no matter what happens: the TPU backend is probed in a subprocess with
-a timeout (the session's axon plugin can either raise UNAVAILABLE or block on
-its tunnel — both killed round 1's bench), and every measurement section is
-individually guarded, falling back to nulls in "extra" rather than crashing.
+"extra"} no matter what happens — and that line is the ONLY thing on stdout:
+at startup fd 1 is duplicated away and replaced with stderr, so any chatty
+library (the axon TPU plugin logs ANSI ERROR lines to stdout; XLA sometimes
+prints multi-KB dumps) can no longer corrupt the driver's JSON parse (the
+round-2 failure: `BENCH_r02.json` `parsed: null`). The same JSON — plus
+per-section partials as they finish — is mirrored to `BENCH.json` so even a
+driver-side timeout leaves a usable artifact.
+
+The TPU backend is probed in a subprocess with a timeout (the session's axon
+plugin can either raise UNAVAILABLE or block on its tunnel — both killed round
+1's bench), and every measurement section is individually guarded, recording a
+one-line error string in "extra" rather than crashing.
 
 Measured sections (see BASELINE.md "Metrics to measure"):
   - stokeslet mobility-matvec throughput, f32 and f64 (pairs/s/chip), vs a
@@ -52,7 +60,55 @@ STOKESLET_FLOPS_PER_PAIR = 30
 PEAK_FLOPS = [("v6", 918e12), ("v5p", 459e12), ("v5", 197e12), ("v4", 275e12)]
 
 
-def _probe_backend(timeout_s: float = 240.0):
+#: real-stdout fd saved by _steal_stdout; the one JSON line goes here
+_REAL_STDOUT_FD = None
+#: partial/final results mirrored here after every section
+BENCH_JSON_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH.json")
+
+
+def _steal_stdout():
+    """Redirect fd 1 to stderr (C-level, so plugin/XLA prints can't pollute
+    the JSON) and keep a private dup of the real stdout for the final line."""
+    global _REAL_STDOUT_FD
+    if _REAL_STDOUT_FD is not None:
+        return
+    _REAL_STDOUT_FD = os.dup(1)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+
+
+def _emit(line: dict):
+    """Write the one JSON line to the real stdout + mirror to BENCH.json."""
+    payload = json.dumps(line)
+    try:
+        with open(BENCH_JSON_PATH, "w") as fh:
+            fh.write(payload + "\n")
+    except Exception:
+        pass
+    fd = _REAL_STDOUT_FD if _REAL_STDOUT_FD is not None else 1
+    os.write(fd, (payload + "\n").encode())
+
+
+def _checkpoint(extra: dict):
+    """Mirror partial results so a driver-side kill still leaves an artifact."""
+    try:
+        with open(BENCH_JSON_PATH, "w") as fh:
+            fh.write(json.dumps({"metric": "bench_partial", "value": 0.0,
+                                 "unit": "", "vs_baseline": 0.0,
+                                 "extra": extra}) + "\n")
+    except Exception:
+        pass
+
+
+def _short_err(e: BaseException, limit: int = 200) -> str:
+    """First line of the exception repr — multi-KB XLA tracebacks embedded in
+    reprs were part of what corrupted round 2's bench output."""
+    first = repr(e).splitlines()[0] if repr(e) else type(e).__name__
+    return first[:limit]
+
+
+def _probe_backend(timeout_s: float = 90.0):
     """Ask a subprocess for the default backend so a wedged TPU plugin can
     never hang or crash the bench process. Returns a backend name or None."""
     code = "import jax; print('BACKEND=' + jax.default_backend())"
@@ -91,12 +147,21 @@ def _numpy_pairs_per_s(n=1024, trials=3):
 
 
 def _rate(fn, n_pairs, trials=3):
-    """pairs/s of a nullary kernel call: compile+warm once, then time."""
-    fn().block_until_ready()
+    """pairs/s of a nullary kernel call: compile+warm once, then time.
+
+    The clock stops only after a host fetch of the last output:
+    `block_until_ready` was observed returning before the work drained (both
+    on the remote axon TPU tunnel and on CPU for one leaf of a larger
+    program), which produced round-2-style impossible >100% MFU readings. A
+    device->host copy of the result is the one barrier that cannot ack early.
+    Executions on one device stream are ordered, so fetching the last trial's
+    output forces all queued trials to completion.
+    """
+    np.asarray(fn())  # compile + warm + drain
     t0 = time.perf_counter()
     for _ in range(trials):
         out = fn()
-    out.block_until_ready()
+    np.asarray(out)  # host fetch: the real completion barrier
     return n_pairs * trials / (time.perf_counter() - t0)
 
 
@@ -127,25 +192,26 @@ def _bench_single_fiber(dtype, tol, trials=3):
     system, state = _make_system(n_fibers=1, n_nodes=64, dtype=dtype)
     system.params = dataclasses.replace(system.params, gmres_tol=tol)
     step = jax.jit(system._solve_impl)
-    _, _, info = step(state)
-    jax.block_until_ready(info.residual)  # compile + warm
+    float(step(state)[2].residual)  # compile + warm + drain
     t0 = time.perf_counter()
     for _ in range(trials):
         _, _, info = step(state)
-    jax.block_until_ready(info.residual)
+    resid = float(info.residual)  # host fetch: the real completion barrier
     wall = (time.perf_counter() - t0) / trials
     return {"wall_s": round(wall, 4), "iters": int(info.iters),
-            "residual": float(info.residual), "tol": tol,
+            "residual": resid, "tol": tol,
             "solves_per_s": round(1.0 / wall, 2)}
 
 
-def _device_shell_operator(nodes, normals, weights, dtype):
+def _device_shell_operator(nodes, normals, weights, dtype, precond_dtype=None):
     """Dense second-kind shell operator + inverse, assembled on-device.
 
     Same math as `periphery.build_shell_operator` (stresslet x normal blocks,
     singularity subtraction, -1/w diagonal, n (x) n complementary term) with
     the O(N^2) assembly and O(N^3) inverse on the accelerator instead of
-    host LAPACK.
+    host LAPACK. ``precond_dtype`` computes the inverse (a preconditioner —
+    accuracy does not matter) in a lower precision: TPU LuDecomposition is
+    f32-only, so an f64 operator still needs an f32 inverse on device.
     """
     import jax.numpy as jnp
 
@@ -174,13 +240,20 @@ def _device_shell_operator(nodes, normals, weights, dtype):
     d = jnp.arange(3 * N)
     M = M.at[d, d].add(-jnp.repeat(1.0 / w_d, 3))
     M = M + jnp.outer(normals_d.reshape(-1), normals_d.reshape(-1))
-    M_inv = jnp.linalg.inv(M)
+    M_inv = jnp.linalg.inv(M.astype(precond_dtype) if precond_dtype else M)
     return M, M_inv
 
 
-def _bench_coupled(shell_n, body_n, dtype, tol, trials=3):
-    """Walkthrough-scale coupled solve: 1 fiber + 1 body + spherical shell."""
+def _bench_coupled(shell_n, body_n, dtype, tol, trials=3, mixed=False):
+    """Walkthrough-scale coupled solve: 1 fiber + 1 body + spherical shell.
+
+    ``mixed=True`` benches the f64-accuracy TPU path: f64 state with the
+    mixed-precision solver (f32 Krylov flows + LU preconditioners, f64
+    iterative refinement to ``tol``) — the apples-to-apples comparison
+    against the reference's 0.328 s/solve at tol 4.6e-11.
+    """
     import jax
+    import jax.numpy as jnp
 
     from skellysim_tpu.bodies import bodies as bd
     from skellysim_tpu.fibers import container as fc
@@ -191,12 +264,15 @@ def _bench_coupled(shell_n, body_n, dtype, tol, trials=3):
     from skellysim_tpu.system import System
 
     t_setup = time.perf_counter()
+    pdt = jnp.float32 if mixed else None
     radius = 6.0
     spec = sphere_shape(shell_n, radius=radius * 1.04)
     normals = -spec.node_normals  # shell normals point inward
     weights = np.full(shell_n, 4 * np.pi * (radius * 1.04) ** 2 / shell_n)
-    op, M_inv = _device_shell_operator(spec.nodes, normals, weights, dtype)
-    shell = peri.make_state(spec.nodes, normals, weights, op, M_inv, dtype=dtype)
+    op, M_inv = _device_shell_operator(spec.nodes, normals, weights, dtype,
+                                       precond_dtype=pdt)
+    shell = peri.make_state(spec.nodes, normals, weights, op, M_inv,
+                            dtype=dtype, precond_dtype=pdt)
 
     body_pre = precompute_body("sphere", body_n, radius=0.5)
     bodies = bd.make_group(
@@ -212,6 +288,7 @@ def _bench_coupled(shell_n, body_n, dtype, tol, trials=3):
 
     params = Params(eta=1.0, dt_initial=0.1, t_final=1.0, gmres_tol=tol,
                     gmres_restart=60, gmres_maxiter=120,
+                    solver_precision="mixed" if mixed else "full",
                     adaptive_timestep_flag=False)
     system = System(params, shell_shape=peri.PeripheryShape(kind="sphere",
                                                             radius=radius))
@@ -219,16 +296,15 @@ def _bench_coupled(shell_n, body_n, dtype, tol, trials=3):
     setup_s = time.perf_counter() - t_setup
 
     step = jax.jit(system._solve_impl)
-    _, _, info = step(state)
-    jax.block_until_ready(info.residual)  # compile + warm
+    float(step(state)[2].residual)  # compile + warm + drain
     t0 = time.perf_counter()
     for _ in range(trials):
         _, _, info = step(state)
-    jax.block_until_ready(info.residual)
+    resid = float(info.residual)  # host fetch: the real completion barrier
     wall = (time.perf_counter() - t0) / trials
     return {"wall_s": round(wall, 4), "iters": int(info.iters),
-            "residual": float(info.residual), "tol": tol,
-            "shell_n": shell_n, "body_n": body_n,
+            "residual": resid, "residual_true": float(info.residual_true),
+            "tol": tol, "shell_n": shell_n, "body_n": body_n,
             "setup_s": round(setup_s, 2),
             "ref_wall_s": REF_SOLVE_WALL_S, "ref_iters": REF_SOLVE_ITERS,
             "vs_ref": round(REF_SOLVE_WALL_S / wall, 2)}
@@ -237,7 +313,9 @@ def _bench_coupled(shell_n, body_n, dtype, tol, trials=3):
 def main():
     extra = {}
 
+    t_probe = time.perf_counter()
     probed = _probe_backend()
+    extra["probe_s"] = round(time.perf_counter() - t_probe, 1)
     if probed in (None, "cpu"):
         from skellysim_tpu.utils.bootstrap import force_cpu_devices
 
@@ -257,18 +335,22 @@ def main():
 
     # --- kernel throughput, f32 + f64 ---------------------------------------
     n32 = 65536 if on_acc else 8192
-    n64 = 16384 if on_acc else 4096
+    # f64 on TPU is software-emulated; 16384^2 pairs did not finish in
+    # round-3 probing, so measure at a size that reliably completes
+    n64 = 4096
     rate32 = rate64 = None
     try:
         rate32 = _kernel_rate(jnp.float32, n32)
         extra["stokeslet_f32"] = {"n": n32, "gpairs_per_s": round(rate32 / 1e9, 4)}
     except Exception as e:
-        extra["stokeslet_f32"] = {"error": repr(e)}
+        extra["stokeslet_f32"] = {"error": _short_err(e)}
+    _checkpoint(extra)
     try:
         rate64 = _kernel_rate(jnp.float64, n64)
         extra["stokeslet_f64"] = {"n": n64, "gpairs_per_s": round(rate64 / 1e9, 4)}
     except Exception as e:
-        extra["stokeslet_f64"] = {"error": repr(e)}
+        extra["stokeslet_f64"] = {"error": _short_err(e)}
+    _checkpoint(extra)
 
     # Pallas fused tiles (accelerator only): report whichever path wins
     if on_acc and rate32 is not None:
@@ -278,16 +360,12 @@ def main():
             rng = np.random.default_rng(1)
             r = jnp.asarray(rng.uniform(-5, 5, (n32, 3)), dtype=jnp.float32)
             f = jnp.asarray(rng.standard_normal((n32, 3)), dtype=jnp.float32)
-            stokeslet_pallas(r, r, f, 1.0).block_until_ready()
-            t0 = time.perf_counter()
-            for _ in range(3):
-                out = stokeslet_pallas(r, r, f, 1.0)
-            out.block_until_ready()
-            prate = n32 * n32 * 3 / (time.perf_counter() - t0)
+            prate = _rate(lambda: stokeslet_pallas(r, r, f, 1.0), n32 * n32)
             extra["stokeslet_f32_pallas"] = {"gpairs_per_s": round(prate / 1e9, 4)}
             rate32 = max(rate32, prate)
         except Exception as e:
-            extra["stokeslet_f32_pallas"] = {"error": repr(e)}
+            extra["stokeslet_f32_pallas"] = {"error": _short_err(e)}
+        _checkpoint(extra)
 
     # MFU estimate against the chip's dense peak (bf16 for TPUs)
     if rate32 is not None and extra.get("device_kind"):
@@ -304,24 +382,67 @@ def main():
     try:
         extra["single_fiber"] = _bench_single_fiber(dtype, tol)
     except Exception as e:
-        extra["single_fiber"] = {"error": repr(e)}
+        extra["single_fiber"] = {"error": _short_err(e)}
+    _checkpoint(extra)
 
     # --- walkthrough-scale coupled solve ------------------------------------
     shell_n = 6000 if on_acc else 600
     try:
         extra["coupled_solve"] = _bench_coupled(shell_n, 400, dtype, tol)
     except Exception as e:
-        extra["coupled_solve"] = {"error": repr(e)}
+        extra["coupled_solve"] = {"error": _short_err(e)}
         if on_acc:  # e.g. device OOM: retry once at CPU-fallback scale
             try:
                 shell_n = 600
                 extra["coupled_solve"] = _bench_coupled(shell_n, 400, dtype, tol)
             except Exception as e2:
-                extra["coupled_solve"] = {"error": repr(e2)}
+                extra["coupled_solve"] = {"error": _short_err(e2)}
+    _checkpoint(extra)
+
+    # --- mixed-precision coupled solve at the reference's tolerance ----------
+    # f64 state + f32 Krylov/preconditioners + iterative refinement: the
+    # apples-to-apples number against the reference's 0.328 s at 4.6e-11
+    try:
+        extra["coupled_solve_mixed"] = _bench_coupled(
+            shell_n, 400, jnp.float64, 1e-10, mixed=True)
+    except Exception as e:
+        extra["coupled_solve_mixed"] = {"error": _short_err(e)}
+    _checkpoint(extra)
+
+    # --- trajectory frame encode at BASELINE scale (10k fibers x 64 nodes) ---
+    try:
+        from skellysim_tpu.fibers import container as fc
+        from skellysim_tpu.io.trajectory import frame_bytes
+        from skellysim_tpu.system.system import SimState
+
+        rng = np.random.default_rng(7)
+        xf = jnp.asarray(rng.standard_normal((10000, 64, 3)), dtype=jnp.float32)
+        big = fc.make_group(xf, lengths=1.0, bending_rigidity=0.01,
+                            radius=0.0125, dtype=jnp.float32)
+        st = SimState(time=jnp.float32(0.0), dt=jnp.float32(0.1), fibers=big,
+                      points=None, background=None)
+        t0 = time.perf_counter()
+        buf = frame_bytes(st)
+        extra["frame_encode_10k"] = {
+            "encode_s": round(time.perf_counter() - t0, 3),
+            "frame_mb": round(len(buf) / 1e6, 1)}
+    except Exception as e:
+        extra["frame_encode_10k"] = {"error": _short_err(e)}
+    _checkpoint(extra)
 
     # --- headline ------------------------------------------------------------
     coupled = extra.get("coupled_solve", {})
-    if "wall_s" in coupled and coupled.get("shell_n") == 6000:
+    mixed = extra.get("coupled_solve_mixed", {})
+    if "wall_s" in mixed and mixed.get("shell_n") == 6000:
+        # full reference tolerance (1e-10) at walkthrough scale: the honest
+        # apples-to-apples headline
+        line = {
+            "metric": "coupled_solve_walkthrough_mixed_wall_s",
+            "value": mixed["wall_s"],
+            "unit": "s/solve",
+            "vs_baseline": mixed["vs_ref"],
+        }
+    elif "wall_s" in coupled and coupled.get("shell_n") == 6000:
         line = {
             "metric": "coupled_solve_walkthrough_wall_s",
             "value": coupled["wall_s"],
@@ -342,13 +463,14 @@ def main():
                 "vs_baseline": 0.0}
     line["backend"] = backend
     line["extra"] = extra
-    print(json.dumps(line))
+    _emit(line)
 
 
 if __name__ == "__main__":
+    _steal_stdout()
     try:
         main()
     except Exception as e:  # absolute backstop: the driver must see valid JSON
-        print(json.dumps({"metric": "bench_failed", "value": 0.0, "unit": "",
-                          "vs_baseline": 0.0, "error": repr(e)}))
+        _emit({"metric": "bench_failed", "value": 0.0, "unit": "",
+               "vs_baseline": 0.0, "error": _short_err(e)})
         sys.exit(0)

@@ -132,9 +132,12 @@ def place(group: BodyGroup):
     return nodes, normals, sites
 
 
-def update_cache(group: BodyGroup, eta) -> BodyCaches:
+def update_cache(group: BodyGroup, eta, precond_dtype=None) -> BodyCaches:
     """Lab placement + singularity subtraction + K matrix + dense LU
-    (`update_cache_variables`, `body_spherical.cpp:94-127`)."""
+    (`update_cache_variables`, `body_spherical.cpp:94-127`).
+
+    ``precond_dtype`` stores the LU factors in a lower precision (f32 for
+    TPU, whose LuDecomposition is f32-only)."""
     nodes, normals, sites = place(group)
     nb, n = group.n_bodies, group.n_nodes
 
@@ -173,6 +176,8 @@ def update_cache(group: BodyGroup, eta) -> BodyCaches:
         return jnp.concatenate([top, bottom], axis=0)
 
     A = jax.vmap(build_A)(nodes, normals, group.weights, ex, ey, ez, K)
+    if precond_dtype is not None:
+        A = A.astype(precond_dtype)
     lu, piv = jax.vmap(jax.scipy.linalg.lu_factor)(A)
 
     return BodyCaches(nodes=nodes, normals=normals, nucleation_sites=sites,
@@ -203,9 +208,11 @@ def matvec(group: BodyGroup, caches: BodyCaches, x_bodies, v_bodies):
 
 
 def apply_preconditioner(group: BodyGroup, caches: BodyCaches, x_bodies):
-    """Dense LU solves (`apply_preconditioner`, `body_spherical.cpp:37`)."""
-    return jax.vmap(lambda lu, piv, b: jax.scipy.linalg.lu_solve((lu, piv), b))(
-        caches.lu, caches.piv, x_bodies)
+    """Dense LU solves (`apply_preconditioner`, `body_spherical.cpp:37`);
+    solves in the LU factors' (possibly lower) precision and casts back."""
+    out = jax.vmap(lambda lu, piv, b: jax.scipy.linalg.lu_solve((lu, piv), b))(
+        caches.lu, caches.piv, x_bodies.astype(caches.lu.dtype))
+    return out.astype(x_bodies.dtype)
 
 
 def update_RHS(group: BodyGroup, v_on_bodies):

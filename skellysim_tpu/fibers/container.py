@@ -25,7 +25,7 @@ import numpy as np
 from ..ops import kernels
 from . import fd_fiber
 from .fd_fiber import FiberScalars
-from .matrices import FibMats, get_mats
+from .matrices import FibMats, get_mats, typed
 
 
 class FiberGroup(NamedTuple):
@@ -57,7 +57,8 @@ class FiberGroup(NamedTuple):
 
     @property
     def mats(self) -> FibMats:
-        return get_mats(self.n_nodes)
+        # cast to the state dtype so f32 groups never promote to f64 under x64
+        return typed(get_mats(self.n_nodes), self.x.dtype)
 
     def scalars(self) -> FiberScalars:
         return FiberScalars(self.length, self.length_prev, self.bending_rigidity,
@@ -136,12 +137,15 @@ def update_cache(group: FiberGroup, dt, eta) -> FiberCaches:
 
 
 def update_rhs_and_bc(group: FiberGroup, caches: FiberCaches, dt, eta,
-                      v_on_fibers, f_total, f_ext) -> FiberCaches:
+                      v_on_fibers, f_total, f_ext,
+                      precond_dtype=None) -> FiberCaches:
     """Assemble BC-applied A/RHS and the batched LU preconditioner.
 
     Mirrors the prep sequence of `System::prep_state_for_solver`
     (`system.cpp:448-453`): RHS uses the total force (motor + external), the BC
-    rows use only the external force.
+    rows use only the external force. ``precond_dtype`` stores the LU factors
+    in a lower precision (f32 for TPU, whose LuDecomposition is f32-only)
+    while A/RHS stay in the state dtype.
     """
     mats = group.mats
     sc = group.scalars()
@@ -162,7 +166,8 @@ def update_rhs_and_bc(group: FiberGroup, caches: FiberCaches, dt, eta,
     A_bc = jnp.where(act, A_bc, eye)
     RHS_bc = jnp.where(group.active[:, None], RHS_bc, 0.0)
 
-    lu, piv = jax.vmap(jax.scipy.linalg.lu_factor)(A_bc)
+    A_lu = A_bc if precond_dtype is None else A_bc.astype(precond_dtype)
+    lu, piv = jax.vmap(jax.scipy.linalg.lu_factor)(A_lu)
     return caches._replace(A_bc=A_bc, RHS=RHS_bc, lu=lu, piv=piv)
 
 
@@ -223,9 +228,14 @@ def matvec(group: FiberGroup, caches: FiberCaches, x_all, v_fib, v_boundary) -> 
 
 
 def apply_preconditioner(group: FiberGroup, caches: FiberCaches, x_all) -> jnp.ndarray:
-    """Batched LU solves, [nf, 4n] (`apply_preconditioner`, `:331-339`)."""
-    return jax.vmap(lambda lu, piv, b: jax.scipy.linalg.lu_solve((lu, piv), b))(
-        caches.lu, caches.piv, x_all)
+    """Batched LU solves, [nf, 4n] (`apply_preconditioner`, `:331-339`).
+
+    Solves in the LU factors' (possibly lower) precision and casts back — a
+    preconditioner only needs to approximate A^-1.
+    """
+    out = jax.vmap(lambda lu, piv, b: jax.scipy.linalg.lu_solve((lu, piv), b))(
+        caches.lu, caches.piv, x_all.astype(caches.lu.dtype))
+    return out.astype(x_all.dtype)
 
 
 def step(group: FiberGroup, fiber_sol) -> FiberGroup:

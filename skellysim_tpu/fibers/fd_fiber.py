@@ -30,6 +30,8 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+from . import matrices
+
 DEFAULT_PENALTY = 500.0   # penalty_param_, fiber_finite_difference.hpp:31
 DEFAULT_BETA_TSTEP = 1.0  # beta_tstep_, fiber_finite_difference.hpp:36
 
@@ -56,6 +58,7 @@ def sbt_constants(radius, length, eta):
 
 def derivatives(x, length_prev, mats):
     """xs..xssss [n, 3] at the *previous accepted* length (`update_derivatives`)."""
+    mats = matrices.typed(mats, x.dtype)
     s = 2.0 / length_prev
     xs = s * (mats.D1 @ x)
     xss = s**2 * (mats.D2 @ x)
@@ -71,6 +74,7 @@ def build_A(xs, xss, xsss, dt, eta, sc: FiberScalars, mats):
     matrices are scaled to the *target* length (`fiber_finite_difference.cpp:102-105`).
     """
     n = xs.shape[0]
+    mats = matrices.typed(mats, xs.dtype)
     E = sc.bending_rigidity
     c0, c1 = sbt_constants(sc.radius, sc.length, eta)
     s = 2.0 / sc.length
@@ -105,6 +109,7 @@ def build_A(xs, xss, xsss, dt, eta, sc: FiberScalars, mats):
 def build_RHS(x, xs, xss, dt, eta, sc: FiberScalars, mats, flow=None, f_external=None):
     """Full (pre-BC) RHS [4n] (`update_RHS`, `fiber_finite_difference.cpp:198-274`)."""
     n = x.shape[0]
+    mats = matrices.typed(mats, x.dtype)
     c0, c1 = sbt_constants(sc.radius, sc.length, eta)
     D1s = (2.0 / sc.length) * mats.D1
     alpha = jnp.asarray(mats.alpha, dtype=x.dtype)
@@ -137,6 +142,7 @@ def _bc_rows(x, xs, xss, dt, eta, sc: FiberScalars, mats,
     """
     n = x.shape[0]
     dtype = x.dtype
+    mats = matrices.typed(mats, dtype)
     E = sc.bending_rigidity
     c0, _c1 = sbt_constants(sc.radius, sc.length, eta)
     s = 2.0 / sc.length
@@ -252,6 +258,7 @@ def force_operator(xs, xss, eta, sc: FiberScalars, mats):
     `fiber_finite_difference.cpp:317-335`).
     """
     n = xs.shape[0]
+    mats = matrices.typed(mats, xs.dtype)
     s = 2.0 / sc.length
     D1s, D4s = s * mats.D1, s**4 * mats.D4
     E = sc.bending_rigidity
@@ -274,6 +281,7 @@ def matvec(A_bc, xvec, v, v_boundary, xs, sc: FiberScalars, mats, plus_pinned):
     ``v_boundary`` is the 7-row body-link condition (zeros when unattached).
     """
     n = xs.shape[0]
+    mats = matrices.typed(mats, xvec.dtype)
     bc_start = 4 * n - 14
     D1p = (2.0 / sc.length_prev) * mats.D1
     vT_tension = D1p @ jnp.sum(xs * v, axis=1)
@@ -291,5 +299,6 @@ def matvec(A_bc, xvec, v, v_boundary, xs, sc: FiberScalars, mats, plus_pinned):
 
 def fiber_error(x, length, mats):
     """max_i | ||xs_i|| - 1 | — inextensibility violation (`fiber_error_local`)."""
+    mats = matrices.typed(mats, x.dtype)
     xs = (2.0 / length) * (mats.D1 @ x)
     return jnp.max(jnp.abs(jnp.linalg.norm(xs, axis=1) - 1.0))

@@ -45,6 +45,40 @@ class FibMats:
     weights0: np.ndarray       # [n] trapezoid weights on [-1, 1]
 
 
+def _cast_mats(m: FibMats, dtype_name: str) -> FibMats:
+    def c(a):
+        return np.asarray(a, dtype=dtype_name)
+
+    return FibMats(m.n_nodes, c(m.alpha), c(m.alpha_roots), c(m.alpha_tension),
+                   c(m.D1), c(m.D2), c(m.D3), c(m.D4),
+                   c(m.P_X), c(m.P_T), c(m.P_down), c(m.weights0))
+
+
+@lru_cache(maxsize=None)
+def _typed_mats(n_nodes: int, dtype_name: str) -> FibMats:
+    return _cast_mats(get_mats(n_nodes), dtype_name)
+
+
+def typed(mats: FibMats, dtype) -> FibMats:
+    """FibMats with every array cast to ``dtype``.
+
+    The matrices are built in float64 for accuracy, but closing f64 NumPy
+    constants over f32 jit code promotes every downstream op to f64 under
+    `jax_enable_x64` — which breaks the TPU path (XLA `LuDecomposition` is
+    f32-only on TPU). Cast once here; use-site dtype follows the state.
+
+    The canonical `get_mats` instance casts through a per-resolution cache;
+    a caller-customized FibMats is cast directly (never swapped for the
+    pristine cached matrices).
+    """
+    name = np.dtype(dtype).name
+    if mats.D1.dtype == np.dtype(dtype):
+        return mats
+    if mats is get_mats(mats.n_nodes):
+        return _typed_mats(mats.n_nodes, name)
+    return _cast_mats(mats, name)
+
+
 @lru_cache(maxsize=None)
 def get_mats(n_nodes: int) -> FibMats:
     if n_nodes not in VALID_NODE_COUNTS:

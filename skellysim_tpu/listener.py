@@ -9,13 +9,18 @@ velocity fields are computed from it, and a msgpack response
 to stdout. A zero-length message terminates the server.
 
 The `evaluator` field selected CPU/GPU/FMM backends in the reference
-(`listener.cpp:117`); here there is a single XLA backend, so it is accepted
-and ignored. An invalid frame_no answers with a zero-length response like the
-reference (`listener.cpp:111-116`).
+(`listener.cpp:117`, `System::set_evaluator`, `system.cpp:389-393`); it maps
+onto our pair-evaluator seam: "CPU"/"GPU" -> "direct" (dense XLA kernels —
+the device is whatever backend JAX runs on), "FMM" -> "ring" (the distributed
+source-block rotation, the structural analogue of the reference's only
+multi-rank evaluator). Our native names are also accepted. An invalid
+frame_no answers with a zero-length response like the reference
+(`listener.cpp:111-116`).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import struct
 import sys
 
@@ -31,6 +36,23 @@ from .system.system import solution_from_state
 
 _LINE_DEFAULTS = dict(dt_init=0.1, t_final=1.0, abs_err=1e-10, rel_err=1e-6,
                       back_integrate=True)
+
+#: reference evaluator names (`listener.cpp:117`) -> runtime pair evaluators
+EVALUATOR_MAP = {"CPU": "direct", "GPU": "direct", "FMM": "ring",
+                 "direct": "direct", "ring": "ring"}
+
+
+def switch_evaluator(system, evaluator: str | None):
+    """Rebuild the System for a requested evaluator (`System::set_evaluator`,
+    `system.cpp:389-393`). Returns (system, switched); unknown or absent
+    names keep the current evaluator."""
+    ev = EVALUATOR_MAP.get(evaluator) if evaluator else None
+    if ev is None or ev == system.params.pair_evaluator:
+        return system, False
+    from .system import System
+
+    return System(dataclasses.replace(system.params, pair_evaluator=ev),
+                  shell_shape=system.shell_shape, mesh=system.mesh), True
 
 
 def _line_kwargs(req: dict) -> dict:
@@ -135,6 +157,12 @@ def serve(config_file: str = "skelly_config.toml",
                 return
             payload += chunk
         cmd = eigen.decode_tree(msgpack.unpackb(payload, raw=False))
+
+        system, switched = switch_evaluator(system, cmd.get("evaluator"))
+        if switched:
+            # new System -> new jit cache; rebind the stable velocity fn
+            def vel_fn(pts, state, solution, _sys=system):
+                return _sys._velocity_at_targets_impl(state, solution, pts)
 
         response = process_request(system, template_state, reader, cmd,
                                    vel_fn=vel_fn)

@@ -62,6 +62,21 @@ class Params:
     # rotate the ICI ring via collective-permute (free-space fiber systems on
     # a mesh; falls back to direct when a shell/bodies are present)
     pair_evaluator: str = "direct"
+    # solver precision strategy (no reference analogue — the reference is
+    # f64-everywhere on CPU; TPU XLA's LuDecomposition is f32-only and the
+    # MXU prefers f32/bf16):
+    #   "full"  — everything in the state dtype (f64 states need a CPU or an
+    #             f64-capable LU path; f32 states run anywhere)
+    #   "mixed" — f64 state/assembly/residuals, f32 Krylov loop + LU
+    #             preconditioner, iterative refinement to gmres_tol
+    #             (solver.gmres_ir); reaches the reference's 1e-10 tolerance
+    #             with the hot loop at accelerator-native f32
+    solver_precision: str = "full"
+    # inner (f32) GMRES tolerance per refinement sweep in "mixed" mode;
+    # each sweep contracts the error by about this factor
+    inner_tol: float = 1e-6
+    # max refinement sweeps in "mixed" mode
+    max_refine: int = 8
     implicit_motor_activation_delay: float = 0.0
     periphery_interaction_flag: bool = False
     dynamic_instability: DynamicInstability = field(default_factory=DynamicInstability)

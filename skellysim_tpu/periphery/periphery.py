@@ -93,13 +93,16 @@ def build_shell_operator(nodes, normals, weights, eta: float = 1.0):
     return M, M_inv
 
 
-def make_state(nodes, normals, weights, operator, M_inv, dtype=jnp.float64) -> PeripheryState:
+def make_state(nodes, normals, weights, operator, M_inv, dtype=jnp.float64,
+               precond_dtype=None) -> PeripheryState:
+    """``precond_dtype`` stores M_inv (the preconditioner — accuracy does not
+    matter) in a lower precision, halving its HBM footprint in mixed mode."""
     N = len(nodes)
     return PeripheryState(
         nodes=jnp.asarray(nodes, dtype=dtype),
         normals=jnp.asarray(normals, dtype=dtype),
         weights=jnp.asarray(weights, dtype=dtype),
-        M_inv=jnp.asarray(M_inv, dtype=dtype),
+        M_inv=jnp.asarray(M_inv, dtype=precond_dtype or dtype),
         stresslet_plus_complementary=jnp.asarray(operator, dtype=dtype),
         density=jnp.zeros(3 * N, dtype=dtype),
     )
@@ -113,8 +116,9 @@ def matvec(shell: PeripheryState, x, v_on_shell):
 
 
 def apply_preconditioner(shell: PeripheryState, x):
-    """P^-1 x = M_inv x (`periphery.cpp:21-29`)."""
-    return shell.M_inv @ x
+    """P^-1 x = M_inv x (`periphery.cpp:21-29`); applied in M_inv's (possibly
+    lower) precision and cast back."""
+    return (shell.M_inv @ x.astype(shell.M_inv.dtype)).astype(x.dtype)
 
 
 def update_RHS(v_on_shell):

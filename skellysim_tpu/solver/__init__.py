@@ -1,1 +1,1 @@
-from .gmres import gmres, GmresResult  # noqa: F401
+from .gmres import gmres, gmres_ir, GmresResult  # noqa: F401

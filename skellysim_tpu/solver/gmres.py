@@ -56,16 +56,19 @@ def _icgs(V, w, k, n_restart):
 
 
 @partial(jax.jit, static_argnames=("matvec", "precond", "restart", "maxiter",
-                                   "debug"))
+                                   "debug", "explicit_residual"))
 def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
           tol: float = 1e-10, restart: int = 100, maxiter: int = 1000,
-          debug: bool = False) -> GmresResult:
+          debug: bool = False, explicit_residual: bool = True) -> GmresResult:
     """Solve ``matvec(x) = b`` with right-preconditioned restarted GMRES.
 
     ``precond`` approximates A^-1 (applied on the right). Initial guess is zero,
     like the reference's freshly constructed solution vector each step.
     ``debug=True`` prints the implicit residual after each restart cycle (the
     analogue of Belos' per-iteration verbosity, `solver_hydro.cpp:73-83`).
+    ``explicit_residual=False`` skips the post-solve ``b - A x`` check (one
+    matvec) and reports the implicit residual as ``residual_true`` — for
+    callers like `gmres_ir` that compute their own explicit residual anyway.
     """
     n = b.shape[0]
     dtype = b.dtype
@@ -158,6 +161,65 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
     init_resid = jnp.where(b_norm > 0.0, jnp.array(jnp.inf, dtype=dtype), jnp.array(0.0, dtype=dtype))
     x, resid, iters, _ = lax.while_loop(
         outer_cond, outer_body, (x0, init_resid, jnp.int32(0), jnp.int32(0)))
-    resid_true = jnp.linalg.norm(b - matvec(x)) / safe_b_norm
+    resid_true = (jnp.linalg.norm(b - matvec(x)) / safe_b_norm
+                  if explicit_residual else resid)
     return GmresResult(x=x, iters=iters, residual=resid, converged=resid <= tol,
                        residual_true=resid_true)
+
+
+@partial(jax.jit, static_argnames=("matvec_hi", "matvec_lo", "precond_lo",
+                                   "restart", "maxiter", "max_refine"))
+def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
+             precond_lo: Callable | None = None, tol: float = 1e-10,
+             inner_tol: float = 1e-5, restart: int = 100, maxiter: int = 1000,
+             max_refine: int = 8) -> GmresResult:
+    """Mixed-precision GMRES with iterative refinement.
+
+    The TPU-native answer to the reference's f64 accuracy gates (GMRES tol
+    1e-10, `solver_hydro.cpp:71-78`; kernel agreement 5e-9,
+    `tests/core/kernel_test.cpp:93`) on hardware whose `LuDecomposition` is
+    f32-only and whose MXU prefers f32/bf16:
+
+      * ``matvec_lo`` / ``precond_lo`` take and return ``b.dtype`` (f64)
+        vectors but may evaluate their expensive interior — the O(N^2)
+        kernel flows, the dense shell matmul, the batched LU solves — in
+        f32 (see `System._apply_matvec(lo=...)`). Stiff small ops (the
+        fiber 4nx4n blocks, whose rows reach ~1e7: f32 entry rounding
+        injects O(1) absolute noise there) stay f64 — they are a vanishing
+        fraction of the flops;
+      * ``matvec_hi`` is the exact f64 operator — used once per refinement
+        sweep for the true residual r = b - A x;
+      * iterative refinement: solve A d = r with the cheap operator to
+        ``inner_tol``, update x += d, repeat until the **explicit f64
+        residual** meets ``tol``. Each sweep contracts the residual by
+        ~max(inner_tol, operator noise), so 1e-10 takes 2-3 sweeps.
+
+    Returns a `GmresResult` whose ``residual`` IS the explicit f64 relative
+    residual (no implicit/explicit drift possible, unlike plain restarted
+    GMRES).
+    """
+    M = precond_lo if precond_lo is not None else (lambda v: v)
+    b_norm = jnp.linalg.norm(b)
+    safe_b_norm = jnp.where(b_norm > 0.0, b_norm, 1.0)
+
+    def cond(state):
+        x, r, r_rel, outer, total = state
+        del x, r
+        return (r_rel > tol) & (outer < max_refine)
+
+    def body(state):
+        x, r, _, outer, total = state
+        d = gmres(matvec_lo, r, precond=M, tol=inner_tol,
+                  restart=restart, maxiter=maxiter, explicit_residual=False)
+        x = x + d.x
+        r = b - matvec_hi(x)
+        r_rel = jnp.linalg.norm(r) / safe_b_norm
+        return x, r, r_rel, outer + 1, total + d.iters
+
+    x0 = jnp.zeros_like(b)
+    init_rel = jnp.where(b_norm > 0.0, jnp.asarray(jnp.inf, dtype=b.dtype),
+                         jnp.asarray(0.0, dtype=b.dtype))
+    x, _, r_rel, outers, iters = lax.while_loop(
+        cond, body, (x0, b, init_rel, jnp.int32(0), jnp.int32(0)))
+    return GmresResult(x=x, iters=iters, residual=r_rel,
+                       converged=r_rel <= tol, residual_true=r_rel)

@@ -28,8 +28,15 @@ from ..fibers import container as fc
 from ..params import Params
 from ..periphery import periphery as peri
 from ..periphery.periphery import PeripheryShape, PeripheryState
-from ..solver import gmres
+from ..solver import gmres, gmres_ir
 from .sources import BackgroundFlow, PointSources
+
+
+def _cast_floats(tree, dtype):
+    """Cast every floating leaf of a pytree to ``dtype`` (ints/bools pass)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a, tree)
 
 
 class SimState(NamedTuple):
@@ -88,6 +95,10 @@ class System:
             raise ValueError(
                 f"unknown pair_evaluator {params.pair_evaluator!r}; "
                 "runtime values are 'direct' or 'ring'")
+        if params.solver_precision not in ("full", "mixed"):
+            raise ValueError(
+                f"unknown solver_precision {params.solver_precision!r}; "
+                "use 'full' or 'mixed'")
         self.params = params
         self.shell_shape = shell_shape
         # device mesh for the ring pair evaluator (params.pair_evaluator="ring");
@@ -170,7 +181,14 @@ class System:
         if shell is not None and background is not None and background.is_active():
             # `sanity_check`, system.cpp:625-626
             raise ValueError("background sources are incompatible with peripheries")
-        dtype = fibers.x.dtype if fibers is not None else jnp.float64
+        if fibers is not None:
+            dtype = fibers.x.dtype
+        elif shell is not None:
+            dtype = shell.density.dtype
+        elif bodies is not None:
+            dtype = bodies.solution.dtype
+        else:
+            dtype = jnp.float64
         return SimState(
             time=jnp.asarray(0.0, dtype=dtype),
             dt=jnp.asarray(self.params.dt_initial, dtype=dtype),
@@ -179,15 +197,25 @@ class System:
 
     # ----------------------------------------------------------------- helpers
 
-    def _node_positions(self, state: SimState):
-        """All hydrodynamic node positions [fibers | shell] (`get_node_maps`)."""
+    def _node_positions(self, state: SimState, body_caches=None):
+        """All hydrodynamic node positions [fibers | shell | bodies]
+        (`get_node_maps`).
+
+        Pass ``body_caches`` when available so body node targets reuse the
+        exact cached lab-frame positions the kernel sources use: recomputing
+        `place()` in a different precision shifts "self" pairs off exact
+        coincidence (distance ~1 ulp instead of 0), un-masking the kernel
+        singularity.
+        """
         parts = []
         if state.fibers is not None:
             parts.append(fc.node_positions(state.fibers))
         if state.shell is not None:
             parts.append(state.shell.nodes)
         if state.bodies is not None:
-            parts.append(bd.place(state.bodies)[0].reshape(-1, 3))
+            nodes = (body_caches.nodes if body_caches is not None
+                     else bd.place(state.bodies)[0])
+            parts.append(nodes.reshape(-1, 3))
         if not parts:
             return jnp.zeros((0, 3), dtype=jnp.float64)
         return jnp.concatenate(parts, axis=0)
@@ -271,6 +299,8 @@ class System:
         nf_nodes, ns_nodes, nb_nodes = self._counts(state)
         v_all = jnp.zeros_like(r_all)
 
+        precond_dtype = (jnp.float32 if p.solver_precision == "mixed" else None)
+
         if fibers is not None:
             caches = fc.update_cache(fibers, state.dt, p.eta)
             nf, n = fibers.n_fibers, fibers.n_nodes
@@ -283,7 +313,8 @@ class System:
             v_all = v_all + self._fiber_flow(state, caches, r_all, external)
 
         if state.bodies is not None:
-            body_caches = bd.update_cache(state.bodies, p.eta)
+            body_caches = bd.update_cache(state.bodies, p.eta,
+                                          precond_dtype=precond_dtype)
             # external body forces/torques induce explicit flow everywhere
             # (`system.cpp:430-443`)
             ext_ft = bd.external_forces_torques(state.bodies, state.time)
@@ -300,7 +331,8 @@ class System:
         if fibers is not None:
             v_fib = v_all[:nf_nodes].reshape(nf, n, 3)
             caches = fc.update_rhs_and_bc(fibers, caches, state.dt, p.eta,
-                                          v_fib, motor + external, external)
+                                          v_fib, motor + external, external,
+                                          precond_dtype=precond_dtype)
         if state.shell is not None:
             v_shell = v_all[nf_nodes:nf_nodes + ns_nodes]
             shell_rhs = peri.update_RHS(v_shell)
@@ -309,8 +341,19 @@ class System:
 
     # ------------------------------------------------------- operator closures
 
-    def _apply_matvec(self, state: SimState, caches, body_caches, x_flat):
-        """Coupled operator A x (`apply_matvec`, `system.cpp:269-324`)."""
+    def _apply_matvec(self, state: SimState, caches, body_caches, x_flat,
+                      lo=None):
+        """Coupled operator A x (`apply_matvec`, `system.cpp:269-324`).
+
+        ``lo`` is an optional (state, caches, body_caches) triple whose float
+        leaves are a lower precision (f32). When given, the O(N^2) pairwise
+        flows and the well-scaled shell/body dense ops — i.e. all the flops —
+        are evaluated through it, while the stiff fiber-local ops (A_bc rows
+        reach ~1e7, so f32 entry rounding injects O(1) absolute noise) and the
+        fiber-body link conditions stay in the ``x_flat`` dtype. This is the
+        cheap operator `gmres_ir` iterates with; exactness is restored by the
+        f64 refinement residuals.
+        """
         p = self.params
         fibers = state.fibers
         shell = state.shell
@@ -319,7 +362,14 @@ class System:
         nf_nodes, ns_nodes, nb_nodes = self._counts(state)
         x_shell = x_flat[fib_size:fib_size + shell_size]
 
-        r_all = self._node_positions(state)
+        f_state, f_caches, f_bcaches = (state, caches, body_caches) if lo is None else lo
+        hi_dtype = x_flat.dtype
+        # without a lo seam every cast below is a no-op (lo_dtype == x dtype);
+        # deriving it from state.time would silently up-cast f32 fiberless
+        # states whose time scalar defaulted to f64
+        lo_dtype = hi_dtype if lo is None else lo[0].time.dtype
+
+        r_all = self._node_positions(f_state, f_bcaches)
         v_all = jnp.zeros_like(r_all)
 
         x_fib = None
@@ -327,7 +377,8 @@ class System:
             nf, n = fibers.n_fibers, fibers.n_nodes
             x_fib = x_flat[:fib_size].reshape(nf, 4 * n)
             fw = fc.apply_fiber_force(fibers, caches, x_fib)
-            v_all = v_all + self._fiber_flow(state, caches, r_all, fw,
+            v_all = v_all + self._fiber_flow(f_state, f_caches, r_all,
+                                             fw.astype(lo_dtype),
                                              subtract_self=True)
 
         if shell is not None and (fibers is not None or bodies is not None):
@@ -335,7 +386,8 @@ class System:
             # self-interaction lives in the dense operator (`system.cpp:301-315`)
             r_fibbody = jnp.concatenate(
                 [r_all[:nf_nodes], r_all[nf_nodes + ns_nodes:]], axis=0)
-            v_shell2fibbody = self._shell_flow(state, r_fibbody, x_shell)
+            v_shell2fibbody = self._shell_flow(f_state, r_fibbody,
+                                               x_shell.astype(lo_dtype))
             v_all = v_all.at[:nf_nodes].add(v_shell2fibbody[:nf_nodes])
             v_all = v_all.at[nf_nodes + ns_nodes:].add(v_shell2fibbody[nf_nodes:])
 
@@ -348,22 +400,26 @@ class System:
                 v_boundary, body_ft = bd.link_conditions(
                     bodies, body_caches, fibers, caches, x_fib, x_bodies)
             else:
-                body_ft = jnp.zeros((nb, 6), dtype=x_flat.dtype)
-            v_all = v_all + bd.flow(bodies, body_caches, r_all, x_bodies,
-                                    body_ft, p.eta)
+                body_ft = jnp.zeros((nb, 6), dtype=hi_dtype)
+            v_all = v_all + bd.flow(f_state.bodies, f_bcaches, r_all,
+                                    x_bodies.astype(lo_dtype),
+                                    body_ft.astype(lo_dtype), p.eta)
 
         res = []
         if fibers is not None:
-            v_fib = v_all[:nf_nodes].reshape(nf, n, 3)
+            v_fib = v_all[:nf_nodes].reshape(nf, n, 3).astype(hi_dtype)
             if v_boundary is None:
-                v_boundary = jnp.zeros((nf, 7), dtype=x_flat.dtype)
+                v_boundary = jnp.zeros((nf, 7), dtype=hi_dtype)
             res.append(fc.matvec(fibers, caches, x_fib, v_fib, v_boundary).reshape(-1))
         if shell is not None:
             v_shell = v_all[nf_nodes:nf_nodes + ns_nodes]
-            res.append(peri.matvec(shell, x_shell, v_shell))
+            res.append(peri.matvec(f_state.shell, x_shell.astype(lo_dtype),
+                                   v_shell).astype(hi_dtype))
         if bodies is not None:
             v_bodies = v_all[nf_nodes + ns_nodes:].reshape(nb, n_b, 3)
-            res.append(bd.matvec(bodies, body_caches, x_bodies, v_bodies).reshape(-1))
+            res.append(bd.matvec(f_state.bodies, f_bcaches,
+                                 x_bodies.astype(lo_dtype),
+                                 v_bodies).astype(hi_dtype).reshape(-1))
         return jnp.concatenate(res)
 
     def _apply_precond(self, state: SimState, caches, body_caches, x_flat):
@@ -402,10 +458,26 @@ class System:
             raise ValueError("state has no implicit components to solve")
         rhs = jnp.concatenate(rhs_parts)
 
-        result = gmres(
-            lambda v: self._apply_matvec(state, caches, body_caches, v), rhs,
-            precond=lambda v: self._apply_precond(state, caches, body_caches, v),
-            tol=p.gmres_tol, restart=p.gmres_restart, maxiter=p.gmres_maxiter)
+        if p.solver_precision == "mixed":
+            # f64 state/assembly/refinement residuals; the Krylov loop's
+            # expensive interior (kernel flows, shell/body dense ops, LU
+            # preconditioners) evaluates through f32 copies via the lo seam
+            # of _apply_matvec, while stiff fiber-local ops stay f64
+            lo = _cast_floats((state, caches, body_caches), jnp.float32)
+            result = gmres_ir(
+                lambda v: self._apply_matvec(state, caches, body_caches, v),
+                lambda v: self._apply_matvec(state, caches, body_caches, v,
+                                             lo=lo),
+                rhs,
+                precond_lo=lambda v: self._apply_precond(lo[0], lo[1], lo[2], v),
+                tol=p.gmres_tol, inner_tol=p.inner_tol,
+                restart=p.gmres_restart, maxiter=p.gmres_maxiter,
+                max_refine=p.max_refine)
+        else:
+            result = gmres(
+                lambda v: self._apply_matvec(state, caches, body_caches, v), rhs,
+                precond=lambda v: self._apply_precond(state, caches, body_caches, v),
+                tol=p.gmres_tol, restart=p.gmres_restart, maxiter=p.gmres_maxiter)
 
         fib_size, shell_size, body_size = self._sizes(state)
         new_state = state
@@ -578,7 +650,10 @@ class System:
                 state = apply_dynamic_instability(state, p, rng)
             wall0 = _time.perf_counter()
             new_state, solution, info = self.step(state)
-            jax.block_until_ready(info.residual)
+            # host fetch, not block_until_ready: blocking on one leaf was
+            # observed returning before the program finished, undermeasuring
+            # wall_s by >100x
+            residual = float(info.residual)
             wall_s = _time.perf_counter() - wall0
             n_steps += 1
             converged = bool(info.converged)
@@ -606,7 +681,7 @@ class System:
             logger.info(
                 "step t=%.6g dt=%.4g iters=%d residual=%.3e (true %.3e) "
                 "fiber_error=%.3e %s (%.3fs)", float(state.time), dt,
-                int(info.iters), float(info.residual),
+                int(info.iters), residual,
                 float(info.residual_true), fiber_error,
                 "accepted" if accept else "rejected", wall_s)
             if bool(info.loss_of_accuracy):
@@ -616,12 +691,12 @@ class System:
                 logger.warning(
                     "GMRES loss of accuracy: implicit residual %.3e converged "
                     "but explicit ||b-Ax||/||b|| = %.3e (> 10x tol %.1e)",
-                    float(info.residual), float(info.residual_true),
+                    residual, float(info.residual_true),
                     p.gmres_tol)
             if metrics_fh is not None:
                 metrics_fh.write(json.dumps({
                     "t": float(state.time), "dt": dt, "iters": int(info.iters),
-                    "residual": float(info.residual),
+                    "residual": residual,
                     "residual_true": float(info.residual_true),
                     "fiber_error": fiber_error, "accepted": accept,
                     "wall_s": round(wall_s, 4)}) + "\n")

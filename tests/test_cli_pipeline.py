@@ -170,3 +170,21 @@ def test_builder_rejects_mixed_resolution(tmp_path):
     cfg.fibers = [f1, f2]
     with pytest.raises(ValueError, match="share n_nodes"):
         builder.build_fibers(cfg.fibers, np.float64)
+
+
+def test_listener_evaluator_mapping():
+    """Reference evaluator names map onto the pair-evaluator seam
+    (`listener.cpp:117` -> direct/ring)."""
+    from skellysim_tpu.listener import switch_evaluator
+    from skellysim_tpu.params import Params
+    from skellysim_tpu.system import System
+
+    system = System(Params(adaptive_timestep_flag=False))
+    for name in ("CPU", "GPU", None, "unknown", "direct"):
+        s2, switched = switch_evaluator(system, name)
+        assert not switched and s2 is system, name
+    s2, switched = switch_evaluator(system, "FMM")
+    assert switched and s2.params.pair_evaluator == "ring"
+    # and back
+    s3, switched = switch_evaluator(s2, "CPU")
+    assert switched and s3.params.pair_evaluator == "direct"

@@ -209,3 +209,76 @@ def test_param_tools_arc():
     assert pts.shape == (3, 500)
     # uniform in arc length → t uniform (constant speed curve)
     assert abs(ts.mean() - 2 * np.pi) / (2 * np.pi) < 0.1
+
+
+def test_param_tools_from_data_variants():
+    """Data-driven sampling parity (`param_tools.py:10-123,237-394`)."""
+    # curve data: a unit-speed helix sampled densely
+    t = np.linspace(0, 4 * np.pi, 2000)
+    helix = np.stack([np.cos(t), np.sin(t), 0.5 * t])
+    t2, cum = param_tools.arc_cumulator(t, helix)
+    L_exact = 4 * np.pi * np.sqrt(1.25)
+    assert abs(cum[-1] - L_exact) / L_exact < 1e-4
+
+    coords, ts, ss = param_tools.r_arc_from_data(
+        800, t, helix, rng=np.random.default_rng(2))
+    assert coords.shape == (3, 800)
+    # on the curve: radius 1 in xy
+    np.testing.assert_allclose(np.hypot(coords[0], coords[1]), 1.0, atol=1e-3)
+    # constant-speed curve: s/t constant
+    np.testing.assert_allclose(ss / ts, np.sqrt(1.25), rtol=1e-3)
+
+    # surface data: unit sphere grid
+    tg = np.linspace(0, 2 * np.pi, 160)
+    ug = np.linspace(0, np.pi, 80)
+    T, U = np.meshgrid(tg, ug)
+    sphere = np.stack([np.cos(T) * np.sin(U), np.sin(T) * np.sin(U), np.cos(U)])
+    _, _, cum_S_t, cum_S_u = param_tools.surface_cumulator(T, U, sphere)
+    assert abs(cum_S_t[-1] - 4 * np.pi) / (4 * np.pi) < 5e-3
+    assert abs(cum_S_u[-1] - 4 * np.pi) / (4 * np.pi) < 5e-3
+
+    pts, rt, ru, _, _ = param_tools.r_surface_from_data(
+        3000, T, U, sphere, rng=np.random.default_rng(3))
+    assert pts.shape == (3, 3000)
+    np.testing.assert_allclose(np.linalg.norm(pts, axis=0), 1.0, atol=2e-3)
+    # marginal-CDF sampling on a sphere is uniform in the azimuthal angle
+    assert abs(rt.mean() - np.pi) / np.pi < 0.05
+
+
+def test_param_tools_sample_to_arc():
+    """Arc-length samples (incl. negative) land at the right parameters
+    (`param_tools.py:154-234`)."""
+    def line(t):
+        # constant speed 2 -> arc length s maps to t = s/2
+        t = np.asarray(t, dtype=float)
+        return np.stack([2.0 * t, np.zeros_like(t), np.zeros_like(t)])
+
+    sample = np.array([-3.0, -1.0, 0.0, 0.5, 2.0])
+    xs, ts = param_tools.sample_to_arc(sample, line)
+    np.testing.assert_allclose(ts, sample / 2.0, atol=1e-4)
+    np.testing.assert_allclose(xs[0], sample, atol=1e-4)
+
+    def helix(t):
+        t = np.asarray(t, dtype=float)
+        return np.stack([np.cos(t), np.sin(t), 0.5 * t])
+
+    # speed sqrt(1.25): s = sqrt(1.25) t
+    xs, ts = param_tools.sample_to_arc(np.array([1.0, 5.0]), helix)
+    np.testing.assert_allclose(ts, np.array([1.0, 5.0]) / np.sqrt(1.25),
+                               rtol=1e-3)
+    # t0 offset: arc length measured from t0
+    xs, ts = param_tools.sample_to_arc(np.array([0.0]), line, t0=1.5)
+    np.testing.assert_allclose(ts, [1.5], atol=1e-4)
+
+
+def test_param_tools_sample_to_arc_closed_curve():
+    """Closed curves (chord bounded by the diameter) still invert arc length
+    — chord-based bracketing would fail here."""
+    def circle(t):
+        t = np.asarray(t, dtype=float)
+        return np.stack([np.cos(t), np.sin(t), np.zeros_like(t)])
+
+    # arc length 4.0 > diameter 2: parameter equals arc length on a unit circle
+    xs, ts = param_tools.sample_to_arc(np.array([1.0, 4.0]), circle,
+                                       precision=4000)
+    np.testing.assert_allclose(ts, [1.0, 4.0], rtol=1e-4)

@@ -1,0 +1,155 @@
+"""Physics oracles from the reference's combined test suite.
+
+Three independent checks that stress the BC-row surgery and fiber-fiber
+hydrodynamic coupling (`fd_fiber.py:130-231`) in ways the rest of the suite
+does not:
+
+* fiber under constant tangential motor force vs the slender-body drag
+  gamma = -4 pi L eta / ln(e eps^2)
+  (`/root/reference/tests/combined/test_fiber_const_force.py:40-77`, 1e-6)
+* two-filament interaction: a perturbed driven filament deflects its straight
+  neighbor purely through hydrodynamics; final tip positions vs the
+  reference's committed regression values
+  (`/root/reference/tests/combined/test_fiber_dualfilament.py:50-76`)
+* clamped Euler buckling at sigma = 72 vs 80: below the second critical
+  compression the kicked oscillation decays, above it grows
+  (`/root/reference/tests/combined/test_clamped_buckling_sigma72.py`,
+  `test_clamped_buckling_sigma80.py`)
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skellysim_tpu.config.schema import perturbed_fiber_positions
+from skellysim_tpu.fibers import container as fc
+from skellysim_tpu.params import Params
+from skellysim_tpu.system import System
+from skellysim_tpu.system.sources import PointSources
+
+
+def _straight_fiber(n_nodes, length, origin, direction, **kw):
+    t = np.linspace(0.0, length, n_nodes)
+    x = np.asarray(origin, dtype=float)[None, :] \
+        + t[:, None] * np.asarray(direction, dtype=float)[None, :]
+    return x
+
+
+def test_fiber_const_force_sbt_drag():
+    """Free fiber with tangential motor force translates at F/gamma with
+    gamma the SBT parallel drag; reference gate 1e-6
+    (`test_fiber_const_force.py:40-77`)."""
+    eta, length, force_scale, n_nodes, radius = 0.7, 0.75, 0.31, 8, 0.0125
+    x = _straight_fiber(n_nodes, length, [0, 0, 0], [0, 0, 1])
+    fibers = fc.make_group(x[None], lengths=length, bending_rigidity=0.0025,
+                           radius=radius, force_scale=force_scale,
+                           dtype=jnp.float64)
+    params = Params(eta=eta, dt_initial=1e-4, dt_write=1e-3, t_final=5e-3,
+                    gmres_tol=1e-10, adaptive_timestep_flag=False)
+    system = System(params)
+    state = system.make_state(fibers=fibers)
+
+    x0 = np.asarray(state.fibers.x[0, 0])
+    t0 = float(state.time)
+    state = system.run(state)
+    xf = np.asarray(state.fibers.x[0, 0])
+    tf = float(state.time)
+
+    v = (xf - x0) / (tf - t0)
+    epsilon = radius / length
+    gamma = force_scale * length / v[2]
+    gamma_theory = -4 * np.pi * length * eta / np.log(np.e * epsilon**2)
+    rel = abs(1 - gamma / gamma_theory)
+    assert rel < 1e-6, rel
+
+
+def test_fiber_dualfilament_deflection():
+    """A perturbed compressed filament drives its straight neighbor through
+    hydrodynamics alone; final tip x-positions vs the reference's committed
+    values (`test_fiber_dualfilament.py:60-64`).
+
+    The committed values are the reference implementation's own golden output
+    at these parameters (x0=-0.004765810967995735, x1=1.0048647877439878);
+    agreement here is cross-implementation, so the gate is looser than the
+    reference's self-regression 1e-6 — discretization details (barycentric
+    downsampling order, quadrature) differ at the 1e-3 level.
+    """
+    sigma, length, E, n_nodes = 0.0225, 2.0, 0.0025, 64
+    x_pert = perturbed_fiber_positions(0.01, length, np.array([0.0, 0.0, 0.0]),
+                                       np.array([0.0, 0.0, 1.0]), n_nodes,
+                                       ortho=np.array([1.0, 0.0, 0.0]))
+    x_straight = _straight_fiber(n_nodes, length, [1.0, 0, 0], [0, 0, 1])
+    fibers = fc.make_group(np.stack([x_pert, x_straight]), lengths=length,
+                           bending_rigidity=E, radius=0.0125,
+                           force_scale=-sigma, minus_clamped=True,
+                           dtype=jnp.float64)
+    params = Params(eta=1.0, dt_initial=0.1, t_final=10.0, gmres_tol=1e-10,
+                    adaptive_timestep_flag=False)
+    system = System(params)
+    state = system.make_state(fibers=fibers)
+    state = system.run(state)
+
+    x0 = float(state.fibers.x[0, -1, 0])   # driver tip deflection
+    x1 = float(state.fibers.x[1, -1, 0])   # hydrodynamic response tip
+    x0_ref = -0.004765810967995735
+    x1_ref = 1.0048647877439878
+    rel = np.hypot(abs(1 - x0 / x0_ref), abs(1 - x1 / x1_ref))
+    # both fibers moved the right way (driver bent -x, neighbor pushed +x)
+    assert x0 < 0 and x1 > 1.0
+    assert rel < 5e-2, (x0, x1, rel)
+
+
+def _buckling_deflections(sigma, t_final=50.0):
+    """Clamped fiber under compressive motor force, kicked sideways by a
+    transient point force; returns the tip x-deflection time series
+    (`test_clamped_buckling_sigma72.py:13-55`)."""
+    length, E, n_nodes = 1.0, 0.0025, 32
+    force_scale = -sigma * E / length**3
+    x = _straight_fiber(n_nodes, length, [0, 0, 0], [0, 0, 1])
+    fibers = fc.make_group(x[None], lengths=length, bending_rigidity=E,
+                           radius=0.0125, force_scale=force_scale,
+                           minus_clamped=True, dtype=jnp.float64)
+    points = PointSources.make(position=[[0.0, 0.0, 10 * length]],
+                               force=[[10.0, 0.0, 0.0]], time_to_live=1.0,
+                               dtype=jnp.float64)
+    params = Params(eta=1.0, dt_initial=0.02, dt_min=0.01, dt_max=0.1,
+                    dt_write=0.1, t_final=t_final, gmres_tol=1e-10,
+                    adaptive_timestep_flag=True)
+    system = System(params)
+    state = system.make_state(fibers=fibers, points=points)
+
+    tip_x = []
+    state = system.run(state, writer=lambda s, sol: tip_x.append(
+        float(s.fibers.x[0, -1, 0])))
+    return np.array(tip_x)
+
+
+def _oscillation_peaks(x):
+    """Indices of local maxima with positive height (scipy-free find_peaks)."""
+    up = (x[1:-1] > x[:-2]) & (x[1:-1] >= x[2:]) & (x[1:-1] > 0)
+    return np.nonzero(up)[0] + 1
+
+
+@pytest.mark.slow
+def test_clamped_buckling_sigma72_decays():
+    """sigma=72 sits below the second critical compression: the kicked
+    oscillation decays peak to peak (`test_clamped_buckling_sigma72.py:57-77`,
+    committed peaks 0.08844356 / 0.05563314)."""
+    x = _buckling_deflections(72.0)
+    peaks = _oscillation_peaks(x)
+    assert len(peaks) >= 3, "expected at least 3 oscillation peaks"
+    # ignore the first peak (the kick itself)
+    assert x[peaks[2]] < x[peaks[1]]
+
+
+@pytest.mark.slow
+def test_clamped_buckling_sigma80_grows():
+    """sigma=80 is supercritical: the oscillation amplitude grows
+    (`test_clamped_buckling_sigma80.py`: x_peak2 > x_peak1 with committed
+    peaks starting at 0.09575812)."""
+    x = _buckling_deflections(80.0)
+    peaks = _oscillation_peaks(x)
+    assert len(peaks) >= 3
+    assert x[peaks[2]] > x[peaks[1]]
