@@ -7,29 +7,41 @@ library (the axon TPU plugin logs ANSI ERROR lines to stdout; XLA sometimes
 prints multi-KB dumps) can no longer corrupt the driver's JSON parse (the
 round-2 failure: `BENCH_r02.json` `parsed: null`). The same JSON — plus
 per-section partials as they finish — is mirrored to `BENCH.json` so even a
-driver-side timeout leaves a usable artifact.
+driver-side timeout leaves a usable artifact. Sections run against a wall-clock
+budget (BENCH_BUDGET_S, default 540 s): whatever doesn't fit is recorded as
+``skipped_budget`` instead of risking an rc=124 with nothing parseable.
 
 The TPU backend is probed in a subprocess with a timeout (the session's axon
 plugin can either raise UNAVAILABLE or block on its tunnel — both killed round
 1's bench), and every measurement section is individually guarded, recording a
-one-line error string in "extra" rather than crashing.
+one-line error string in "extra" rather than crashing. A persistent JAX
+compilation cache under ``.jax_cache/`` makes re-runs (including the driver's)
+skip the multi-minute remote compiles.
 
 Measured sections (see BASELINE.md "Metrics to measure"):
-  - stokeslet mobility-matvec throughput, f32 and f64 (pairs/s/chip), vs a
-    single-core NumPy direct evaluation (the reference's oracle backend,
-    `/root/reference/tests/core/kernel_test.cpp`), plus an MFU estimate;
-  - single-fiber implicit solve (64 nodes, free space): wall/solve + iters;
-  - the reference docs-walkthrough-scale coupled solve — 1 fiber + 1 body
-    (400 nodes) + spherical periphery (6000 nodes on an accelerator) — against
-    the reference's published footprint: GMRES 7 iters, 0.328 s/solve
-    (`/root/reference/docs/source/getting_started.rst:96-100`).
+  1. stokeslet mobility-matvec throughput, f32 + f64 (pairs/s/chip), vs a
+     single-core NumPy direct evaluation (the reference's oracle backend,
+     `/root/reference/tests/core/kernel_test.cpp`), plus an MFU estimate and
+     the Pallas-vs-XLA comparison;
+  2. single-fiber implicit solve (64 nodes, free space): wall/solve + iters;
+  3. trajectory frame encode at the 10k-fiber scale;
+  4. the reference docs-walkthrough-scale coupled solve — 1 fiber + 1 body
+     (400 nodes) + spherical periphery — f32 at 1e-8 and mixed-precision f64
+     at the reference's 1e-10 tolerance, against its published footprint:
+     GMRES 7 iters, 0.328 s/solve
+     (`/root/reference/docs/source/getting_started.rst:96-100`);
+  5. BASELINE #3/#5: ellipsoidal periphery + 1k clamped fibers, and the
+     oocyte surface-of-revolution periphery + fibers — full coupled solves;
+  6. BASELINE #4: the 10k-fiber (640k-node) dense Stokeslet matvec — the
+     measurement that decides the FMM go/no-go (extra["fmm_go_no_go"]).
 
-Headline: coupled-solve wall time when the walkthrough-scale config ran
-(vs_baseline = ref_wall / our_wall, >1 means faster than the reference);
-otherwise f32 kernel throughput vs the NumPy oracle.
+Headline: mixed-precision coupled solve at the walkthrough scale when it ran
+(vs_baseline = ref_wall / our_wall, >1 means faster than the reference at a
+*stricter* achieved tolerance); falls back to the f32 coupled solve, then to
+kernel throughput vs the NumPy oracle.
 
-Bench-only shortcut: shell quadrature weights are uniform (4*pi*R^2/N on
-Fibonacci nodes) instead of the Reeger-Fornberg RBF weights, and the dense
+Bench-only shortcut: shell quadrature weights are uniform (area/N on the
+generated nodes) instead of the Reeger-Fornberg RBF weights, and the dense
 shell operator + its inverse are assembled/inverted on-device — the host here
 has one CPU core, where the production scipy path (`periphery.build_shell_operator`)
 takes ~5 min at 6000 nodes. Solver structure, shapes, and flop profile are
@@ -59,12 +71,19 @@ STOKESLET_FLOPS_PER_PAIR = 30
 #: per-chip dense peak (flops/s) by device_kind substring, bf16 for TPUs
 PEAK_FLOPS = [("v6", 918e12), ("v5p", 459e12), ("v5", 197e12), ("v4", 275e12)]
 
+#: wall-clock budget; sections that don't fit are recorded as skipped
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 540))
+_T_START = time.monotonic()
 
 #: real-stdout fd saved by _steal_stdout; the one JSON line goes here
 _REAL_STDOUT_FD = None
 #: partial/final results mirrored here after every section
 BENCH_JSON_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH.json")
+
+
+def _remaining() -> float:
+    return BUDGET_S - (time.monotonic() - _T_START)
 
 
 def _steal_stdout():
@@ -181,16 +200,11 @@ def _kernel_rate(dtype, n):
     return _rate(lambda: kernels.stokeslet_direct(r, r, f, 1.0), n * n)
 
 
-def _bench_single_fiber(dtype, tol, trials=3):
-    """1 fiber x 64 nodes in free space, background-driven implicit solve."""
-    import dataclasses
-
+def _solve_rate(system, state, trials=3):
+    """{wall_s, iters, residual, residual_true, solves_per_s} of a jit'd
+    solve, timed to a host fetch."""
     import jax
 
-    from __graft_entry__ import _make_system
-
-    system, state = _make_system(n_fibers=1, n_nodes=64, dtype=dtype)
-    system.params = dataclasses.replace(system.params, gmres_tol=tol)
     step = jax.jit(system._solve_impl)
     float(step(state)[2].residual)  # compile + warm + drain
     t0 = time.perf_counter()
@@ -199,8 +213,21 @@ def _bench_single_fiber(dtype, tol, trials=3):
     resid = float(info.residual)  # host fetch: the real completion barrier
     wall = (time.perf_counter() - t0) / trials
     return {"wall_s": round(wall, 4), "iters": int(info.iters),
-            "residual": resid, "tol": tol,
+            "residual": resid, "residual_true": float(info.residual_true),
             "solves_per_s": round(1.0 / wall, 2)}
+
+
+def _bench_single_fiber(dtype, tol, trials=3):
+    """1 fiber x 64 nodes in free space, background-driven implicit solve."""
+    import dataclasses
+
+    from __graft_entry__ import _make_system
+
+    system, state = _make_system(n_fibers=1, n_nodes=64, dtype=dtype)
+    system.params = dataclasses.replace(system.params, gmres_tol=tol)
+    out = _solve_rate(system, state, trials)
+    out["tol"] = tol
+    return out
 
 
 def _device_shell_operator(nodes, normals, weights, dtype, precond_dtype=None):
@@ -208,10 +235,11 @@ def _device_shell_operator(nodes, normals, weights, dtype, precond_dtype=None):
 
     Same math as `periphery.build_shell_operator` (stresslet x normal blocks,
     singularity subtraction, -1/w diagonal, n (x) n complementary term) with
-    the O(N^2) assembly and O(N^3) inverse on the accelerator instead of
-    host LAPACK. ``precond_dtype`` computes the inverse (a preconditioner —
-    accuracy does not matter) in a lower precision: TPU LuDecomposition is
-    f32-only, so an f64 operator still needs an f32 inverse on device.
+    the O(N^2) assembly row-blocked (`kernels.stresslet_times_normal_blocked`)
+    and the O(N^3) inverse on the accelerator instead of host LAPACK.
+    ``precond_dtype`` computes the inverse (a preconditioner — accuracy does
+    not matter) in a lower precision: TPU LuDecomposition is f32-only, so an
+    f64 operator still needs an f32 inverse on device.
     """
     import jax.numpy as jnp
 
@@ -222,8 +250,8 @@ def _device_shell_operator(nodes, normals, weights, dtype, precond_dtype=None):
     normals_d = jnp.asarray(normals, dtype=dtype)
     w_d = jnp.asarray(weights, dtype=dtype)
 
-    M = jnp.asarray(kernels.stresslet_times_normal(nodes_d, normals_d, 1.0),
-                    dtype=dtype).reshape(3 * N, 3 * N)
+    M = kernels.stresslet_times_normal_blocked(nodes_d, normals_d, 1.0
+                                               ).reshape(3 * N, 3 * N)
 
     svs = []
     for k in range(3):
@@ -244,15 +272,8 @@ def _device_shell_operator(nodes, normals, weights, dtype, precond_dtype=None):
     return M, M_inv
 
 
-def _bench_coupled(shell_n, body_n, dtype, tol, trials=3, mixed=False):
-    """Walkthrough-scale coupled solve: 1 fiber + 1 body + spherical shell.
-
-    ``mixed=True`` benches the f64-accuracy TPU path: f64 state with the
-    mixed-precision solver (f32 Krylov flows + LU preconditioners, f64
-    iterative refinement to ``tol``) — the apples-to-apples comparison
-    against the reference's 0.328 s/solve at tol 4.6e-11.
-    """
-    import jax
+def _walkthrough_state(shell_n, body_n, dtype, tol, mixed):
+    """Walkthrough-scale coupled scene: 1 fiber + 1 body + spherical shell."""
     import jax.numpy as jnp
 
     from skellysim_tpu.bodies import bodies as bd
@@ -263,7 +284,6 @@ def _bench_coupled(shell_n, body_n, dtype, tol, trials=3, mixed=False):
     from skellysim_tpu.periphery.shapes import sphere_shape
     from skellysim_tpu.system import System
 
-    t_setup = time.perf_counter()
     pdt = jnp.float32 if mixed else None
     radius = 6.0
     spec = sphere_shape(shell_n, radius=radius * 1.04)
@@ -292,22 +312,159 @@ def _bench_coupled(shell_n, body_n, dtype, tol, trials=3, mixed=False):
                     adaptive_timestep_flag=False)
     system = System(params, shell_shape=peri.PeripheryShape(kind="sphere",
                                                             radius=radius))
-    state = system.make_state(fibers=fibers, shell=shell, bodies=bodies)
+    return system, system.make_state(fibers=fibers, shell=shell, bodies=bodies)
+
+
+def _bench_coupled(shell_n, body_n, dtype, tol, trials=3, mixed=False):
+    """Walkthrough-scale coupled solve; ``mixed=True`` benches the
+    f64-accuracy TPU path (f32 Krylov flows + LU preconditioners, f64
+    iterative refinement to ``tol``) — the apples-to-apples comparison
+    against the reference's 0.328 s/solve at tol 4.6e-11."""
+    t_setup = time.perf_counter()
+    system, state = _walkthrough_state(shell_n, body_n, dtype, tol, mixed)
+    setup_s = time.perf_counter() - t_setup
+    out = _solve_rate(system, state, trials)
+    out.update({"tol": tol, "shell_n": shell_n, "body_n": body_n,
+                "setup_s": round(setup_s, 2),
+                "ref_wall_s": REF_SOLVE_WALL_S, "ref_iters": REF_SOLVE_ITERS,
+                "vs_ref": round(REF_SOLVE_WALL_S / out["wall_s"], 2)})
+    return out
+
+
+def _bench_coupled_ladder(scales, body_n, dtype, tol, mixed):
+    """Try the walkthrough solve at descending shell sizes; record the error
+    at each failed scale instead of silently overwriting it."""
+    errors = {}
+    for shell_n in scales:
+        if _remaining() < 60:
+            errors["skipped_budget"] = f"{int(_remaining())}s left"
+            break
+        try:
+            out = _bench_coupled(shell_n, body_n, dtype, tol, mixed=mixed)
+            if errors:
+                out["errors_at_larger_scales"] = errors
+            return out
+        except Exception as e:
+            errors[str(shell_n)] = _short_err(e)
+    return {"error": errors or "no scale attempted"}
+
+
+def _clamped_fiber_field(spec, n_fibers, n_nodes, length, dtype):
+    """[n_fibers, n_nodes, 3] straight fibers clamped on the shell surface,
+    pointing inward — the ellipsoid/oocyte example geometry
+    (`examples/ellipsoid/gen_config.py`, `examples/oocyte/gen_config.py`)."""
+    import jax.numpy as jnp
+
+    stride = max(1, len(spec.nodes) // n_fibers)
+    origins = np.asarray(spec.nodes)[::stride][:n_fibers] * 0.98
+    inward = -np.asarray(spec.node_normals)[::stride][:n_fibers]
+    t = np.linspace(0, length, n_nodes)
+    x = origins[:, None, :] + t[None, :, None] * inward[:, None, :]
+    return jnp.asarray(x, dtype=dtype), origins.shape[0]
+
+
+def _bench_fiber_shell(kind, n_fibers, fiber_nodes, shell_n, dtype, tol,
+                       trials=2):
+    """BASELINE #3/#5: many clamped fibers with motor forcing inside a
+    non-spherical periphery; full coupled implicit solve."""
+    import jax.numpy as jnp
+
+    from skellysim_tpu.fibers import container as fc
+    from skellysim_tpu.params import Params
+    from skellysim_tpu.periphery import periphery as peri
+    from skellysim_tpu.periphery import shapes
+    from skellysim_tpu.system import System
+
+    t_setup = time.perf_counter()
+    if kind == "ellipsoid":
+        a, b, c = 7.8, 6.0, 6.0
+        spec = shapes.ellipsoid_shape(shell_n, a, b, c)
+        # rough surface area (Thomsen approximation) for uniform weights
+        p = 1.6075
+        area = 4 * np.pi * (((a*b)**p + (a*c)**p + (b*c)**p) / 3) ** (1/p)
+        shape = peri.PeripheryShape(kind="ellipsoid", abc=(a, b, c))
+    elif kind == "revolution":
+        env = {"n_nodes_target": shell_n, "lower_bound": -3.75,
+               "upper_bound": 3.75, "T": 0.72, "p1": 0.4, "p2": 0.2,
+               "length": 7.5,
+               "height": "0.5 * T * ((1 + 2*x/length)**p1) "
+                         "* ((1 - 2*x/length)**p2) * length"}
+        spec = shapes.surface_of_revolution_shape(env)
+        area = 4 * np.pi * 2.0 ** 2  # order-of-magnitude uniform weights
+        shape = peri.PeripheryShape(kind="generic")
+    else:
+        raise ValueError(kind)
+
+    N = len(spec.nodes)
+    normals = -spec.node_normals
+    weights = np.full(N, area / N)
+    op, M_inv = _device_shell_operator(spec.nodes, normals, weights, dtype,
+                                       precond_dtype=jnp.float32)
+    shell = peri.make_state(spec.nodes, normals, weights, op, M_inv,
+                            dtype=dtype, precond_dtype=jnp.float32)
+
+    x, nf = _clamped_fiber_field(spec, n_fibers, fiber_nodes, 1.0, dtype)
+    fibers = fc.make_group(x, lengths=1.0, bending_rigidity=2.5e-3,
+                           radius=0.0125, force_scale=-0.05,
+                           minus_clamped=True, dtype=dtype)
+    params = Params(eta=1.0, dt_initial=8e-3, t_final=1.0, gmres_tol=tol,
+                    gmres_restart=60, gmres_maxiter=120,
+                    adaptive_timestep_flag=False)
+    system = System(params, shell_shape=shape)
+    state = system.make_state(fibers=fibers, shell=shell)
     setup_s = time.perf_counter() - t_setup
 
-    step = jax.jit(system._solve_impl)
-    float(step(state)[2].residual)  # compile + warm + drain
+    out = _solve_rate(system, state, trials)
+    n_nodes_total = nf * fiber_nodes + N
+    # two pairwise kernel evaluations per GMRES iteration (fiber flow +
+    # shell flow) over all nodes
+    pairs = 2 * n_nodes_total * n_nodes_total * max(out["iters"], 1)
+    out.update({"tol": tol, "kind": kind, "n_fibers": nf,
+                "fiber_nodes": fiber_nodes, "shell_n": N,
+                "nodes_total": n_nodes_total, "setup_s": round(setup_s, 2),
+                "iters_per_s": round(out["iters"] / out["wall_s"], 2),
+                "matvec_gpairs_per_s": round(pairs / out["wall_s"] / 1e9, 3)})
+    return out
+
+
+def _bench_640k_matvec(n_fibers, n_nodes, dtype, trials=2):
+    """BASELINE #4: dense Stokeslet mobility matvec at the 10k-fiber scale
+    (640k source=target nodes) — the measurement behind the FMM go/no-go."""
+    import jax.numpy as jnp
+
+    from skellysim_tpu.ops import kernels
+
+    rng = np.random.default_rng(100)
+    box = 20.0
+    n = n_fibers * n_nodes
+    origins = rng.uniform(-box / 2, box / 2, (n_fibers, 3))
+    dirs = rng.normal(size=(n_fibers, 3))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    t = np.linspace(0, 1.0, n_nodes)
+    r = (origins[:, None, :] + t[None, :, None] * dirs[:, None, :]).reshape(-1, 3)
+    r = jnp.asarray(r, dtype=dtype)
+    f = jnp.asarray(rng.standard_normal((n, 3)), dtype=dtype)
+
     t0 = time.perf_counter()
-    for _ in range(trials):
-        _, _, info = step(state)
-    resid = float(info.residual)  # host fetch: the real completion barrier
-    wall = (time.perf_counter() - t0) / trials
-    return {"wall_s": round(wall, 4), "iters": int(info.iters),
-            "residual": resid, "residual_true": float(info.residual_true),
-            "tol": tol, "shell_n": shell_n, "body_n": body_n,
-            "setup_s": round(setup_s, 2),
-            "ref_wall_s": REF_SOLVE_WALL_S, "ref_iters": REF_SOLVE_ITERS,
-            "vs_ref": round(REF_SOLVE_WALL_S / wall, 2)}
+    rate = _rate(lambda: kernels.stokeslet_direct(r, r, f, 1.0), n * n,
+                 trials=trials)
+    out = {"n_nodes": n, "gpairs_per_s": round(rate / 1e9, 3)}
+    try:
+        # matmul-form tile: O(N^2*3) contractions on the MXU (see
+        # kernels.stokeslet_block_mxu numerics caveat — valid for this
+        # well-separated free-fiber cloud)
+        rate_mxu = _rate(lambda: kernels.stokeslet_direct(r, r, f, 1.0,
+                                                          impl="mxu"),
+                         n * n, trials=trials)
+        out["gpairs_per_s_mxu"] = round(rate_mxu / 1e9, 3)
+        rate = max(rate, rate_mxu)
+    except Exception as e:
+        out["mxu_error"] = _short_err(e)
+    wall = n * n / rate
+    out.update({"wall_s_per_matvec": round(wall, 3),
+                "projected_v5p8_wall_s": round(wall / 8, 3),
+                "total_s": round(time.perf_counter() - t0, 1)})
+    return out
 
 
 def main():
@@ -323,6 +480,13 @@ def main():
     import jax
 
     jax.config.update("jax_enable_x64", True)
+    try:  # persistent compile cache: the driver's run skips remote compiles
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
     backend = jax.default_backend()
     on_acc = backend != "cpu"
     extra["backend"] = backend
@@ -335,8 +499,8 @@ def main():
 
     # --- kernel throughput, f32 + f64 ---------------------------------------
     n32 = 65536 if on_acc else 8192
-    # f64 on TPU is software-emulated; 16384^2 pairs did not finish in
-    # round-3 probing, so measure at a size that reliably completes
+    # f64 on TPU is software-emulated (~100x slower than f32); measure at a
+    # size that reliably completes
     n64 = 4096
     rate32 = rate64 = None
     try:
@@ -385,30 +549,6 @@ def main():
         extra["single_fiber"] = {"error": _short_err(e)}
     _checkpoint(extra)
 
-    # --- walkthrough-scale coupled solve ------------------------------------
-    shell_n = 6000 if on_acc else 600
-    try:
-        extra["coupled_solve"] = _bench_coupled(shell_n, 400, dtype, tol)
-    except Exception as e:
-        extra["coupled_solve"] = {"error": _short_err(e)}
-        if on_acc:  # e.g. device OOM: retry once at CPU-fallback scale
-            try:
-                shell_n = 600
-                extra["coupled_solve"] = _bench_coupled(shell_n, 400, dtype, tol)
-            except Exception as e2:
-                extra["coupled_solve"] = {"error": _short_err(e2)}
-    _checkpoint(extra)
-
-    # --- mixed-precision coupled solve at the reference's tolerance ----------
-    # f64 state + f32 Krylov/preconditioners + iterative refinement: the
-    # apples-to-apples number against the reference's 0.328 s at 4.6e-11
-    try:
-        extra["coupled_solve_mixed"] = _bench_coupled(
-            shell_n, 400, jnp.float64, 1e-10, mixed=True)
-    except Exception as e:
-        extra["coupled_solve_mixed"] = {"error": _short_err(e)}
-    _checkpoint(extra)
-
     # --- trajectory frame encode at BASELINE scale (10k fibers x 64 nodes) ---
     try:
         from skellysim_tpu.fibers import container as fc
@@ -421,13 +561,77 @@ def main():
                             radius=0.0125, dtype=jnp.float32)
         st = SimState(time=jnp.float32(0.0), dt=jnp.float32(0.1), fibers=big,
                       points=None, background=None)
+        frame_bytes(st)  # warm the device->host paths
         t0 = time.perf_counter()
         buf = frame_bytes(st)
         extra["frame_encode_10k"] = {
             "encode_s": round(time.perf_counter() - t0, 3),
             "frame_mb": round(len(buf) / 1e6, 1)}
+        del big, st, xf
     except Exception as e:
         extra["frame_encode_10k"] = {"error": _short_err(e)}
+    _checkpoint(extra)
+
+    # --- walkthrough-scale coupled solves ------------------------------------
+    scales = [6000, 2000, 600] if on_acc else [600]
+    extra["coupled_solve"] = _bench_coupled_ladder(scales, 400, dtype, tol,
+                                                   mixed=False)
+    _checkpoint(extra)
+    # mixed precision at the reference's tolerance (f64 state): the
+    # apples-to-apples number against 0.328 s at 4.6e-11
+    extra["coupled_solve_mixed"] = _bench_coupled_ladder(
+        scales, 400, jnp.float64, 1e-10, mixed=True)
+    _checkpoint(extra)
+
+    # --- BASELINE #3: ellipsoid + 1k clamped fibers ---------------------------
+    if _remaining() > 120:
+        try:
+            extra["ellipsoid_1k_fibers"] = _bench_fiber_shell(
+                "ellipsoid", 1000 if on_acc else 16, 64,
+                6000 if on_acc else 192, dtype, tol)
+        except Exception as e:
+            extra["ellipsoid_1k_fibers"] = {"error": _short_err(e)}
+    else:
+        extra["ellipsoid_1k_fibers"] = {"skipped_budget": int(_remaining())}
+    _checkpoint(extra)
+
+    # --- BASELINE #4: 10k fibers / 640k nodes dense matvec --------------------
+    if _remaining() > 90:
+        try:
+            extra["dense_matvec_10k_fibers"] = _bench_640k_matvec(
+                10000 if on_acc else 100, 64, jnp.float32)
+        except Exception as e:
+            extra["dense_matvec_10k_fibers"] = {"error": _short_err(e)}
+    else:
+        extra["dense_matvec_10k_fibers"] = {"skipped_budget": int(_remaining())}
+    _checkpoint(extra)
+
+    # FMM go/no-go (BASELINE #4 north star: >=10x vs STKFMM on 32 ranks)
+    dm = extra.get("dense_matvec_10k_fibers", {})
+    if "wall_s_per_matvec" in dm:
+        w8 = dm["projected_v5p8_wall_s"]
+        extra["fmm_go_no_go"] = {
+            "measured": f"dense {dm['n_nodes']}-node matvec "
+                        f"{dm['wall_s_per_matvec']}s on one chip; /8 ring "
+                        f"projection {w8}s on v5p-8",
+            "verdict": ("dense viable" if w8 <= 1.0 else
+                        "dense marginal — hierarchical evaluator warranted"),
+            "note": "STKFMM at 640k sources on 32 CPU ranks is O(1s)/eval "
+                    "(PVFMM ~1e6-1e7 pts/s/core class); >=10x needs the "
+                    "projected 8-chip matvec under ~0.1s",
+        }
+    _checkpoint(extra)
+
+    # --- BASELINE #5: oocyte (surface of revolution) + fibers -----------------
+    if _remaining() > 120:
+        try:
+            extra["oocyte_fibers"] = _bench_fiber_shell(
+                "revolution", 1000 if on_acc else 16, 32,
+                6000 if on_acc else 200, dtype, tol)
+        except Exception as e:
+            extra["oocyte_fibers"] = {"error": _short_err(e)}
+    else:
+        extra["oocyte_fibers"] = {"skipped_budget": int(_remaining())}
     _checkpoint(extra)
 
     # --- headline ------------------------------------------------------------
@@ -461,6 +665,7 @@ def main():
     else:
         line = {"metric": "bench_failed", "value": 0.0, "unit": "",
                 "vs_baseline": 0.0}
+    line["total_s"] = round(time.monotonic() - _T_START, 1)
     line["backend"] = backend
     line["extra"] = extra
     _emit(line)

@@ -123,14 +123,19 @@ def build_bodies(cfg_bodies: list, config_dir: str, dtype) -> bd.BodyGroup | Non
         dtype=dtype)
 
 
-def build_periphery(cfg_periphery, config_dir: str, dtype):
-    """(PeripheryState, PeripheryShape) from config + precompute npz."""
+def build_periphery(cfg_periphery, config_dir: str, dtype, precond_dtype=None):
+    """(PeripheryState, PeripheryShape) from config + precompute npz.
+
+    ``precond_dtype`` stores M_inv (the preconditioner) at a lower precision
+    — the mixed solver only ever applies it in f32, so keeping an f64 copy
+    would waste (3N)^2 * 8 bytes of HBM."""
     data = _load_npz(os.path.join(config_dir, cfg_periphery.precompute_file),
                      "periphery")
     state = peri.make_state(data["nodes"], data["normals"],
                             data["quadrature_weights"],
                             data["stresslet_plus_complementary"],
-                            data["M_inv"], dtype=dtype)
+                            data["M_inv"], dtype=dtype,
+                            precond_dtype=precond_dtype)
     shape_name = getattr(cfg_periphery, "shape", "sphere")
     if shape_name == "sphere":
         shape = peri.PeripheryShape(kind="sphere", radius=cfg_periphery.radius)
@@ -183,11 +188,26 @@ def build_simulation(config, config_dir: str = ".", dtype=jnp.float64,
                       "given to build_simulation; using the direct evaluator")
     shell, shape = (None, None)
     if getattr(config, "periphery", None) is not None:
-        shell, shape = build_periphery(config.periphery, config_dir, dtype)
+        pdt = jnp.float32 if params.solver_precision == "mixed" else None
+        shell, shape = build_periphery(config.periphery, config_dir, dtype,
+                                       precond_dtype=pdt)
+
+    fibers = build_fibers(config.fibers, dtype)
+    if (fibers is not None and params.pair_evaluator == "ring"
+            and mesh is not None):
+        # round the fiber batch up to a mesh-divisible node count with inert
+        # padding fibers so user configs never hit the ring divisibility
+        # ValueError (System._fiber_flow)
+        nf, n = fibers.n_fibers, fibers.n_nodes
+        nf_pad = nf
+        while (nf_pad * n) % mesh.size != 0:
+            nf_pad += 1
+        if nf_pad != nf:
+            fibers = fc.grow_capacity(fibers, nf_pad)
 
     system = System(params, shell_shape=shape, mesh=mesh)
     state = system.make_state(
-        fibers=build_fibers(config.fibers, dtype),
+        fibers=fibers,
         points=build_point_sources(config.point_sources, dtype),
         background=build_background(config.background, dtype),
         shell=shell,

@@ -262,3 +262,43 @@ def fiber_error(group: FiberGroup) -> jnp.ndarray:
 
 def solution_size(group: FiberGroup) -> int:
     return group.n_fibers * 4 * group.n_nodes
+
+
+def grow_capacity(group: FiberGroup, new_cap: int,
+                  node_multiple: int = 1) -> FiberGroup:
+    """Pad every [nf]-leading leaf to ``new_cap`` slots (padding inactive).
+
+    Used by dynamic instability (geometric capacity growth) and by the
+    builder to round the fiber batch up to a mesh-divisible count for the
+    ring evaluator. ``node_multiple`` (the mesh size) rounds ``new_cap``
+    further up until the total node count divides it — every grower must
+    preserve the ring divisibility invariant or a long run dies mid-flight
+    in `System._fiber_flow`. Padded slots replicate slot 0 instead of
+    zero-filling: a zero-length/zero-x fiber makes the cache derivatives
+    inf/NaN, and 0-weight * NaN leaks NaN through the stokeslet sum even for
+    inactive slots. Padded slots are inert: inactive and unbound.
+    """
+    if node_multiple > 1:
+        while (new_cap * group.n_nodes) % node_multiple != 0:
+            new_cap += 1
+    nf = group.n_fibers
+    pad = new_cap - nf
+    if pad <= 0:
+        return group
+
+    def pad_leaf(leaf):
+        leaf = np.asarray(leaf)
+        if leaf.ndim >= 1 and leaf.shape[0] == nf:
+            if nf == 0:
+                fill = np.zeros((pad,) + leaf.shape[1:], dtype=leaf.dtype)
+            else:
+                fill = np.repeat(leaf[:1], pad, axis=0)
+            return np.concatenate([leaf, fill], axis=0)
+        return leaf
+
+    padded = type(group)(*[pad_leaf(l) for l in group])
+    active = np.asarray(padded.active)
+    active[nf:] = False
+    binding_body = np.asarray(padded.binding_body)
+    binding_body[nf:] = -1
+    return padded._replace(active=active, binding_body=binding_body)

@@ -45,14 +45,22 @@ EVALUATOR_MAP = {"CPU": "direct", "GPU": "direct", "FMM": "ring",
 def switch_evaluator(system, evaluator: str | None):
     """Rebuild the System for a requested evaluator (`System::set_evaluator`,
     `system.cpp:389-393`). Returns (system, switched); unknown or absent
-    names keep the current evaluator."""
+    names keep the current evaluator. Switching to "ring" creates a mesh
+    over the local devices when the System has none — without one the ring
+    path would silently fall back to direct, making the switch a
+    cache-discarding no-op."""
     ev = EVALUATOR_MAP.get(evaluator) if evaluator else None
     if ev is None or ev == system.params.pair_evaluator:
         return system, False
     from .system import System
 
+    mesh = system.mesh
+    if ev == "ring" and mesh is None:
+        from .parallel import make_mesh
+
+        mesh = make_mesh()
     return System(dataclasses.replace(system.params, pair_evaluator=ev),
-                  shell_shape=system.shell_shape, mesh=system.mesh), True
+                  shell_shape=system.shell_shape, mesh=mesh), True
 
 
 def _line_kwargs(req: dict) -> dict:

@@ -70,6 +70,45 @@ def _blocked_target_sum(kernel_fn, r_trg, block_size):
     return u.reshape(nb * block_size, 3)[:n_trg]
 
 
+#: sources beyond this count are chunked (the [t_block, n_src] intermediates
+#: would otherwise scale HBM use linearly with n_src — 640k sources against
+#: a 4096-target block is a 31 GB displacement tensor)
+_SRC_CHUNK_THRESHOLD = 32768
+_DEFAULT_SRC_BLOCK = 8192
+
+
+def _pair_sum(pair_fn, r_trg, src_arrays, block_size, source_block):
+    """Target-blocked, source-chunked pairwise sum.
+
+    ``pair_fn(trg_block, *src_chunk_arrays) -> [t, 3]`` must give zero
+    contribution for zero-padded sources (every kernel here does: padded
+    strengths are zero, and exactly-coincident pairs are masked).
+    """
+    n_src = src_arrays[0].shape[0]
+    if source_block is None:
+        source_block = (_DEFAULT_SRC_BLOCK if n_src > _SRC_CHUNK_THRESHOLD
+                        else None)
+    if source_block is None or n_src <= source_block:
+        return _blocked_target_sum(lambda trg: pair_fn(trg, *src_arrays),
+                                   r_trg, block_size)
+    ns_b = _block_iter(n_src, source_block)
+    pad = ns_b * source_block - n_src
+    chunks = tuple(
+        jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1)).reshape(
+            (ns_b, source_block) + a.shape[1:])
+        for a in src_arrays)
+
+    def kernel(trg):
+        def body(acc, chunk):
+            return acc + pair_fn(trg, *chunk), None
+
+        acc, _ = lax.scan(body, jnp.zeros((trg.shape[0], 3), dtype=trg.dtype),
+                          chunks)
+        return acc
+
+    return _blocked_target_sum(kernel, r_trg, block_size)
+
+
 def stokeslet_block(trg, src, f_src):
     """Unscaled Stokeslet partial sum of one (target-block, source-block) pair.
 
@@ -84,6 +123,42 @@ def stokeslet_block(trg, src, f_src):
     rinv3 = rinv * rinv * rinv
     df = jnp.einsum("tsk,sk->ts", d, f_src)
     return jnp.einsum("ts,sk->tk", rinv, f_src) + jnp.einsum("ts,tsk->tk", df * rinv3, d)
+
+
+def stokeslet_block_mxu(trg, src, f_src):
+    """`stokeslet_block` restructured so the O(t*s*3) contractions are MXU
+    matmuls instead of reductions over a materialized [t, s, 3] displacement
+    tensor:
+
+      r2_ts = |t|^2 + |s|^2 - 2 (t @ s^T)               (one [t,3]x[3,s] matmul)
+      df_ts = (t @ f^T) - (s . f)_s                      (one matmul)
+      u_tk  = rinv @ f + t_k * rowsum(c) - c @ s,  c = df * rinv^3
+                                                         (two [t,s]x[s,3] matmuls)
+
+    Only rsqrt + ~6 multiplies per pair stay elementwise on the VPU.
+
+    NUMERICS CAVEAT (why this is opt-in, not the default): the subtraction
+    form loses absolute accuracy ~eps * (|t|^2 + |s|^2) on r2, so (a) exact
+    self-pair detection by r2 == 0 is no longer reliable — pairs are instead
+    masked below a relative threshold 16 eps (|t|^2+|s|^2), i.e. separations
+    under ~4 sqrt(eps) |t| are treated as coincident — and (b) near-field
+    pairs closer than ~sqrt(eps) |t| carry O(1) relative error. Fine for
+    well-separated free-fiber clouds (node spacings >= 1e-2 at O(10)
+    coordinates); wrong tool for touching surfaces. Recentering coordinates
+    on the cloud centroid before calling tightens both bounds.
+    """
+    eps = jnp.finfo(trg.dtype).eps
+    t2 = jnp.sum(trg * trg, axis=1)
+    s2 = jnp.sum(src * src, axis=1)
+    ts = trg @ src.T
+    scale = t2[:, None] + s2[None, :]
+    r2 = jnp.maximum(scale - 2.0 * ts, 0.0)
+    mask = r2 > 16.0 * eps * scale
+    rinv = jnp.where(mask, lax.rsqrt(jnp.where(mask, r2, 1.0)), 0.0)
+    rinv3 = rinv * rinv * rinv
+    df = trg @ f_src.T - jnp.sum(src * f_src, axis=1)[None, :]
+    c = df * rinv3
+    return rinv @ f_src + trg * jnp.sum(c, axis=1, keepdims=True) - c @ src
 
 
 def stresslet_block(trg, src, S):
@@ -106,29 +181,43 @@ def oseen_block(trg, src, density, eta, reg, epsilon_distance):
     return jnp.einsum("ts,sk->tk", fr, density) + jnp.einsum("ts,tsk->tk", gr * df, d)
 
 
-@partial(jax.jit, static_argnames=("block_size",))
-def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096):
+@partial(jax.jit, static_argnames=("block_size", "source_block", "impl"))
+def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096,
+                     source_block: int | None = None, impl: str = "exact"):
     """Singular Stokeslet sum: [n_src,3] sources, [n_trg,3] targets -> [n_trg,3].
 
     Self-interactions (exactly coincident points) contribute zero, matching
-    `pvfmm::stokes_vel` / `src/core/kernels.cu:17-41`.
+    `pvfmm::stokes_vel` / `src/core/kernels.cu:17-41`. Sources beyond
+    ``_SRC_CHUNK_THRESHOLD`` are scanned in ``source_block`` chunks so peak
+    memory stays O(block_size * source_block) at BASELINE scale (640k nodes).
+
+    ``impl="mxu"`` selects the matmul-form tile (`stokeslet_block_mxu`) that
+    moves the O(N^2 * 3) contractions onto the MXU — see its numerics caveat;
+    coordinates are recentered on the combined centroid first to tighten the
+    cancellation bound.
     """
     factor = 1.0 / (8.0 * math.pi)
-    u = _blocked_target_sum(lambda trg: stokeslet_block(trg, r_src, f_src),
-                            r_trg, block_size)
+    if impl == "mxu":
+        center = jnp.mean(r_src, axis=0)
+        u = _pair_sum(stokeslet_block_mxu, r_trg - center,
+                      (r_src - center, f_src), block_size, source_block)
+    else:
+        u = _pair_sum(stokeslet_block, r_trg, (r_src, f_src), block_size,
+                      source_block)
     return u * (factor / eta)
 
 
-@partial(jax.jit, static_argnames=("block_size",))
-def stresslet_direct(r_dl, r_trg, f_dl, eta, *, block_size: int = 4096):
+@partial(jax.jit, static_argnames=("block_size", "source_block"))
+def stresslet_direct(r_dl, r_trg, f_dl, eta, *, block_size: int = 4096,
+                     source_block: int | None = None):
     """Singular stresslet (double-layer) sum.
 
     ``f_dl`` is [n_src, 3, 3] (the 9-component source S with rows indexed like the
     reference's sxx..szz, i.e. ``f_dl[s, i, j] = S_ij``); returns [n_trg, 3].
     """
     factor = 1.0 / (8.0 * math.pi)
-    u = _blocked_target_sum(lambda trg: stresslet_block(trg, r_dl, f_dl),
-                            r_trg, block_size)
+    u = _pair_sum(stresslet_block, r_trg, (r_dl, f_dl), block_size,
+                  source_block)
     return u * (factor / eta)
 
 
@@ -164,16 +253,18 @@ def _regularized_frgr(r2, eta, reg, epsilon_distance):
     return fr, gr
 
 
-@partial(jax.jit, static_argnames=("block_size",))
+@partial(jax.jit, static_argnames=("block_size", "source_block"))
 def oseen_contract(r_src, r_trg, density, eta, reg=DEFAULT_REG,
-                   epsilon_distance=DEFAULT_EPS, *, block_size: int = 4096):
+                   epsilon_distance=DEFAULT_EPS, *, block_size: int = 4096,
+                   source_block: int | None = None):
     """Regularized Oseen tensor contracted with a density: -> [n_trg, 3].
 
     Mirror of `kernels::oseen_tensor_contract_direct` (`src/core/kernels.cpp:85-131`).
     """
-    return _blocked_target_sum(
-        lambda trg: oseen_block(trg, r_src, density, eta, reg, epsilon_distance),
-        r_trg, block_size)
+    return _pair_sum(
+        lambda trg, src, dens: oseen_block(trg, src, dens, eta, reg,
+                                           epsilon_distance),
+        r_trg, (r_src, density), block_size, source_block)
 
 
 @jax.jit
@@ -193,9 +284,9 @@ def oseen_tensor(r_src, r_trg, eta, reg=DEFAULT_REG, epsilon_distance=DEFAULT_EP
     return jnp.transpose(G, (0, 2, 1, 3))
 
 
-@partial(jax.jit, static_argnames=("block_size",))
+@partial(jax.jit, static_argnames=("block_size", "source_block"))
 def rotlet(r_src, r_trg, density, eta, reg=DEFAULT_REG, epsilon_distance=DEFAULT_EPS,
-           *, block_size: int = 4096):
+           *, block_size: int = 4096, source_block: int | None = None):
     """Rotlet sum ``u = 1/(8 pi eta) sum_j (rho_j x d)/r^3`` -> [n_trg, 3].
 
     Mirror of `kernels::rotlet` (`src/core/kernels.cpp:206-242`). Note the reference
@@ -203,15 +294,16 @@ def rotlet(r_src, r_trg, density, eta, reg=DEFAULT_REG, epsilon_distance=DEFAULT
     """
     factor = 1.0 / (8.0 * math.pi * eta)
 
-    def block(trg):
-        d = trg[:, None, :] - r_src[None, :, :]
+    def block(trg, src, dens):
+        d = trg[:, None, :] - src[None, :, :]
         r2 = jnp.sum(d * d, axis=-1)
         rinv = _reg_rinv(r2, reg, epsilon_distance, inclusive=False, drop_self=False)
         fr = rinv * rinv * rinv
-        cross = jnp.cross(density[None, :, :], d)
+        cross = jnp.cross(dens[None, :, :], d)
         return jnp.einsum("ts,tsk->tk", fr, cross)
 
-    return _blocked_target_sum(block, r_trg, block_size) * factor
+    return _pair_sum(block, r_trg, (r_src, density), block_size,
+                     source_block) * factor
 
 
 @jax.jit
@@ -234,6 +326,38 @@ def stresslet_times_normal(r, normals, eta, reg=DEFAULT_REG, epsilon_distance=DE
     coeff = jnp.where(offdiag, factor * dn * rinv5, 0.0)
     M = coeff[:, :, None, None] * d[:, :, :, None] * d[:, :, None, :]
     return jnp.transpose(M, (0, 2, 1, 3))
+
+
+@partial(jax.jit, static_argnames=("block_size",))
+def stresslet_times_normal_blocked(r, normals, eta, reg=DEFAULT_REG,
+                                   epsilon_distance=DEFAULT_EPS, *,
+                                   block_size: int = 512):
+    """Row-blocked `stresslet_times_normal`: same values, peak memory
+    O(block_size * n) instead of O(n^2) — the unblocked assembly of a
+    6000-node shell operator needs several multi-GB intermediates at once.
+    """
+    factor = -3.0 / (4.0 * math.pi)
+    n = r.shape[0]
+    nb = _block_iter(n, block_size)
+    pad = nb * block_size - n
+    r_pad = jnp.pad(r, ((0, pad), (0, 0)))
+    row_idx = jnp.arange(nb * block_size).reshape(nb, block_size)
+    col_idx = jnp.arange(n)
+
+    def rows(args):
+        trg, idx = args
+        d = trg[:, None, :] - r[None, :, :]
+        r2 = jnp.sum(d * d, axis=-1)
+        offdiag = idx[:, None] != col_idx[None, :]
+        rinv = _reg_rinv(r2, reg, epsilon_distance, inclusive=False,
+                         drop_self=False)
+        dn = jnp.einsum("bjk,jk->bj", d, normals)
+        coeff = jnp.where(offdiag, factor * dn * rinv**5, 0.0)
+        M = coeff[:, :, None, None] * d[:, :, :, None] * d[:, :, None, :]
+        return jnp.transpose(M, (0, 2, 1, 3))  # [b, 3, n, 3]
+
+    M = lax.map(rows, (r_pad.reshape(nb, block_size, 3), row_idx))
+    return M.reshape(nb * block_size, 3, n, 3)[:n]
 
 
 @partial(jax.jit, static_argnames=("block_size",))

@@ -1,3 +1,5 @@
 from .mesh import FIBER_AXIS, make_mesh, shard_state  # noqa: F401
+from .multihost import initialize as initialize_multihost  # noqa: F401
+from .multihost import process_info  # noqa: F401
 from .ring import (ring_oseen_contract, ring_stokeslet,  # noqa: F401
                    ring_stresslet)

@@ -1,0 +1,62 @@
+"""Multi-host (DCN) bring-up for multi-slice / multi-process runs.
+
+The reference scales across nodes with `mpirun` + MPI collectives
+(`/root/reference/src/skelly_sim.cpp:14`, SURVEY.md §5.8); the TPU-native
+equivalent is JAX's distributed runtime: every host runs the same program,
+`jax.distributed.initialize` wires the processes together, and the same
+GSPMD-sharded jit programs used single-host then span all hosts — XLA routes
+collectives over ICI within a slice and DCN across slices. No simulation code
+changes: `make_mesh()` over `jax.devices()` simply sees every chip.
+
+Typical launch (one process per host, same command everywhere):
+
+    SKELLY_COORDINATOR=host0:1234 SKELLY_NUM_PROCS=4 SKELLY_PROC_ID=$RANK \
+        python -m skellysim_tpu --config-file=skelly_config.toml
+
+On Cloud TPU / GKE, `jax.distributed.initialize()` auto-discovers all of
+this from the environment and the arguments may be omitted entirely.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """Join the multi-host runtime; no-op for single-process runs.
+
+    Arguments default from SKELLY_COORDINATOR / SKELLY_NUM_PROCS /
+    SKELLY_PROC_ID, falling back to JAX's own autodetection (TPU pods
+    populate it from the metadata server). Returns True when a distributed
+    runtime was started. The analogue of the reference's MPI_Init_thread —
+    but resumable state stays rank-count-independent here (our RNG streams
+    are not per-rank, unlike `trajectory_reader.cpp:204-219`).
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "SKELLY_COORDINATOR")
+    if num_processes is None and "SKELLY_NUM_PROCS" in os.environ:
+        num_processes = int(os.environ["SKELLY_NUM_PROCS"])
+    if process_id is None and "SKELLY_PROC_ID" in os.environ:
+        process_id = int(os.environ["SKELLY_PROC_ID"])
+
+    if num_processes in (None, 1) and coordinator_address is None:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def process_info() -> dict:
+    """{process_index, process_count, local/global device counts} — the
+    analogue of the reference's rank/size echo (`system.cpp:30-45`)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }

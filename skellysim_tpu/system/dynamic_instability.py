@@ -27,34 +27,13 @@ from ..fibers import container as fc
 from ..utils.rng import SimRNG
 
 
-def _grow_capacity(fibers, new_cap: int):
-    """Pad every [nf]-leading leaf to ``new_cap`` slots (padding inactive)."""
-    nf = fibers.n_fibers
-    pad = new_cap - nf
-
-    def pad_leaf(leaf):
-        leaf = np.asarray(leaf)
-        if leaf.ndim >= 1 and leaf.shape[0] == nf:
-            # replicate slot 0 instead of zero-filling: a zero-length/zero-x
-            # fiber makes the cache derivatives inf/NaN, and 0-weight * NaN
-            # leaks NaN through the stokeslet sum even for inactive slots
-            if nf == 0:
-                fill = np.zeros((pad,) + leaf.shape[1:], dtype=leaf.dtype)
-            else:
-                fill = np.repeat(leaf[:1], pad, axis=0)
-            return np.concatenate([leaf, fill], axis=0)
-        return leaf
-
-    padded = type(fibers)(*[pad_leaf(l) for l in fibers])
-    # padded slots must be inert: inactive, unbound
-    active = np.asarray(padded.active)
-    active[nf:] = False
-    binding_body = np.asarray(padded.binding_body)
-    binding_body[nf:] = -1
-    return padded._replace(active=active, binding_body=binding_body)
+#: shared with the builder's ring-evaluator padding; see
+#: `container.grow_capacity`
+_grow_capacity = fc.grow_capacity
 
 
-def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5):
+def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
+                              node_multiple: int = 1):
     """One nucleation/catastrophe update. Returns a new SimState.
 
     Runs on host between solves (like the reference, which calls it at the top
@@ -154,6 +133,7 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5):
             bending_rigidity=di.bending_rigidity, radius=di.radius,
             minus_clamped=True, binding_body=np.array(new_body),
             binding_site=np.array(new_site), dtype=dtype)
+        fibers = fc.grow_capacity(fibers, fibers.n_fibers, node_multiple)
         return state._replace(fibers=fibers)
 
     # fill inactive slots; grow capacity geometrically when out of room
@@ -162,7 +142,8 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5):
     if slots.size < len(chosen):
         need = int(active.sum()) + len(chosen)
         new_cap = max(int(np.ceil(fibers.n_fibers * capacity_factor)), need)
-        fibers = _grow_capacity(fibers, new_cap)
+        # node_multiple keeps the ring evaluator's mesh-divisibility invariant
+        fibers = _grow_capacity(fibers, new_cap, node_multiple)
         active = np.asarray(fibers.active)
         slots = np.flatnonzero(~active)
     slots = slots[:len(chosen)]

@@ -611,7 +611,8 @@ class System:
         return self._solve_jit(state)
 
     def run(self, state: SimState, *, writer=None, max_steps: int | None = None,
-            rng=None, metrics_path: str | None = None):
+            rng=None, metrics_path: str | None = None,
+            profile_dir: str | None = None):
         """Adaptive time loop (`run`, `system.cpp:516-571`).
 
         Host-side control flow around the jit'd step: accept/reject on fiber
@@ -630,8 +631,18 @@ class System:
         """
         metrics_fh = open(metrics_path, "a") if metrics_path else None
         try:
-            state = self._run_loop(state, writer=writer, max_steps=max_steps,
-                                   rng=rng, metrics_fh=metrics_fh)
+            if profile_dir is not None:
+                # XLA/TPU profiler capture of the whole loop (the structured
+                # upgrade over the reference's omp_get_wtime logging,
+                # SURVEY.md §5.1); open with TensorBoard or xprof
+                with jax.profiler.trace(profile_dir):
+                    state = self._run_loop(state, writer=writer,
+                                           max_steps=max_steps, rng=rng,
+                                           metrics_fh=metrics_fh)
+            else:
+                state = self._run_loop(state, writer=writer,
+                                       max_steps=max_steps, rng=rng,
+                                       metrics_fh=metrics_fh)
         finally:
             if metrics_fh is not None:
                 metrics_fh.close()
@@ -647,7 +658,11 @@ class System:
                 break
             backup = state
             if rng is not None and p.dynamic_instability.n_nodes > 0:
-                state = apply_dynamic_instability(state, p, rng)
+                # a ring mesh constrains nucleation's capacity growth to
+                # mesh-divisible node counts (grow_capacity invariant)
+                nm = self.mesh.size if self._ring_active() else 1
+                state = apply_dynamic_instability(state, p, rng,
+                                                  node_multiple=nm)
             wall0 = _time.perf_counter()
             new_state, solution, info = self.step(state)
             # host fetch, not block_until_ready: blocking on one leaf was

@@ -85,3 +85,15 @@ def test_indivisible_shell_rows_raise():
     # explicit opt-in replicates instead
     sharded = shard_state(state, mesh, allow_replicated_shell=True)
     assert len(sharded.shell.M_inv.sharding.device_set) in (1, N_DEV)
+
+
+def test_multihost_initialize_noop_single_process():
+    """Single-process runs skip distributed init and report sane process
+    info (the multi-host bring-up path, parallel/multihost.py)."""
+    from skellysim_tpu.parallel import multihost
+
+    assert multihost.initialize() is False
+    info = multihost.process_info()
+    assert info["process_index"] == 0
+    assert info["process_count"] == 1
+    assert info["global_device_count"] >= 1

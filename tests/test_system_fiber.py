@@ -80,3 +80,29 @@ def test_step_reports_convergence():
     assert int(info.iters) > 0
     assert float(info.residual) <= params.gmres_tol
     assert float(info.fiber_error) < 1e-6
+
+
+def test_run_with_profiler_trace(tmp_path):
+    """profile_dir captures an XLA profiler trace of the run loop
+    (SURVEY.md §5.1 structured-profiling upgrade)."""
+    import os
+
+    import numpy as np
+
+    from skellysim_tpu.fibers import container as fc
+    from skellysim_tpu.params import Params
+    from skellysim_tpu.system import System
+    from skellysim_tpu.system.sources import BackgroundFlow
+
+    t = np.linspace(0, 1, 16)
+    x = np.stack([np.zeros(16), np.zeros(16), t], axis=-1)
+    fibers = fc.make_group(x[None], lengths=1.0, bending_rigidity=0.01,
+                           radius=0.0125)
+    system = System(Params(dt_initial=0.01, t_final=0.02,
+                           adaptive_timestep_flag=False))
+    state = system.make_state(fibers=fibers,
+                              background=BackgroundFlow.make(uniform=[0, 0, 1.0]))
+    prof = str(tmp_path / "prof")
+    system.run(state, max_steps=1, profile_dir=prof)
+    found = [os.path.join(dp, f) for dp, _, fs in os.walk(prof) for f in fs]
+    assert found, "no profiler artifacts written"
