@@ -272,7 +272,7 @@ def _device_shell_operator(nodes, normals, weights, dtype, precond_dtype=None):
     return M, M_inv
 
 
-def _walkthrough_state(shell_n, body_n, dtype, tol, mixed):
+def _walkthrough_state(shell_n, body_n, dtype, tol, mixed, kernel_impl="exact"):
     """Walkthrough-scale coupled scene: 1 fiber + 1 body + spherical shell."""
     import jax.numpy as jnp
 
@@ -309,19 +309,21 @@ def _walkthrough_state(shell_n, body_n, dtype, tol, mixed):
     params = Params(eta=1.0, dt_initial=0.1, t_final=1.0, gmres_tol=tol,
                     gmres_restart=60, gmres_maxiter=120,
                     solver_precision="mixed" if mixed else "full",
-                    adaptive_timestep_flag=False)
+                    kernel_impl=kernel_impl, adaptive_timestep_flag=False)
     system = System(params, shell_shape=peri.PeripheryShape(kind="sphere",
                                                             radius=radius))
     return system, system.make_state(fibers=fibers, shell=shell, bodies=bodies)
 
 
-def _bench_coupled(shell_n, body_n, dtype, tol, trials=3, mixed=False):
+def _bench_coupled(shell_n, body_n, dtype, tol, trials=3, mixed=False,
+                   kernel_impl="exact"):
     """Walkthrough-scale coupled solve; ``mixed=True`` benches the
     f64-accuracy TPU path (f32 Krylov flows + LU preconditioners, f64
     iterative refinement to ``tol``) — the apples-to-apples comparison
     against the reference's 0.328 s/solve at tol 4.6e-11."""
     t_setup = time.perf_counter()
-    system, state = _walkthrough_state(shell_n, body_n, dtype, tol, mixed)
+    system, state = _walkthrough_state(shell_n, body_n, dtype, tol, mixed,
+                                       kernel_impl)
     setup_s = time.perf_counter() - t_setup
     out = _solve_rate(system, state, trials)
     out.update({"tol": tol, "shell_n": shell_n, "body_n": body_n,
@@ -582,6 +584,16 @@ def main():
     extra["coupled_solve_mixed"] = _bench_coupled_ladder(
         scales, 400, jnp.float64, 1e-10, mixed=True)
     _checkpoint(extra)
+
+    # MXU matmul-form kernel tiles at the scale the f32 solve survived
+    cs = extra.get("coupled_solve", {})
+    if "wall_s" in cs and _remaining() > 90:
+        try:
+            extra["coupled_solve_mxu_kernels"] = _bench_coupled(
+                cs["shell_n"], 400, dtype, tol, kernel_impl="mxu")
+        except Exception as e:
+            extra["coupled_solve_mxu_kernels"] = {"error": _short_err(e)}
+        _checkpoint(extra)
 
     # --- BASELINE #3: ellipsoid + 1k clamped fibers ---------------------------
     if _remaining() > 120:

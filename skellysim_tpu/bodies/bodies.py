@@ -222,12 +222,14 @@ def update_RHS(group: BodyGroup, v_on_bodies):
                             jnp.zeros((nb, 6), dtype=v_on_bodies.dtype)], axis=1)
 
 
-def flow(group: BodyGroup, caches: BodyCaches, r_trg, x_bodies, forces_torques, eta):
+def flow(group: BodyGroup, caches: BodyCaches, r_trg, x_bodies, forces_torques,
+         eta, impl: str = "exact"):
     """Body -> target velocities (`flow_spherical`, `body_container.cpp:269-339`):
     double-layer stresslet from node densities + Stokeslet from COM forces +
     rotlet from COM torques. ``forces_torques`` is [nb, 6]. Pass
     ``x_bodies=None`` to skip the stresslet term (e.g. the explicit RHS flow,
-    which only carries COM forces/torques)."""
+    which only carries COM forces/torques). The COM Stokeslet/rotlet stay on
+    the exact tile regardless of ``impl`` — nb sources are negligible."""
     nb, n = group.n_bodies, group.n_nodes
     if x_bodies is None:
         v = jnp.zeros_like(r_trg)
@@ -236,7 +238,7 @@ def flow(group: BodyGroup, caches: BodyCaches, r_trg, x_bodies, forces_torques, 
         normals = caches.normals.reshape(nb * n, 3)
         f_dl = 2.0 * eta * normals[:, :, None] * densities[:, None, :]
         v = kernels.stresslet_direct(caches.nodes.reshape(nb * n, 3), r_trg,
-                                     f_dl, eta)
+                                     f_dl, eta, impl=impl)
     v = v + kernels.stokeslet_direct(group.position, r_trg, forces_torques[:, :3], eta)
     v = v + kernels.rotlet(group.position, r_trg, forces_torques[:, 3:], eta)
     return v

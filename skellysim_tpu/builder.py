@@ -198,12 +198,8 @@ def build_simulation(config, config_dir: str = ".", dtype=jnp.float64,
         # round the fiber batch up to a mesh-divisible node count with inert
         # padding fibers so user configs never hit the ring divisibility
         # ValueError (System._fiber_flow)
-        nf, n = fibers.n_fibers, fibers.n_nodes
-        nf_pad = nf
-        while (nf_pad * n) % mesh.size != 0:
-            nf_pad += 1
-        if nf_pad != nf:
-            fibers = fc.grow_capacity(fibers, nf_pad)
+        fibers = fc.grow_capacity(fibers, fibers.n_fibers,
+                                  node_multiple=mesh.size)
 
     system = System(params, shell_shape=shape, mesh=mesh)
     state = system.make_state(

@@ -91,6 +91,15 @@ def main(argv=None) -> None:
                         format="[%(asctime)s] [%(levelname)s] %(message)s",
                         stream=sys.stderr)
 
+    # multi-host bring-up (no-op single-process; the analogue of the
+    # reference's MPI_Init, `skelly_sim.cpp:14`) — must run before any JAX
+    # backend init so every host joins the same runtime
+    from .parallel import initialize_multihost, process_info
+
+    if initialize_multihost():
+        logging.getLogger("skellysim_tpu").info(
+            "multi-host runtime: %s", process_info())
+
     if args.listen:
         from .listener import serve  # deferred: heavy post-processing imports
         serve(args.config_file)

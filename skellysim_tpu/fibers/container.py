@@ -184,7 +184,7 @@ def weighted_forces(group: FiberGroup, forces) -> jnp.ndarray:
 
 def flow(group: FiberGroup, caches: FiberCaches, r_trg, forces, eta,
          subtract_self: bool = True, evaluator: str = "direct",
-         mesh=None) -> jnp.ndarray:
+         mesh=None, impl: str = "exact") -> jnp.ndarray:
     """Velocity at targets from all fiber nodes (`flow`, `:172-214`).
 
     ``forces`` is [nf, n, 3]; when ``subtract_self`` the first nf*n targets are
@@ -199,10 +199,10 @@ def flow(group: FiberGroup, caches: FiberCaches, r_trg, forces, eta,
         from ..parallel.ring import ring_stokeslet
 
         vel = ring_stokeslet(node_positions(group), r_trg, wf.reshape(-1, 3),
-                             eta, mesh=mesh)
+                             eta, mesh=mesh, impl=impl)
     else:
         vel = kernels.stokeslet_direct(node_positions(group), r_trg,
-                                       wf.reshape(-1, 3), eta)
+                                       wf.reshape(-1, 3), eta, impl=impl)
     if subtract_self:
         self_vel = jnp.einsum("fiajb,fjb->fia", caches.stokeslet, wf)
         nfn = group.n_fibers * group.n_nodes

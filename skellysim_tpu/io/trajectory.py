@@ -126,8 +126,65 @@ _FIBER_KEYS = ["n_nodes_", "radius_", "length_", "length_prev_",
 _FIBER_KEY_BYTES = [msgpack.packb(k) for k in _FIBER_KEYS]
 
 
+def _fiber_array_bytes_native(fibers) -> bytes | None:
+    """Native C++ encode of the active-fiber map array
+    (`native/frameenc.cpp`); None when the toolchain is unavailable."""
+    lib = load_library("frameenc")
+    if lib is None:
+        return None
+    lib.frameenc_fibers.restype = ctypes.c_int64
+    dbl = ctypes.POINTER(ctypes.c_double)
+    lib.frameenc_fibers.argtypes = [dbl] * 9 + [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint64)]
+
+    def darr(a):
+        return np.ascontiguousarray(np.asarray(a, dtype=np.float64))
+
+    x = darr(fibers.x)
+    nf, n = x.shape[0], x.shape[1]
+    tension = darr(fibers.tension)
+    scalars = [darr(getattr(fibers, f)) for f in
+               ("radius", "length", "length_prev", "bending_rigidity",
+                "penalty", "force_scale", "beta_tstep")]
+    binding = np.ascontiguousarray(np.stack(
+        [np.asarray(fibers.binding_body), np.asarray(fibers.binding_site)],
+        axis=1).astype(np.int32))
+    active = np.ascontiguousarray(np.asarray(fibers.active, dtype=np.uint8))
+    mclamp = np.ascontiguousarray(
+        np.asarray(fibers.minus_clamped, dtype=np.uint8))
+
+    out_p = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_uint64()
+    args = [x, tension] + scalars
+    rc = lib.frameenc_fibers(
+        *[a.ctypes.data_as(dbl) for a in args],
+        binding.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        active.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        mclamp.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        nf, n, ctypes.byref(out_p), ctypes.byref(out_len))
+    if rc < 0:
+        return None
+    try:
+        return ctypes.string_at(out_p, out_len.value)
+    finally:
+        lib.frameenc_free(out_p)
+
+
 def _fiber_array_bytes(fibers) -> bytes:
-    """msgpack bytes of the active-fiber map array, field-vectorized."""
+    """msgpack bytes of the active-fiber map array: native C++ fast path
+    (`native/frameenc.cpp`) with the field-vectorized Python encoder as the
+    fallback — both byte-identical to `packb` of the object maps."""
+    native = _fiber_array_bytes_native(fibers)
+    if native is not None:
+        return native
+    return _fiber_array_bytes_py(fibers)
+
+
+def _fiber_array_bytes_py(fibers) -> bytes:
+    """Pure-Python encode of the active-fiber map array, field-vectorized."""
     x = np.asarray(fibers.x, dtype=np.float64)
     tension = np.asarray(fibers.tension, dtype=np.float64)
     active = np.nonzero(np.asarray(fibers.active))[0]

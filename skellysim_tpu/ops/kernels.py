@@ -161,6 +161,35 @@ def stokeslet_block_mxu(trg, src, f_src):
     return rinv @ f_src + trg * jnp.sum(c, axis=1, keepdims=True) - c @ src
 
 
+def stresslet_block_mxu(trg, src, S):
+    """`stresslet_block` in matmul form (same strategy and numerics caveat as
+    `stokeslet_block_mxu`): with d = t - s,
+
+      d.S.d = T9 @ S9^T - t @ (S s + S^T s)^T + (s.S.s)     (matmuls; T9/S9
+               are the 9 coordinate products t_i t_j / S_ij per point)
+      u_tk  = t_k rowsum(c) - c @ s,   c = -3 (d.S.d) r^-5   (two matmuls)
+
+    leaving rsqrt + ~6 multiplies per pair on the VPU.
+    """
+    eps = jnp.finfo(trg.dtype).eps
+    t2 = jnp.sum(trg * trg, axis=1)
+    s2 = jnp.sum(src * src, axis=1)
+    scale = t2[:, None] + s2[None, :]
+    r2 = jnp.maximum(scale - 2.0 * (trg @ src.T), 0.0)
+    mask = r2 > 16.0 * eps * scale
+    rinv = jnp.where(mask, lax.rsqrt(jnp.where(mask, r2, 1.0)), 0.0)
+    rinv5 = (rinv * rinv) ** 2 * rinv
+
+    T9 = (trg[:, :, None] * trg[:, None, :]).reshape(trg.shape[0], 9)
+    S9 = S.reshape(S.shape[0], 9)
+    Ss = jnp.einsum("sij,sj->si", S, src)
+    STs = jnp.einsum("sij,si->sj", S, src)
+    sSs = jnp.einsum("si,si->s", src, Ss)
+    dSd = T9 @ S9.T - trg @ (Ss + STs).T + sSs[None, :]
+    c = -3.0 * dSd * rinv5
+    return trg * jnp.sum(c, axis=1, keepdims=True) - c @ src
+
+
 def stresslet_block(trg, src, S):
     """Unscaled stresslet partial sum of one (target-block, source-block) pair."""
     d = trg[:, None, :] - src[None, :, :]
@@ -207,17 +236,24 @@ def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096,
     return u * (factor / eta)
 
 
-@partial(jax.jit, static_argnames=("block_size", "source_block"))
+@partial(jax.jit, static_argnames=("block_size", "source_block", "impl"))
 def stresslet_direct(r_dl, r_trg, f_dl, eta, *, block_size: int = 4096,
-                     source_block: int | None = None):
+                     source_block: int | None = None, impl: str = "exact"):
     """Singular stresslet (double-layer) sum.
 
     ``f_dl`` is [n_src, 3, 3] (the 9-component source S with rows indexed like the
     reference's sxx..szz, i.e. ``f_dl[s, i, j] = S_ij``); returns [n_trg, 3].
+    ``impl="mxu"`` selects the matmul-form tile (`stresslet_block_mxu`,
+    recentered on the source centroid — see `stokeslet_block_mxu`'s caveat).
     """
     factor = 1.0 / (8.0 * math.pi)
-    u = _pair_sum(stresslet_block, r_trg, (r_dl, f_dl), block_size,
-                  source_block)
+    if impl == "mxu":
+        center = jnp.mean(r_dl, axis=0)
+        u = _pair_sum(stresslet_block_mxu, r_trg - center,
+                      (r_dl - center, f_dl), block_size, source_block)
+    else:
+        u = _pair_sum(stresslet_block, r_trg, (r_dl, f_dl), block_size,
+                      source_block)
     return u * (factor / eta)
 
 

@@ -30,7 +30,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.kernels import (DEFAULT_EPS, DEFAULT_REG, oseen_block,
-                           stokeslet_block, stresslet_block)
+                           stokeslet_block, stokeslet_block_mxu,
+                           stresslet_block, stresslet_block_mxu)
 from .mesh import FIBER_AXIS
 
 
@@ -71,26 +72,30 @@ def _ring_eval(block_fn, mesh: Mesh, axis_name: str, specs, scale, *operands):
                          out_specs=P(axis_name))(*operands)
 
 
-@partial(jax.jit, static_argnames=("mesh", "axis_name"))
+@partial(jax.jit, static_argnames=("mesh", "axis_name", "impl"))
 def ring_stokeslet(r_src, r_trg, f_src, eta, *, mesh: Mesh,
-                   axis_name: str = FIBER_AXIS):
+                   axis_name: str = FIBER_AXIS, impl: str = "exact"):
     """Ring-parallel singular Stokeslet sum (`ops.kernels.stokeslet_direct`).
 
     Leading axes of ``r_src``/``f_src``/``r_trg`` must be divisible by the
-    mesh size.
+    mesh size. ``impl="mxu"`` uses the matmul-form tile (no centroid
+    recentering in the ring — see `stokeslet_block_mxu`'s caveat, which then
+    applies relative to the raw coordinate magnitudes).
     """
     spec = P(axis_name)
-    return _ring_eval(stokeslet_block, mesh, axis_name, (spec, spec, spec),
+    block = stokeslet_block_mxu if impl == "mxu" else stokeslet_block
+    return _ring_eval(block, mesh, axis_name, (spec, spec, spec),
                       1.0 / (8.0 * math.pi * eta), r_trg, r_src, f_src)
 
 
-@partial(jax.jit, static_argnames=("mesh", "axis_name"))
+@partial(jax.jit, static_argnames=("mesh", "axis_name", "impl"))
 def ring_stresslet(r_dl, r_trg, f_dl, eta, *, mesh: Mesh,
-                   axis_name: str = FIBER_AXIS):
+                   axis_name: str = FIBER_AXIS, impl: str = "exact"):
     """Ring-parallel stresslet (double-layer) sum
     (`ops.kernels.stresslet_direct`); ``f_dl`` is [n_src, 3, 3]."""
     spec = P(axis_name)
-    return _ring_eval(stresslet_block, mesh, axis_name,
+    block = stresslet_block_mxu if impl == "mxu" else stresslet_block
+    return _ring_eval(block, mesh, axis_name,
                       (spec, spec, P(axis_name, None, None)),
                       1.0 / (8.0 * math.pi * eta), r_trg, r_dl, f_dl)
 

@@ -62,6 +62,11 @@ class Params:
     # rotate the ICI ring via collective-permute (free-space fiber systems on
     # a mesh; falls back to direct when a shell/bodies are present)
     pair_evaluator: str = "direct"
+    # pairwise-kernel tile implementation: "exact" (displacement-tensor form,
+    # the reference's semantics bit-for-bit) or "mxu" (matmul form — the
+    # O(N^2*3) contractions ride the MXU; see kernels.stokeslet_block_mxu's
+    # near-field cancellation caveat — for well-separated fiber clouds)
+    kernel_impl: str = "exact"
     # solver precision strategy (no reference analogue — the reference is
     # f64-everywhere on CPU; TPU XLA's LuDecomposition is f32-only and the
     # MXU prefers f32/bf16):

@@ -127,7 +127,7 @@ def update_RHS(v_on_shell):
 
 
 def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct",
-         mesh=None):
+         mesh=None, impl: str = "exact"):
     """Shell -> target velocities via the double-layer stresslet
     (`periphery.cpp:55-79`): f_dl = 2 eta n (x) rho.
 
@@ -149,8 +149,8 @@ def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct
                 [src, jnp.full((pad, 3), 1e7, dtype=src.dtype)], axis=0)
             f_dl = jnp.concatenate(
                 [f_dl, jnp.zeros((pad, 3, 3), dtype=f_dl.dtype)], axis=0)
-        return ring_stresslet(src, r_trg, f_dl, eta, mesh=mesh)
-    return kernels.stresslet_direct(shell.nodes, r_trg, f_dl, eta)
+        return ring_stresslet(src, r_trg, f_dl, eta, mesh=mesh, impl=impl)
+    return kernels.stresslet_direct(shell.nodes, r_trg, f_dl, eta, impl=impl)
 
 
 # ------------------------------------------------- shape-specific interactions

@@ -140,7 +140,8 @@ class System:
         ring; shell/body target rows ride along in the padded target set."""
         if not self._ring_active():
             return fc.flow(state.fibers, caches, r_trg, forces, self.params.eta,
-                           subtract_self=subtract_self, evaluator="direct")
+                           subtract_self=subtract_self, evaluator="direct",
+                           impl=self.params.kernel_impl)
         nfn = state.fibers.n_fibers * state.fibers.n_nodes
         if nfn % self.mesh.size != 0:
             raise ValueError(
@@ -151,7 +152,7 @@ class System:
         r_pad, T = self._ring_pad_targets(r_trg)
         vel = fc.flow(state.fibers, caches, r_pad, forces, self.params.eta,
                       subtract_self=subtract_self, evaluator="ring",
-                      mesh=self.mesh)
+                      mesh=self.mesh, impl=self.params.kernel_impl)
         return vel[:T]
 
     def _shell_flow(self, state: SimState, r_trg, density):
@@ -160,10 +161,12 @@ class System:
         The density->f_dl math and source padding live in `peri.flow`; only
         the target padding is System's job."""
         if not self._ring_active():
-            return peri.flow(state.shell, r_trg, density, self.params.eta)
+            return peri.flow(state.shell, r_trg, density, self.params.eta,
+                             impl=self.params.kernel_impl)
         r_pad, T = self._ring_pad_targets(r_trg)
         return peri.flow(state.shell, r_pad, density, self.params.eta,
-                         evaluator="ring", mesh=self.mesh)[:T]
+                         evaluator="ring", mesh=self.mesh,
+                         impl=self.params.kernel_impl)[:T]
 
     # ------------------------------------------------------------- state setup
 
@@ -319,7 +322,7 @@ class System:
             # (`system.cpp:430-443`)
             ext_ft = bd.external_forces_torques(state.bodies, state.time)
             v_all = v_all + bd.flow(state.bodies, body_caches, r_all, None,
-                                    ext_ft, p.eta)
+                                    ext_ft, p.eta, impl=p.kernel_impl)
 
         v_all = v_all + self._external_flows(state, r_all)
 
@@ -403,7 +406,8 @@ class System:
                 body_ft = jnp.zeros((nb, 6), dtype=hi_dtype)
             v_all = v_all + bd.flow(f_state.bodies, f_bcaches, r_all,
                                     x_bodies.astype(lo_dtype),
-                                    body_ft.astype(lo_dtype), p.eta)
+                                    body_ft.astype(lo_dtype), p.eta,
+                                    impl=p.kernel_impl)
 
         res = []
         if fibers is not None:
@@ -541,8 +545,10 @@ class System:
             f_on_fibers = fc.apply_fiber_force(fibers, caches, x_fib)
             if p.periphery_interaction_flag and shell is not None:
                 f_on_fibers = f_on_fibers + self._periphery_force_fibers(state)
-            v = v + fc.flow(fibers, caches, r_trg, f_on_fibers, p.eta,
-                            subtract_self=False)
+            # through the pair-evaluator seam so listener-mode evaluator
+            # switches (direct/ring) genuinely change the computation
+            v = v + self._fiber_flow(state, caches, r_trg, f_on_fibers,
+                                     subtract_self=False)
 
         if bodies is not None:
             nb = bodies.n_bodies
@@ -554,11 +560,12 @@ class System:
                     bodies, body_caches, fibers, caches, x_fib, x_bodies)
             else:
                 body_ft = jnp.zeros((nb, 6), dtype=solution.dtype)
-            v = v + bd.flow(bodies, body_caches, r_trg, x_bodies, body_ft, p.eta)
+            v = v + bd.flow(bodies, body_caches, r_trg, x_bodies, body_ft,
+                            p.eta, impl=p.kernel_impl)
 
         if shell is not None:
-            v = v + peri.flow(shell, r_trg,
-                              solution[fib_size:fib_size + shell_size], p.eta)
+            v = v + self._shell_flow(state, r_trg,
+                                     solution[fib_size:fib_size + shell_size])
 
         v = v + self._external_flows(state, r_trg)
 
@@ -629,17 +636,16 @@ class System:
         line per step {t, dt, iters, residual, fiber_error, accepted, wall_s}
         — the structured-metrics upgrade SURVEY.md §5.1 calls for.
         """
+        import contextlib
+
         metrics_fh = open(metrics_path, "a") if metrics_path else None
+        # XLA/TPU profiler capture of the whole loop (the structured upgrade
+        # over the reference's omp_get_wtime logging, SURVEY.md §5.1); open
+        # with TensorBoard or xprof
+        prof = (jax.profiler.trace(profile_dir) if profile_dir is not None
+                else contextlib.nullcontext())
         try:
-            if profile_dir is not None:
-                # XLA/TPU profiler capture of the whole loop (the structured
-                # upgrade over the reference's omp_get_wtime logging,
-                # SURVEY.md §5.1); open with TensorBoard or xprof
-                with jax.profiler.trace(profile_dir):
-                    state = self._run_loop(state, writer=writer,
-                                           max_steps=max_steps, rng=rng,
-                                           metrics_fh=metrics_fh)
-            else:
+            with prof:
                 state = self._run_loop(state, writer=writer,
                                        max_steps=max_steps, rng=rng,
                                        metrics_fh=metrics_fh)

@@ -71,10 +71,11 @@ def test_fiber_dualfilament_deflection():
     values (`test_fiber_dualfilament.py:60-64`).
 
     The committed values are the reference implementation's own golden output
-    at these parameters (x0=-0.004765810967995735, x1=1.0048647877439878);
-    agreement here is cross-implementation, so the gate is looser than the
-    reference's self-regression 1e-6 — discretization details (barycentric
-    downsampling order, quadrature) differ at the 1e-3 level.
+    at these parameters (x0=-0.004765810967995735, x1=1.0048647877439878).
+    Measured cross-implementation agreement is ~1e-10 relative — the FD
+    fiber discretization, BC rows, and fiber-fiber hydrodynamics are
+    numerically equivalent to the reference's — so the gate here is the
+    reference's own 1e-6.
     """
     sigma, length, E, n_nodes = 0.0225, 2.0, 0.0025, 64
     x_pert = perturbed_fiber_positions(0.01, length, np.array([0.0, 0.0, 0.0]),
@@ -98,7 +99,7 @@ def test_fiber_dualfilament_deflection():
     rel = np.hypot(abs(1 - x0 / x0_ref), abs(1 - x1 / x1_ref))
     # both fibers moved the right way (driver bent -x, neighbor pushed +x)
     assert x0 < 0 and x1 > 1.0
-    assert rel < 5e-2, (x0, x1, rel)
+    assert rel < 1e-6, (x0, x1, rel)  # the reference's own regression gate
 
 
 def _buckling_deflections(sigma, t_final=50.0):

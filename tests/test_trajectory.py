@@ -206,3 +206,29 @@ def test_frame_bytes_matches_object_encoder():
     assert raw == ref
     # and decodes to the same tree
     assert msgpack.unpackb(raw, raw=False) == msgpack.unpackb(ref, raw=False)
+
+
+def test_native_frame_encoder_matches_python():
+    """The C++ fiber-array encoder is byte-identical to the Python one (and
+    thus to msgpack.packb of the object maps)."""
+    import jax.numpy as jnp
+
+    from skellysim_tpu.fibers import container as fc
+    from skellysim_tpu.io import trajectory as tj
+
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.standard_normal((300, 16, 3)))
+    fibers = fc.make_group(x, lengths=rng.uniform(0.5, 2.0, 300),
+                           bending_rigidity=0.01, radius=0.0125,
+                           minus_clamped=rng.random(300) > 0.5)
+    fibers = fibers._replace(
+        active=jnp.asarray(rng.random(300) > 0.1),
+        binding_body=jnp.asarray(rng.integers(-1, 300, 300), dtype=jnp.int32),
+        tension=jnp.asarray(rng.standard_normal((300, 16))))
+
+    native = tj._fiber_array_bytes_native(fibers)
+    if native is None:
+        import pytest
+
+        pytest.skip("no native toolchain")
+    assert native == tj._fiber_array_bytes_py(fibers)
