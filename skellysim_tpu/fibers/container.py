@@ -264,6 +264,47 @@ def solution_size(group: FiberGroup) -> int:
     return group.n_fibers * 4 * group.n_nodes
 
 
+def sort_fibers_morton(group: FiberGroup) -> FiberGroup:
+    """Reorder fibers by the Morton (Z-order) code of their centroids.
+
+    Makes consecutive fibers spatially local, so the source *chunks* of the
+    chunked pairwise kernels (`ops.kernels._pair_sum`) and the rotating ring
+    blocks are compact in space — which is what keeps the MXU matmul-form
+    tiles accurate in f32 (their per-block recentering bound scales with the
+    block's spatial extent; see `stokeslet_block_mxu`). Safe to apply at any
+    time: all per-fiber state rides along, and nothing indexes fibers by
+    position (body bindings point at bodies, not fibers). Host-side; call at
+    setup or after nucleation bursts, not per step.
+    """
+    nf = group.n_fibers
+    if nf <= 1:
+        return group
+    cent = np.asarray(jnp.mean(group.x, axis=1))          # [nf, 3]
+    lo = cent.min(axis=0)
+    span = np.maximum(cent.max(axis=0) - lo, 1e-300)
+    q = np.clip((cent - lo) / span * 1023.0, 0, 1023).astype(np.uint64)
+
+    def spread(v):
+        # interleave 10 bits with two zero bits (standard Morton dilation)
+        v = (v | (v << 16)) & np.uint64(0x030000FF)
+        v = (v | (v << 8)) & np.uint64(0x0300F00F)
+        v = (v | (v << 4)) & np.uint64(0x030C30C3)
+        v = (v | (v << 2)) & np.uint64(0x09249249)
+        return v
+
+    code = spread(q[:, 0]) | (spread(q[:, 1]) << np.uint64(1)) \
+        | (spread(q[:, 2]) << np.uint64(2))
+    order = np.argsort(code, kind="stable")
+
+    def permute(leaf):
+        leaf = np.asarray(leaf)
+        if leaf.ndim >= 1 and leaf.shape[0] == nf:
+            return leaf[order]
+        return leaf
+
+    return type(group)(*[permute(l) for l in group])
+
+
 def grow_capacity(group: FiberGroup, new_cap: int,
                   node_multiple: int = 1) -> FiberGroup:
     """Pad every [nf]-leading leaf to ``new_cap`` slots (padding inactive).

@@ -138,15 +138,25 @@ def stokeslet_block_mxu(trg, src, f_src):
     Only rsqrt + ~6 multiplies per pair stay elementwise on the VPU.
 
     NUMERICS CAVEAT (why this is opt-in, not the default): the subtraction
-    form loses absolute accuracy ~eps * (|t|^2 + |s|^2) on r2, so (a) exact
+    form loses absolute accuracy ~eps * (|t'|^2 + |s'|^2) on r2, so (a) exact
     self-pair detection by r2 == 0 is no longer reliable — pairs are instead
-    masked below a relative threshold 16 eps (|t|^2+|s|^2), i.e. separations
-    under ~4 sqrt(eps) |t| are treated as coincident — and (b) near-field
-    pairs closer than ~sqrt(eps) |t| carry O(1) relative error. Fine for
-    well-separated free-fiber clouds (node spacings >= 1e-2 at O(10)
-    coordinates); wrong tool for touching surfaces. Recentering coordinates
-    on the cloud centroid before calling tightens both bounds.
+    masked below a relative threshold 16 eps (|t'|^2+|s'|^2), i.e.
+    separations under ~4 sqrt(eps) |t'| are treated as coincident — and (b)
+    near-field pairs closer than ~sqrt(eps) |t'| carry O(1) relative error.
+
+    Coordinates are recentered on the source block's *first point* (t', s'):
+    the dangerous pairs are close ones, and a close target sits near the
+    source block, so when source blocks are spatially local (consecutive
+    nodes of one fiber; `fibers.container.sort_fibers_morton` for whole
+    clouds) |t'| is the block extent and both bounds tighten to harmless.
+    Pure far-field blocks have large r2, where the subtraction form is
+    accurate anyway. The first point — not the mean — because zero- or
+    sentinel-padded tail sources (the ring evaluator pads at 1e7) would
+    drag a mean arbitrarily far from the real points.
     """
+    center = src[0]
+    trg = trg - center
+    src = src - center
     eps = jnp.finfo(trg.dtype).eps
     t2 = jnp.sum(trg * trg, axis=1)
     s2 = jnp.sum(src * src, axis=1)
@@ -169,8 +179,13 @@ def stresslet_block_mxu(trg, src, S):
                are the 9 coordinate products t_i t_j / S_ij per point)
       u_tk  = t_k rowsum(c) - c @ s,   c = -3 (d.S.d) r^-5   (two matmuls)
 
-    leaving rsqrt + ~6 multiplies per pair on the VPU.
+    leaving rsqrt + ~6 multiplies per pair on the VPU. Like
+    `stokeslet_block_mxu`, coordinates recenter on the source block's first
+    point.
     """
+    center = src[0]
+    trg = trg - center
+    src = src - center
     eps = jnp.finfo(trg.dtype).eps
     t2 = jnp.sum(trg * trg, axis=1)
     s2 = jnp.sum(src * src, axis=1)
@@ -221,15 +236,13 @@ def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096,
     memory stays O(block_size * source_block) at BASELINE scale (640k nodes).
 
     ``impl="mxu"`` selects the matmul-form tile (`stokeslet_block_mxu`) that
-    moves the O(N^2 * 3) contractions onto the MXU — see its numerics caveat;
-    coordinates are recentered on the combined centroid first to tighten the
-    cancellation bound.
+    moves the O(N^2 * 3) contractions onto the MXU — see its numerics caveat
+    and per-source-block recentering.
     """
     factor = 1.0 / (8.0 * math.pi)
     if impl == "mxu":
-        center = jnp.mean(r_src, axis=0)
-        u = _pair_sum(stokeslet_block_mxu, r_trg - center,
-                      (r_src - center, f_src), block_size, source_block)
+        u = _pair_sum(stokeslet_block_mxu, r_trg, (r_src, f_src),
+                      block_size, source_block)
     else:
         u = _pair_sum(stokeslet_block, r_trg, (r_src, f_src), block_size,
                       source_block)
@@ -244,13 +257,13 @@ def stresslet_direct(r_dl, r_trg, f_dl, eta, *, block_size: int = 4096,
     ``f_dl`` is [n_src, 3, 3] (the 9-component source S with rows indexed like the
     reference's sxx..szz, i.e. ``f_dl[s, i, j] = S_ij``); returns [n_trg, 3].
     ``impl="mxu"`` selects the matmul-form tile (`stresslet_block_mxu`,
-    recentered on the source centroid — see `stokeslet_block_mxu`'s caveat).
+    recentered per source block on its first point — see
+    `stokeslet_block_mxu`'s caveat).
     """
     factor = 1.0 / (8.0 * math.pi)
     if impl == "mxu":
-        center = jnp.mean(r_dl, axis=0)
-        u = _pair_sum(stresslet_block_mxu, r_trg - center,
-                      (r_dl - center, f_dl), block_size, source_block)
+        u = _pair_sum(stresslet_block_mxu, r_trg, (r_dl, f_dl),
+                      block_size, source_block)
     else:
         u = _pair_sum(stresslet_block, r_trg, (r_dl, f_dl), block_size,
                       source_block)

@@ -78,9 +78,10 @@ def ring_stokeslet(r_src, r_trg, f_src, eta, *, mesh: Mesh,
     """Ring-parallel singular Stokeslet sum (`ops.kernels.stokeslet_direct`).
 
     Leading axes of ``r_src``/``f_src``/``r_trg`` must be divisible by the
-    mesh size. ``impl="mxu"`` uses the matmul-form tile (no centroid
-    recentering in the ring — see `stokeslet_block_mxu`'s caveat, which then
-    applies relative to the raw coordinate magnitudes).
+    mesh size. ``impl="mxu"`` uses the matmul-form tile; each rotating
+    source shard recenters on its own first point inside the tile
+    (`stokeslet_block_mxu`), so the f32 cancellation bound scales with the
+    shard's spatial extent.
     """
     spec = P(axis_name)
     block = stokeslet_block_mxu if impl == "mxu" else stokeslet_block
