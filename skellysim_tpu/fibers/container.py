@@ -279,9 +279,11 @@ def sort_fibers_morton(group: FiberGroup) -> FiberGroup:
     nf = group.n_fibers
     if nf <= 1:
         return group
-    cent = np.asarray(jnp.mean(group.x, axis=1))          # [nf, 3]
+    # f64 centroids regardless of group dtype: a float32 span floored with a
+    # denormal underflows to 0 and NaN-poisons the Morton codes
+    cent = np.asarray(jnp.mean(group.x, axis=1), dtype=np.float64)  # [nf, 3]
     lo = cent.min(axis=0)
-    span = np.maximum(cent.max(axis=0) - lo, 1e-300)
+    span = np.maximum(cent.max(axis=0) - lo, np.finfo(np.float64).tiny)
     q = np.clip((cent - lo) / span * 1023.0, 0, 1023).astype(np.uint64)
 
     def spread(v):

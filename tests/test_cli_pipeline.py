@@ -188,3 +188,77 @@ def test_listener_evaluator_mapping():
     # and back
     s3, switched = switch_evaluator(s2, "CPU")
     assert switched and s3.params.pair_evaluator == "direct"
+
+
+@pytest.mark.slow
+def test_cli_pipeline_revolution_periphery(tmp_path):
+    """gen -> precompute -> run for a surface-of-revolution periphery
+    (`examples/oocyte` shape at fixture scale): exercises the envelope fit,
+    the precompute node-count write-back, and the generic-shell solve."""
+    from skellysim_tpu.config import ConfigRevolution
+
+    cfg = ConfigRevolution()
+    cfg.params.dt_initial = 0.01
+    cfg.params.dt_write = 0.01
+    cfg.params.t_final = 0.02
+    cfg.params.adaptive_timestep_flag = False
+    cfg.periphery.envelope = {
+        "n_nodes_target": 150,
+        "lower_bound": -3.75, "upper_bound": 3.75,
+        "height": "0.5 * T * ((1 + 2*x/length)**p1) * ((1 - 2*x/length)**p2) * length",
+        "T": 0.72, "p1": 0.4, "p2": 0.2, "length": 7.5,
+    }
+    fib = Fiber(n_nodes=8, length=0.5, bending_rigidity=0.0025,
+                minus_clamped=True)
+    cfg.fibers = [fib]
+    cfg.periphery.move_fibers_to_surface(cfg.fibers, ds_min=0.2, verbose=False,
+                                         rng=np.random.default_rng(3))
+    cfg_path = str(tmp_path / "skelly_config.toml")
+    cfg.save(cfg_path)
+
+    precompute.precompute_from_config(cfg_path, verbose=False)
+    # revolution precompute rewrites the config with the realized node count
+    from skellysim_tpu.config import load_config
+
+    back = load_config(cfg_path)
+    assert os.path.exists(str(tmp_path / back.periphery.precompute_file))
+    n_realized = int(np.load(str(tmp_path / back.periphery.precompute_file))
+                     ["nodes"].shape[0])
+    assert back.periphery.n_nodes == n_realized
+
+    cli.run(cfg_path)
+    traj = TrajectoryReader(str(tmp_path / "skelly_sim.out"))
+    assert len(traj) >= 1
+    frame = traj.load_frame(-1)
+    assert np.asarray(frame["shell"]["solution_vec_"]).size == 3 * n_realized
+
+
+@pytest.mark.slow
+def test_cli_pipeline_ellipsoid_periphery(tmp_path):
+    """gen -> precompute -> run for an ellipsoidal periphery
+    (`examples/ellipsoid` shape at fixture scale)."""
+    from skellysim_tpu.config import ConfigEllipsoidal, load_config
+
+    cfg = ConfigEllipsoidal()
+    cfg.params.dt_initial = 0.01
+    cfg.params.dt_write = 0.01
+    cfg.params.t_final = 0.02
+    cfg.params.adaptive_timestep_flag = False
+    cfg.periphery.n_nodes = 150
+    cfg.periphery.a, cfg.periphery.b, cfg.periphery.c = 6.0, 4.0, 4.0
+    fib = Fiber(n_nodes=8, length=0.5, bending_rigidity=0.0025,
+                minus_clamped=True)
+    cfg.fibers = [fib]
+    cfg.periphery.move_fibers_to_surface(cfg.fibers, ds_min=0.2, verbose=False,
+                                         rng=np.random.default_rng(5))
+    cfg_path = str(tmp_path / "skelly_config.toml")
+    cfg.save(cfg_path)
+
+    precompute.precompute_from_config(cfg_path, verbose=False)
+    cli.run(cfg_path)
+    traj = TrajectoryReader(str(tmp_path / "skelly_sim.out"))
+    assert len(traj) >= 1
+    back = load_config(cfg_path)
+    frame = traj.load_frame(-1)
+    assert (np.asarray(frame["shell"]["solution_vec_"]).size
+            == 3 * back.periphery.n_nodes)
