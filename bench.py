@@ -518,6 +518,26 @@ def main():
         extra["stokeslet_f64"] = {"error": _short_err(e)}
     _checkpoint(extra)
 
+    # double-float f32 kernel: f64-class accuracy without emulated f64
+    # (ops/df_kernels.py) — rate + achieved error vs the exact path
+    try:
+        from skellysim_tpu.ops import kernels as _k
+        from skellysim_tpu.ops.df_kernels import stokeslet_direct_df
+
+        r, f = _kernel_inputs(jnp.float32, n64)
+        rate_df = _rate(lambda: stokeslet_direct_df(r, r, f, 1.0), n64 * n64)
+        ref = np.asarray(_k.stokeslet_direct(
+            r.astype(jnp.float64), r.astype(jnp.float64),
+            f.astype(jnp.float64), 1.0))
+        got = np.asarray(stokeslet_direct_df(r, r, f, 1.0))
+        extra["stokeslet_df"] = {
+            "n": n64, "gpairs_per_s": round(rate_df / 1e9, 4),
+            "rel_err_vs_f64": float(np.linalg.norm(got - ref)
+                                    / np.linalg.norm(ref))}
+    except Exception as e:
+        extra["stokeslet_df"] = {"error": _short_err(e)}
+    _checkpoint(extra)
+
     # Pallas fused tiles (accelerator only): report whichever path wins
     if on_acc and rate32 is not None:
         try:

@@ -1,0 +1,256 @@
+"""Double-float (compensated f32) pairwise kernels.
+
+TPU f64 is software-emulated (~113x slower than f32 on the measured v5
+chip, `docs/performance.md`), but the reference's backend-agreement gate is
+5e-9 (`/root/reference/tests/core/kernel_test.cpp:93`) — unreachable in plain
+f32. These kernels evaluate the Stokeslet in double-float arithmetic: every
+value is an unevaluated (hi, lo) pair of f32 with ~2*24 bits of significand
+(Dekker/Knuth error-free transformations), giving ~1e-14-class per-pair
+accuracy from pure f32 VPU ops at a small-constant-factor cost instead of the
+emulated-f64 cliff. Pair contributions are exact-converted to f64 (hi + lo is
+exactly representable) only for the final accumulation.
+
+Intended use: the high-precision residual matvec of the mixed-precision
+solver (`solver.gmres_ir`) at scales where the native-f64 kernels are too
+slow, and the on-device kernel-agreement gate. Dtype-generic (the same
+transformations double f64 on CPU), but f32 inputs are the point.
+
+References: Dekker (1971) / Knuth TAOCP two_sum & two_prod; the standard
+double-double recipes (e.g. Hida-Li-Bailey's QD library's add/mul shapes) —
+re-derived here for branch-free jnp arrays.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["stokeslet_direct_df"]
+
+
+# Every rounded intermediate that error-extraction expressions subtract back
+# is wrapped in an optimization barrier: XLA's algebraic simplifier (and the
+# excess-precision mode the TPU stack pins on, --xla_allow_excess_precision)
+# otherwise cancels patterns like (a + b) - a symbolically, collapsing the
+# compensation terms to zero — measured: the jitted rsqrt regressed from
+# 1e-14 (eager) to f32 seed accuracy before the barriers.
+_bar = lax.optimization_barrier
+
+
+def _two_sum(a, b):
+    """Error-free a + b = s + e (Knuth; no magnitude ordering required)."""
+    s = _bar(a + b)
+    bb = _bar(s - a)
+    e = (a - _bar(s - bb)) + (b - bb)
+    return s, e
+
+
+def _quick_two_sum(a, b):
+    """Error-free a + b = s + e assuming |a| >= |b|."""
+    s = _bar(a + b)
+    e = b - (s - a)
+    return s, e
+
+
+def _split_factor(dtype):
+    # 2^ceil(p/2) + 1: 4097 for f32 (p=24), 134217729 for f64 (p=53)
+    bits = jnp.finfo(dtype).nmant + 1
+    return float(2 ** math.ceil(bits / 2) + 1)
+
+
+def _two_prod(a, b):
+    """Error-free a * b = p + e via Dekker splitting (no FMA dependency)."""
+    c = _split_factor(a.dtype)
+    p = _bar(a * b)
+    a_big = _bar(c * a)
+    a_hi = _bar(a_big - _bar(a_big - a))
+    a_lo = a - a_hi
+    b_big = _bar(c * b)
+    b_hi = _bar(b_big - _bar(b_big - b))
+    b_lo = b - b_hi
+    e = ((a_hi * b_hi - p) + a_hi * b_lo + a_lo * b_hi) + a_lo * b_lo
+    return p, e
+
+
+def _df_add(xh, xl, yh, yl):
+    s, e = _two_sum(xh, yh)
+    e = e + (xl + yl)
+    return _quick_two_sum(s, e)
+
+
+def _df_mul(xh, xl, yh, yl):
+    p, e = _two_prod(xh, yh)
+    e = e + (xh * yl + xl * yh)
+    return _quick_two_sum(p, e)
+
+
+def _df_neg(xh, xl):
+    return -xh, -xl
+
+
+def _df_rsqrt(xh, xl):
+    """1/sqrt(x) in double-float: f32 seed + one DF Newton step.
+
+    y_{n+1} = y_n * (3 - x y_n^2) / 2 doubles the accurate bits, so one step
+    from the ~2^-24 hardware estimate reaches the full DF precision. Assumes
+    x > 0 (callers mask zero/coincident pairs before the sqrt).
+    """
+    y0 = lax.rsqrt(xh)
+    # t = x * y0 * y0  (DF)
+    th, tl = _df_mul(xh, xl, y0, jnp.zeros_like(y0))
+    th, tl = _df_mul(th, tl, y0, jnp.zeros_like(y0))
+    # r = 3 - t (DF)
+    rh, rl = _df_add(jnp.full_like(th, 3.0), jnp.zeros_like(th), *_df_neg(th, tl))
+    # y = y0 * r / 2
+    yh, yl = _df_mul(rh, rl, y0, jnp.zeros_like(y0))
+    return 0.5 * yh, 0.5 * yl
+
+
+def _df_sum(h, l, axis):
+    """Sum (h, l) double-float values along ``axis`` with renormalizing DF
+    adds in a log-depth halving tree — all f32. One f64 conversion per
+    *result* element happens in the caller, so the per-pair emulated-f64
+    cost of a naive `jnp.sum(hi.astype(f64) + lo.astype(f64))` (ruinous on
+    TPU, where f64 is software-emulated) never appears."""
+    n = h.shape[axis]
+    p = 1 << max(n - 1, 0).bit_length()
+    if p != n:
+        pads = [(0, 0)] * h.ndim
+        pads[axis] = (0, p - n)
+        h = jnp.pad(h, pads)
+        l = jnp.pad(l, pads)
+    while h.shape[axis] > 1:
+        m = h.shape[axis] // 2
+        h, l = _df_add(lax.slice_in_dim(h, 0, m, axis=axis),
+                       lax.slice_in_dim(l, 0, m, axis=axis),
+                       lax.slice_in_dim(h, m, 2 * m, axis=axis),
+                       lax.slice_in_dim(l, m, 2 * m, axis=axis))
+    return jnp.squeeze(h, axis), jnp.squeeze(l, axis)
+
+
+def _df_split(x):
+    """f64 -> (hi, lo) f32 pair with hi + lo ~ x to ~2^-48; f32 passes
+    through with lo = 0."""
+    if x.dtype == jnp.float32:
+        return x, jnp.zeros_like(x)
+    hi = x.astype(jnp.float32)
+    lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
+    return hi, lo
+
+
+def _stokeslet_block_df(trg_hl, src_hl, f_hl):
+    """One (target-block, source-chunk) Stokeslet partial sum, accumulated in
+    f64 from per-pair double-float contributions. Operands are (hi, lo) f32
+    pairs ([t, 3] / [s, 3]); returns [t, 3] float64."""
+    trg_h, trg_l = trg_hl
+    src_h, src_l = src_hl
+    f_h, f_l = f_hl
+
+    def comp(k):
+        dh, de = _two_sum(trg_h[:, None, k], -src_h[None, :, k])
+        # full two_sum, not quick: for nearly coincident f64 points the
+        # lo-word difference can exceed |dh|, violating quick_two_sum's
+        # magnitude precondition
+        return _two_sum(dh, de + (trg_l[:, None, k] - src_l[None, :, k]))
+
+    dxh, dxl = comp(0)
+    dyh, dyl = comp(1)
+    dzh, dzl = comp(2)
+
+    r2h, r2l = _df_mul(dxh, dxl, dxh, dxl)
+    r2h, r2l = _df_add(r2h, r2l, *_df_mul(dyh, dyl, dyh, dyl))
+    r2h, r2l = _df_add(r2h, r2l, *_df_mul(dzh, dzl, dzh, dzl))
+
+    mask = r2h > 0.0
+    safe = jnp.where(mask, r2h, 1.0)
+    rih, ril = _df_rsqrt(safe, jnp.where(mask, r2l, 0.0))
+    rih = jnp.where(mask, rih, 0.0)
+    ril = jnp.where(mask, ril, 0.0)
+    r3h, r3l = _df_mul(rih, ril, rih, ril)
+    r3h, r3l = _df_mul(r3h, r3l, rih, ril)
+
+    fs = [(f_h[None, :, k], f_l[None, :, k]) for k in range(3)]
+    dfh, dfl = _df_mul(dxh, dxl, *fs[0])
+    dfh, dfl = _df_add(dfh, dfl, *_df_mul(dyh, dyl, *fs[1]))
+    dfh, dfl = _df_add(dfh, dfl, *_df_mul(dzh, dzl, *fs[2]))
+
+    ch, cl = _df_mul(dfh, dfl, r3h, r3l)
+
+    out = []
+    for (fkh, fkl), dh, dl in ((fs[0], dxh, dxl), (fs[1], dyh, dyl),
+                               (fs[2], dzh, dzl)):
+        uh, ul = _df_mul(rih, ril, fkh, fkl)
+        uh, ul = _df_add(uh, ul, *_df_mul(ch, cl, dh, dl))
+        sh, sl = _df_sum(uh, ul, axis=1)
+        # hi + lo is exactly representable in f64: one conversion per target
+        out.append(sh.astype(jnp.float64) + sl.astype(jnp.float64))
+    return jnp.stack(out, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("block_size", "source_block"))
+def stokeslet_direct_df(r_src, r_trg, f_src, eta, *, block_size: int = 1024,
+                        source_block: int = 4096):
+    """Singular Stokeslet sum with double-float per-pair arithmetic.
+
+    Same semantics as `kernels.stokeslet_direct` (self pairs drop, factor
+    1/(8 pi eta)), evaluated to ~1e-14-class relative accuracy — far under
+    the reference's 5e-9 backend-agreement gate — without native f64 pair
+    arithmetic. f32 inputs pass straight in; f64 inputs split into (hi, lo)
+    f32 pairs (~2^-48 representation error), so this serves as the
+    high-precision residual matvec for `solver.gmres_ir` at scales where
+    emulated f64 is too slow. Returns float64.
+
+    Accuracy envelope: per-pair relative error ~max(1e-14,
+    2^-48 * |x| / |d|) — the split bounds how precisely a displacement
+    between close points is represented. Physical node spacings (>= 1e-2 at
+    O(10) coordinates) sit comfortably under the gate; pathological
+    separations below ~1e-6 * |x| degrade gracefully toward f32-class for
+    that pair only.
+    """
+    from .kernels import _block_iter
+
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        # without x64, every float64 request silently canonicalizes to f32
+        # and the result would be ordinary f32 accuracy wearing a DF label
+        raise RuntimeError(
+            "stokeslet_direct_df needs jax_enable_x64 for its float64 "
+            "accumulator/output (the pair arithmetic itself is f32)")
+
+    n_trg = r_trg.shape[0]
+    n_src = r_src.shape[0]
+    if n_trg == 0:
+        return jnp.zeros((0, 3), dtype=jnp.float64)
+
+    def blocks(a, block, nb, pad):
+        hi, lo = _df_split(a)
+        return (jnp.pad(hi, ((0, pad), (0, 0))).reshape(nb, block, 3),
+                jnp.pad(lo, ((0, pad), (0, 0))).reshape(nb, block, 3))
+
+    nb_t = _block_iter(n_trg, block_size)
+    trg_blocks = blocks(r_trg, block_size, nb_t,
+                        nb_t * block_size - n_trg)
+
+    nb_s = _block_iter(n_src, source_block)
+    pad_s = nb_s * source_block - n_src
+    src_chunks = blocks(r_src, source_block, nb_s, pad_s)
+    f_chunks = blocks(f_src, source_block, nb_s, pad_s)
+
+    def per_target_block(trg_hl):
+        def body(acc, chunk):
+            sh, sl, fh, fl = chunk
+            return acc + _stokeslet_block_df(trg_hl, (sh, sl), (fh, fl)), None
+
+        acc, _ = lax.scan(
+            body, jnp.zeros((trg_hl[0].shape[0], 3), dtype=jnp.float64),
+            (src_chunks[0], src_chunks[1], f_chunks[0], f_chunks[1]))
+        return acc
+
+    u = lax.map(per_target_block, trg_blocks)
+    u = u.reshape(nb_t * block_size, 3)[:n_trg]
+    return u / (8.0 * math.pi) / jnp.asarray(eta, dtype=jnp.float64)
