@@ -230,6 +230,32 @@ def _bench_single_fiber(dtype, tol, trials=3):
     return out
 
 
+def _block_inv(M, max_direct: int = 12000):
+    """Dense inverse via recursive 2x2 Schur-complement blocking.
+
+    TPU LuDecomposition keeps an [n, 128] panel in scoped VMEM; at n = 18000
+    (a 6000-node shell) that panel is 17.7 MB against a 16 MB limit and the
+    compile fails. Halving until blocks fit turns the inverse into two
+    smaller LUs plus MXU matmuls. Accuracy is preconditioner-grade, which is
+    all the callers need.
+    """
+    import jax.numpy as jnp
+
+    n = M.shape[0]
+    if n <= max_direct:
+        return jnp.linalg.inv(M)
+    h = n // 2
+    A, B = M[:h, :h], M[:h, h:]
+    C, D = M[h:, :h], M[h:, h:]
+    Ai = _block_inv(A, max_direct)
+    AiB = Ai @ B
+    Si = _block_inv(D - C @ AiB, max_direct)
+    CAi = C @ Ai
+    top = jnp.concatenate([Ai + AiB @ (Si @ CAi), -AiB @ Si], axis=1)
+    bot = jnp.concatenate([-Si @ CAi, Si], axis=1)
+    return jnp.concatenate([top, bot], axis=0)
+
+
 def _device_shell_operator(nodes, normals, weights, dtype, precond_dtype=None):
     """Dense second-kind shell operator + inverse, assembled on-device.
 
@@ -250,25 +276,21 @@ def _device_shell_operator(nodes, normals, weights, dtype, precond_dtype=None):
     normals_d = jnp.asarray(normals, dtype=dtype)
     w_d = jnp.asarray(weights, dtype=dtype)
 
-    M = kernels.stresslet_times_normal_blocked(nodes_d, normals_d, 1.0
-                                               ).reshape(3 * N, 3 * N)
+    M = kernels.stresslet_times_normal_blocked(nodes_d, normals_d, 1.0)
 
-    svs = []
+    # singularity-subtraction columns, scattered in 2-D (a [N, 3, N, 3]
+    # reshape would be tile-padded 3 -> 128 by XLA: 55 GB at N = 6000)
+    idx = jnp.arange(N)
+    rows = 3 * idx[:, None] + jnp.arange(3)[None, :]  # [N, 3]
     for k in range(3):
         e = jnp.zeros((N, 3), dtype=dtype).at[:, k].set(w_d)
-        svs.append(kernels.stresslet_times_normal_times_density(
-            nodes_d, normals_d, e, 1.0))
-    C = jnp.stack(svs, axis=-1) / w_d[:, None, None]  # [N, 3row, 3col]
-
-    M4 = M.reshape(N, 3, N, 3)
-    i = jnp.arange(N)[:, None, None]
-    M4 = M4.at[i, jnp.arange(3)[None, :, None], i,
-               jnp.arange(3)[None, None, :]].add(-C)
-    M = M4.reshape(3 * N, 3 * N)
+        sv = kernels.stresslet_times_normal_times_density(
+            nodes_d, normals_d, e, 1.0)
+        M = M.at[rows, (3 * idx + k)[:, None]].add(-sv / w_d[:, None])
     d = jnp.arange(3 * N)
     M = M.at[d, d].add(-jnp.repeat(1.0 / w_d, 3))
     M = M + jnp.outer(normals_d.reshape(-1), normals_d.reshape(-1))
-    M_inv = jnp.linalg.inv(M.astype(precond_dtype) if precond_dtype else M)
+    M_inv = _block_inv(M.astype(precond_dtype) if precond_dtype else M)
     return M, M_inv
 
 

@@ -161,16 +161,17 @@ def update_cache(group: BodyGroup, eta, precond_dtype=None) -> BodyCaches:
 
     K = jax.vmap(jax.vmap(k_node))(vec).reshape(nb, 3 * n, 6)
 
-    # dense operator A (`update_preconditioner`, `:104-127`)
+    # dense operator A (`update_preconditioner`, `:104-127`); assembled in
+    # the 2-D [3n, 3n] layout throughout — a [.., n, 3]-shaped intermediate
+    # would be tile-padded 3 -> 128 by XLA (42x HBM)
     def build_A(nodes_b, normals_b, w_b, ex_b, ey_b, ez_b, K_b):
-        M = kernels.stresslet_times_normal(nodes_b, normals_b, eta).reshape(3 * n, 3 * n)
-        # subtract the singularity columns: A[3i:3i+3, 3i+k] -= e_k[i]/w_i
-        sub = jnp.zeros((n, 3, n, 3), dtype=M.dtype)
+        M = kernels.stresslet_times_normal_blocked(
+            nodes_b, normals_b, eta, block_size=min(512, -(-n // 8) * 8))
+        # subtract the singularity columns: A[3i+a, 3i+k] -= e_k[i, a]/w_i
         idx = jnp.arange(n)
-        sub = sub.at[idx, :, idx, 0].set(ex_b / w_b[:, None])
-        sub = sub.at[idx, :, idx, 1].set(ey_b / w_b[:, None])
-        sub = sub.at[idx, :, idx, 2].set(ez_b / w_b[:, None])
-        M = M - sub.reshape(3 * n, 3 * n)
+        rows = (3 * idx[:, None] + jnp.arange(3)[None, :])  # [n, 3]
+        for k, e in enumerate((ex_b, ey_b, ez_b)):
+            M = M.at[rows, (3 * idx + k)[:, None]].add(-e / w_b[:, None])
         top = jnp.concatenate([M, -K_b], axis=1)
         bottom = jnp.concatenate([-K_b.T, jnp.eye(6, dtype=M.dtype)], axis=1)
         return jnp.concatenate([top, bottom], axis=0)

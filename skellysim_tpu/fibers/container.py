@@ -72,7 +72,10 @@ class FiberCaches(NamedTuple):
     xss: jnp.ndarray
     xsss: jnp.ndarray
     xssss: jnp.ndarray
-    stokeslet: jnp.ndarray  # [nf, n, 3, n, 3] dense self-mobility
+    #: [nf, 3n, 3n] dense self-mobility (interleaved-xyz 2-D layout: a
+    #: [.., n, 3]-shaped leaf would be tile-padded 3 -> 128 by XLA, a 42x
+    #: HBM blowup at large fiber counts)
+    stokeslet: jnp.ndarray
     force_op: jnp.ndarray   # [nf, 3n, 4n]
     A_bc: jnp.ndarray       # [nf, 4n, 4n] (BC-applied)
     RHS: jnp.ndarray        # [nf, 4n] (BC-applied)
@@ -125,7 +128,9 @@ def update_cache(group: FiberGroup, dt, eta) -> FiberCaches:
     xs, xss, xsss, xssss = jax.vmap(
         lambda x, lp: fd_fiber.derivatives(x, lp, mats))(group.x, group.length_prev)
 
-    stokeslet = jax.vmap(lambda x: kernels.oseen_tensor(x, x, eta))(group.x)
+    n3 = 3 * group.n_nodes
+    stokeslet = jax.vmap(
+        lambda x: kernels.oseen_tensor(x, x, eta).reshape(n3, n3))(group.x)
     force_op = jax.vmap(
         lambda a, b, s: fd_fiber.force_operator(a, b, eta, s, mats))(xs, xss, sc)
 
@@ -204,7 +209,8 @@ def flow(group: FiberGroup, caches: FiberCaches, r_trg, forces, eta,
         vel = kernels.stokeslet_direct(node_positions(group), r_trg,
                                        wf.reshape(-1, 3), eta, impl=impl)
     if subtract_self:
-        self_vel = jnp.einsum("fiajb,fjb->fia", caches.stokeslet, wf)
+        self_vel = jnp.einsum("fij,fj->fi", caches.stokeslet,
+                              wf.reshape(group.n_fibers, -1))
         nfn = group.n_fibers * group.n_nodes
         vel = vel.at[:nfn].add(-self_vel.reshape(-1, 3))
     return vel

@@ -213,8 +213,6 @@ def stokeslet_direct_df(r_src, r_trg, f_src, eta, *, block_size: int = 1024,
     """
     from .kernels import _block_iter
 
-    import jax
-
     if not jax.config.jax_enable_x64:
         # without x64, every float64 request silently canonicalizes to f32
         # and the result would be ordinary f32 accuracy wearing a DF label

@@ -69,7 +69,10 @@ def build_shell_operator(nodes, normals, weights, eta: float = 1.0):
     weights = np.asarray(weights, dtype=np.float64)
     N = len(nodes)
 
-    M = np.array(kernels.stresslet_times_normal(nodes, normals, eta)).reshape(3 * N, 3 * N)
+    # row-blocked 2-D assembly: the dense 4-D builder materializes a
+    # [N, 3, N, 3] device array whose trailing dim of 3 XLA tile-pads to 128
+    # (55 GB at N = 6000 — an OOM on any real accelerator backend)
+    M = np.array(kernels.stresslet_times_normal_blocked(nodes, normals, eta))
 
     # singularity subtraction vectors e_k integrated with quadrature weights
     def sing_vec(k):

@@ -242,10 +242,11 @@ def test_stresslet_times_normal_blocked_matches_dense():
     nrm = rng.standard_normal((37, 3))
     nrm /= np.linalg.norm(nrm, axis=1, keepdims=True)
     nrm = jnp.asarray(nrm)
-    dense = kernels.stresslet_times_normal(r, nrm, 1.0)
+    dense = np.asarray(kernels.stresslet_times_normal(r, nrm, 1.0)
+                       ).reshape(3 * 37, 3 * 37)
     blocked = kernels.stresslet_times_normal_blocked(r, nrm, 1.0, block_size=8)
-    np.testing.assert_allclose(np.asarray(blocked), np.asarray(dense),
-                               rtol=0, atol=1e-13)
+    assert blocked.shape == (3 * 37, 3 * 37)
+    np.testing.assert_allclose(np.asarray(blocked), dense, rtol=0, atol=1e-13)
 
 
 def test_stokeslet_mxu_impl_matches_exact():
