@@ -167,11 +167,7 @@ def update_cache(group: BodyGroup, eta, precond_dtype=None) -> BodyCaches:
     def build_A(nodes_b, normals_b, w_b, ex_b, ey_b, ez_b, K_b):
         M = kernels.stresslet_times_normal_blocked(
             nodes_b, normals_b, eta, block_size=min(512, -(-n // 8) * 8))
-        # subtract the singularity columns: A[3i+a, 3i+k] -= e_k[i, a]/w_i
-        idx = jnp.arange(n)
-        rows = (3 * idx[:, None] + jnp.arange(3)[None, :])  # [n, 3]
-        for k, e in enumerate((ex_b, ey_b, ez_b)):
-            M = M.at[rows, (3 * idx + k)[:, None]].add(-e / w_b[:, None])
+        M = kernels.subtract_singularity_columns(M, (ex_b, ey_b, ez_b), w_b)
         top = jnp.concatenate([M, -K_b], axis=1)
         bottom = jnp.concatenate([-K_b.T, jnp.eye(6, dtype=M.dtype)], axis=1)
         return jnp.concatenate([top, bottom], axis=0)

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["stokeslet_direct_df"]
+__all__ = ["stokeslet_direct_df", "stresslet_direct_df"]
 
 
 # Every rounded intermediate that error-extraction expressions subtract back
@@ -191,6 +191,128 @@ def _stokeslet_block_df(trg_hl, src_hl, f_hl):
     return jnp.stack(out, axis=-1)
 
 
+def _stresslet_block_df(trg_hl, src_hl, S_hl):
+    """One (target-block, source-chunk) stresslet partial sum in double-float.
+
+    ``u_k = sum_s -3 (d . S_s . d) d_k / r^5`` with d = t - s and self pairs
+    dropped — the DF mirror of `kernels.stresslet_block`. ``S_hl`` is the
+    (hi, lo) pair of the [s, 3, 3] double-layer source. Returns [t, 3] f64.
+    """
+    S_h, S_l = S_hl
+    d = []   # displacement components as DF pairs
+    trg_h, trg_l = trg_hl
+    src_h, src_l = src_hl
+    for k in range(3):
+        dh, de = _two_sum(trg_h[:, None, k], -src_h[None, :, k])
+        d.append(_two_sum(dh, de + (trg_l[:, None, k] - src_l[None, :, k])))
+
+    r2h, r2l = _df_mul(*d[0], *d[0])
+    r2h, r2l = _df_add(r2h, r2l, *_df_mul(*d[1], *d[1]))
+    r2h, r2l = _df_add(r2h, r2l, *_df_mul(*d[2], *d[2]))
+
+    mask = r2h > 0.0
+    safe = jnp.where(mask, r2h, 1.0)
+    rih, ril = _df_rsqrt(safe, jnp.where(mask, r2l, 0.0))
+    rih = jnp.where(mask, rih, 0.0)
+    ril = jnp.where(mask, ril, 0.0)
+    # r^-5 = (r^-1)^4 * r^-1
+    r2ih, r2il = _df_mul(rih, ril, rih, ril)
+    r4ih, r4il = _df_mul(r2ih, r2il, r2ih, r2il)
+    r5h, r5l = _df_mul(r4ih, r4il, rih, ril)
+
+    # z_i = sum_j S_ij d_j  (DF), then dSd = sum_i d_i z_i
+    dSdh = dSdl = None
+    for i in range(3):
+        zh, zl = _df_mul(S_h[None, :, i, 0], S_l[None, :, i, 0], *d[0])
+        zh, zl = _df_add(zh, zl, *_df_mul(S_h[None, :, i, 1],
+                                          S_l[None, :, i, 1], *d[1]))
+        zh, zl = _df_add(zh, zl, *_df_mul(S_h[None, :, i, 2],
+                                          S_l[None, :, i, 2], *d[2]))
+        th, tl = _df_mul(*d[i], zh, zl)
+        dSdh, dSdl = (th, tl) if dSdh is None else _df_add(dSdh, dSdl, th, tl)
+
+    ch, cl = _df_mul(dSdh, dSdl, r5h, r5l)
+
+    out = []
+    for k in range(3):
+        uh, ul = _df_mul(ch, cl, *d[k])
+        sh, sl = _df_sum(uh, ul, axis=1)
+        # the -3 scale applies on the exact f64 reconstruction: scaling the
+        # (hi, lo) words separately by a non-power-of-two rounds each word
+        # and destroys the compensation (measured: 2.7e-8 instead of 1e-13)
+        out.append(-3.0 * (sh.astype(jnp.float64) + sl.astype(jnp.float64)))
+    return jnp.stack(out, axis=-1)
+
+
+def _direct_df(block_fn, r_src, r_trg, payload, eta, block_size, source_block):
+    """Shared target-blocked, source-chunked driver for the DF kernels.
+
+    ``block_fn(trg_hl, src_hl, payload_hl) -> [t, 3] f64`` is one
+    (target-block, source-chunk) partial sum; ``payload`` is the per-source
+    strength array (any trailing rank). Zero-padded tail sources must
+    contribute zero (payload pads are zero and both block functions mask
+    coincident pairs). Applies the common 1/(8 pi eta) scale.
+    """
+    from .kernels import _block_iter
+
+    if not jax.config.jax_enable_x64:
+        # without x64, every float64 request silently canonicalizes to f32
+        # and the result would be ordinary f32 accuracy wearing a DF label
+        raise RuntimeError(
+            "DF kernels need jax_enable_x64 for their float64 "
+            "accumulator/output (the pair arithmetic itself is f32)")
+
+    n_trg = r_trg.shape[0]
+    n_src = r_src.shape[0]
+    if n_trg == 0:
+        return jnp.zeros((0, 3), dtype=jnp.float64)
+
+    def blocks(a, block, nb, pad):
+        hi, lo = _df_split(a)
+        widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+        shape = (nb, block) + a.shape[1:]
+        return (jnp.pad(hi, widths).reshape(shape),
+                jnp.pad(lo, widths).reshape(shape))
+
+    nb_t = _block_iter(n_trg, block_size)
+    trg_blocks = blocks(r_trg, block_size, nb_t, nb_t * block_size - n_trg)
+
+    nb_s = _block_iter(n_src, source_block)
+    pad_s = nb_s * source_block - n_src
+    src_chunks = blocks(r_src, source_block, nb_s, pad_s)
+    payload_chunks = blocks(payload, source_block, nb_s, pad_s)
+
+    def per_target_block(trg_hl):
+        def body(acc, chunk):
+            sh, sl, ph, pl = chunk
+            return acc + block_fn(trg_hl, (sh, sl), (ph, pl)), None
+
+        acc, _ = lax.scan(
+            body, jnp.zeros((trg_hl[0].shape[0], 3), dtype=jnp.float64),
+            (src_chunks[0], src_chunks[1],
+             payload_chunks[0], payload_chunks[1]))
+        return acc
+
+    u = lax.map(per_target_block, trg_blocks)
+    u = u.reshape(nb_t * block_size, 3)[:n_trg]
+    return u / (8.0 * math.pi) / jnp.asarray(eta, dtype=jnp.float64)
+
+
+@partial(jax.jit, static_argnames=("block_size", "source_block"))
+def stresslet_direct_df(r_dl, r_trg, f_dl, eta, *, block_size: int = 1024,
+                        source_block: int = 4096):
+    """Singular stresslet (double-layer) sum in double-float arithmetic.
+
+    Same semantics as `kernels.stresslet_direct` (``f_dl`` is [n_src, 3, 3],
+    self pairs drop, factor 1/(8 pi eta)), evaluated to ~1e-14-class relative
+    accuracy from f32 VPU ops; the shell -> target flow is the dominant term
+    of the mixed solver's f64 refinement matvec at walkthrough scale, where
+    emulated f64 costs ~100x f32. Returns float64.
+    """
+    return _direct_df(_stresslet_block_df, r_dl, r_trg, f_dl, eta,
+                      block_size, source_block)
+
+
 @partial(jax.jit, static_argnames=("block_size", "source_block"))
 def stokeslet_direct_df(r_src, r_trg, f_src, eta, *, block_size: int = 1024,
                         source_block: int = 4096):
@@ -211,44 +333,5 @@ def stokeslet_direct_df(r_src, r_trg, f_src, eta, *, block_size: int = 1024,
     separations below ~1e-6 * |x| degrade gracefully toward f32-class for
     that pair only.
     """
-    from .kernels import _block_iter
-
-    if not jax.config.jax_enable_x64:
-        # without x64, every float64 request silently canonicalizes to f32
-        # and the result would be ordinary f32 accuracy wearing a DF label
-        raise RuntimeError(
-            "stokeslet_direct_df needs jax_enable_x64 for its float64 "
-            "accumulator/output (the pair arithmetic itself is f32)")
-
-    n_trg = r_trg.shape[0]
-    n_src = r_src.shape[0]
-    if n_trg == 0:
-        return jnp.zeros((0, 3), dtype=jnp.float64)
-
-    def blocks(a, block, nb, pad):
-        hi, lo = _df_split(a)
-        return (jnp.pad(hi, ((0, pad), (0, 0))).reshape(nb, block, 3),
-                jnp.pad(lo, ((0, pad), (0, 0))).reshape(nb, block, 3))
-
-    nb_t = _block_iter(n_trg, block_size)
-    trg_blocks = blocks(r_trg, block_size, nb_t,
-                        nb_t * block_size - n_trg)
-
-    nb_s = _block_iter(n_src, source_block)
-    pad_s = nb_s * source_block - n_src
-    src_chunks = blocks(r_src, source_block, nb_s, pad_s)
-    f_chunks = blocks(f_src, source_block, nb_s, pad_s)
-
-    def per_target_block(trg_hl):
-        def body(acc, chunk):
-            sh, sl, fh, fl = chunk
-            return acc + _stokeslet_block_df(trg_hl, (sh, sl), (fh, fl)), None
-
-        acc, _ = lax.scan(
-            body, jnp.zeros((trg_hl[0].shape[0], 3), dtype=jnp.float64),
-            (src_chunks[0], src_chunks[1], f_chunks[0], f_chunks[1]))
-        return acc
-
-    u = lax.map(per_target_block, trg_blocks)
-    u = u.reshape(nb_t * block_size, 3)[:n_trg]
-    return u / (8.0 * math.pi) / jnp.asarray(eta, dtype=jnp.float64)
+    return _direct_df(_stokeslet_block_df, r_src, r_trg, f_src, eta,
+                      block_size, source_block)

@@ -237,8 +237,17 @@ def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096,
 
     ``impl="mxu"`` selects the matmul-form tile (`stokeslet_block_mxu`) that
     moves the O(N^2 * 3) contractions onto the MXU — see its numerics caveat
-    and per-source-block recentering.
+    and per-source-block recentering. ``impl="df"`` evaluates in double-float
+    f32 arithmetic (`df_kernels.stokeslet_direct_df`, ~1e-14 relative, f64
+    output) — the accuracy tier for refinement residuals on hardware whose
+    native f64 is emulated.
     """
+    if impl == "df":
+        from .df_kernels import stokeslet_direct_df
+
+        return stokeslet_direct_df(
+            r_src, r_trg, f_src, eta, block_size=min(block_size, 1024),
+            source_block=source_block or 4096)
     factor = 1.0 / (8.0 * math.pi)
     if impl == "mxu":
         u = _pair_sum(stokeslet_block_mxu, r_trg, (r_src, f_src),
@@ -258,8 +267,15 @@ def stresslet_direct(r_dl, r_trg, f_dl, eta, *, block_size: int = 4096,
     reference's sxx..szz, i.e. ``f_dl[s, i, j] = S_ij``); returns [n_trg, 3].
     ``impl="mxu"`` selects the matmul-form tile (`stresslet_block_mxu`,
     recentered per source block on its first point — see
-    `stokeslet_block_mxu`'s caveat).
+    `stokeslet_block_mxu`'s caveat). ``impl="df"`` evaluates in double-float
+    f32 arithmetic (`df_kernels.stresslet_direct_df`, f64 output).
     """
+    if impl == "df":
+        from .df_kernels import stresslet_direct_df
+
+        return stresslet_direct_df(
+            r_dl, r_trg, f_dl, eta, block_size=min(block_size, 1024),
+            source_block=source_block or 4096)
     factor = 1.0 / (8.0 * math.pi)
     if impl == "mxu":
         u = _pair_sum(stresslet_block_mxu, r_trg, (r_dl, f_dl),
@@ -415,6 +431,23 @@ def stresslet_times_normal_blocked(r, normals, eta, reg=DEFAULT_REG,
 
     M = lax.map(rows, (r_pad.reshape(nb, block_size, 3), row_idx))
     return M.reshape(3 * nb * block_size, 3 * n)[:3 * n]
+
+
+def subtract_singularity_columns(M, sing_vecs, weights):
+    """Second-kind singularity subtraction on a [3n, 3n] interleaved matrix.
+
+    ``M[3i+a, 3i+k] -= e_k[i, a] / w_i`` for the three singularity vectors
+    ``sing_vecs = (ex, ey, ez)`` (each [n, 3]) — the diagonal-block
+    correction of `precompute.py:113-130` / `body_spherical.cpp:168-181`,
+    scattered in 2-D so no [.., n, 3]-shaped intermediate is materialized
+    (XLA tile-pads a trailing dim of 3 to 128: 42x HBM).
+    """
+    n = weights.shape[0]
+    idx = jnp.arange(n)
+    rows = 3 * idx[:, None] + jnp.arange(3)[None, :]  # [n, 3]
+    for k, e in enumerate(sing_vecs):
+        M = M.at[rows, (3 * idx + k)[:, None]].add(-e / weights[:, None])
+    return M
 
 
 @partial(jax.jit, static_argnames=("block_size",))

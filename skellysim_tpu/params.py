@@ -78,8 +78,18 @@ class Params:
     #             with the hot loop at accelerator-native f32
     solver_precision: str = "full"
     # inner (f32) GMRES tolerance per refinement sweep in "mixed" mode;
-    # each sweep contracts the error by about this factor
-    inner_tol: float = 1e-6
+    # each sweep contracts the error by about this factor. A loose inner
+    # tolerance wins: measured at walkthrough scale, 1e-4 converges to
+    # 1e-10 in ~12 total inner iterations vs ~19 at 1e-6 (more sweeps,
+    # but each sweep's Krylov solve is much shorter)
+    inner_tol: float = 1e-4
+    # pairwise-kernel tile for the f64 refinement residual (and prep flows)
+    # in "mixed" mode: "exact" = native f64 (fast on CPU, ~100x slower than
+    # f32 on TPUs, whose f64 is software-emulated), "df" = double-float f32
+    # (`ops.df_kernels`, ~1e-14 relative — far beyond gmres_tol needs),
+    # "auto" = "df" on accelerators, "exact" on CPU. The ring evaluator has
+    # no DF tile; ring runs keep native f64 residuals
+    refine_pair_impl: str = "auto"
     # max refinement sweeps in "mixed" mode
     max_refine: int = 8
     implicit_motor_activation_delay: float = 0.0

@@ -104,9 +104,25 @@ class System:
         # device mesh for the ring pair evaluator (params.pair_evaluator="ring");
         # GSPMD sharding via parallel.shard_state needs no mesh here
         self.mesh = mesh
+        if params.refine_pair_impl not in ("auto", "exact", "df"):
+            raise ValueError(
+                f"unknown refine_pair_impl {params.refine_pair_impl!r}; "
+                "use 'auto', 'exact', or 'df'")
         self._solve_jit = jax.jit(self._solve_impl)
         self._collision_jit = jax.jit(self._check_collision)
         self._vel_jit = jax.jit(self._velocity_at_targets_impl)
+
+    @property
+    def _refine_impl(self) -> str:
+        """Pairwise tile for mixed-mode f64 residual/prep flows (see
+        Params.refine_pair_impl). Resolved lazily from self.params — the
+        codebase's pattern of replacing params post-construction
+        (`system.params = dataclasses.replace(...)`) must not pin a stale
+        tile."""
+        impl = self.params.refine_pair_impl
+        if impl == "auto":
+            return "df" if jax.default_backend() != "cpu" else "exact"
+        return impl
 
     def _ring_active(self) -> bool:
         ring = self.params.pair_evaluator == "ring"
@@ -132,16 +148,21 @@ class System:
         return r_trg, T
 
     def _fiber_flow(self, state: SimState, caches, r_trg, forces,
-                    subtract_self: bool = True):
+                    subtract_self: bool = True, impl: str | None = None):
         """Fiber-source flow through the selected pair evaluator
         (the reference's `params.pair_evaluator` seam,
         `fiber_container_base.cpp:20-33`). The ring path pads the target rows
         to a mesh multiple and rotates fiber-node source blocks around the ICI
-        ring; shell/body target rows ride along in the padded target set."""
+        ring; shell/body target rows ride along in the padded target set.
+        ``impl`` overrides `params.kernel_impl` (the mixed solver's f64
+        residual passes "df"); the ring evaluator has no DF tile, so ring
+        runs fall back to its exact (native-dtype) tile."""
+        if impl is None:
+            impl = self.params.kernel_impl
         if not self._ring_active():
             return fc.flow(state.fibers, caches, r_trg, forces, self.params.eta,
                            subtract_self=subtract_self, evaluator="direct",
-                           impl=self.params.kernel_impl)
+                           impl=impl)
         nfn = state.fibers.n_fibers * state.fibers.n_nodes
         if nfn % self.mesh.size != 0:
             raise ValueError(
@@ -152,21 +173,25 @@ class System:
         r_pad, T = self._ring_pad_targets(r_trg)
         vel = fc.flow(state.fibers, caches, r_pad, forces, self.params.eta,
                       subtract_self=subtract_self, evaluator="ring",
-                      mesh=self.mesh, impl=self.params.kernel_impl)
+                      mesh=self.mesh,
+                      impl="exact" if impl == "df" else impl)
         return vel[:T]
 
-    def _shell_flow(self, state: SimState, r_trg, density):
+    def _shell_flow(self, state: SimState, r_trg, density,
+                    impl: str | None = None):
         """Shell -> target flow through the pair-evaluator seam
         (`include/kernels.hpp:78-122`: one evaluator serves all components).
         The density->f_dl math and source padding live in `peri.flow`; only
         the target padding is System's job."""
+        if impl is None:
+            impl = self.params.kernel_impl
         if not self._ring_active():
             return peri.flow(state.shell, r_trg, density, self.params.eta,
-                             impl=self.params.kernel_impl)
+                             impl=impl)
         r_pad, T = self._ring_pad_targets(r_trg)
         return peri.flow(state.shell, r_pad, density, self.params.eta,
                          evaluator="ring", mesh=self.mesh,
-                         impl=self.params.kernel_impl)[:T]
+                         impl="exact" if impl == "df" else impl)[:T]
 
     # ------------------------------------------------------------- state setup
 
@@ -303,6 +328,12 @@ class System:
         v_all = jnp.zeros_like(r_all)
 
         precond_dtype = (jnp.float32 if p.solver_precision == "mixed" else None)
+        # mixed mode evaluates the (f64) prep flows through the refinement
+        # tile — on accelerators that is double-float f32 (~1e-14, sets the
+        # RHS accuracy floor) instead of the emulated-f64 cliff
+        impl_flow = (self._refine_impl
+                     if p.solver_precision == "mixed"
+                     and state.time.dtype == jnp.float64 else p.kernel_impl)
 
         if fibers is not None:
             caches = fc.update_cache(fibers, state.dt, p.eta)
@@ -313,7 +344,8 @@ class System:
                               fc.generate_constant_force(fibers, caches),
                               jnp.zeros_like(fibers.x))
 
-            v_all = v_all + self._fiber_flow(state, caches, r_all, external)
+            v_all = v_all + self._fiber_flow(state, caches, r_all, external,
+                                             impl=impl_flow)
 
         if state.bodies is not None:
             body_caches = bd.update_cache(state.bodies, p.eta,
@@ -322,7 +354,7 @@ class System:
             # (`system.cpp:430-443`)
             ext_ft = bd.external_forces_torques(state.bodies, state.time)
             v_all = v_all + bd.flow(state.bodies, body_caches, r_all, None,
-                                    ext_ft, p.eta, impl=p.kernel_impl)
+                                    ext_ft, p.eta, impl=impl_flow)
 
         v_all = v_all + self._external_flows(state, r_all)
 
@@ -345,7 +377,7 @@ class System:
     # ------------------------------------------------------- operator closures
 
     def _apply_matvec(self, state: SimState, caches, body_caches, x_flat,
-                      lo=None):
+                      lo=None, flow_impl: str | None = None):
         """Coupled operator A x (`apply_matvec`, `system.cpp:269-324`).
 
         ``lo`` is an optional (state, caches, body_caches) triple whose float
@@ -356,8 +388,13 @@ class System:
         fiber-body link conditions stay in the ``x_flat`` dtype. This is the
         cheap operator `gmres_ir` iterates with; exactness is restored by the
         f64 refinement residuals.
+
+        ``flow_impl`` overrides the pairwise tile for the flows (the mixed
+        solver's f64 residual matvec passes the double-float tile).
         """
         p = self.params
+        if flow_impl is None:
+            flow_impl = p.kernel_impl
         fibers = state.fibers
         shell = state.shell
         bodies = state.bodies
@@ -382,7 +419,8 @@ class System:
             fw = fc.apply_fiber_force(fibers, caches, x_fib)
             v_all = v_all + self._fiber_flow(f_state, f_caches, r_all,
                                              fw.astype(lo_dtype),
-                                             subtract_self=True)
+                                             subtract_self=True,
+                                             impl=flow_impl)
 
         if shell is not None and (fibers is not None or bodies is not None):
             # shell flow is evaluated at fiber and body nodes only; the shell
@@ -390,7 +428,8 @@ class System:
             r_fibbody = jnp.concatenate(
                 [r_all[:nf_nodes], r_all[nf_nodes + ns_nodes:]], axis=0)
             v_shell2fibbody = self._shell_flow(f_state, r_fibbody,
-                                               x_shell.astype(lo_dtype))
+                                               x_shell.astype(lo_dtype),
+                                               impl=flow_impl)
             v_all = v_all.at[:nf_nodes].add(v_shell2fibbody[:nf_nodes])
             v_all = v_all.at[nf_nodes + ns_nodes:].add(v_shell2fibbody[nf_nodes:])
 
@@ -407,7 +446,7 @@ class System:
             v_all = v_all + bd.flow(f_state.bodies, f_bcaches, r_all,
                                     x_bodies.astype(lo_dtype),
                                     body_ft.astype(lo_dtype), p.eta,
-                                    impl=p.kernel_impl)
+                                    impl=flow_impl)
 
         res = []
         if fibers is not None:
@@ -468,8 +507,13 @@ class System:
             # preconditioners) evaluates through f32 copies via the lo seam
             # of _apply_matvec, while stiff fiber-local ops stay f64
             lo = _cast_floats((state, caches, body_caches), jnp.float32)
+            # hi residual flows go through the refinement tile (df on
+            # accelerators); state must be f64 for the df split to pay off
+            hi_impl = (self._refine_impl
+                       if state.time.dtype == jnp.float64 else p.kernel_impl)
             result = gmres_ir(
-                lambda v: self._apply_matvec(state, caches, body_caches, v),
+                lambda v: self._apply_matvec(state, caches, body_caches, v,
+                                             flow_impl=hi_impl),
                 lambda v: self._apply_matvec(state, caches, body_caches, v,
                                              lo=lo),
                 rhs,

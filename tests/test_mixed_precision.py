@@ -150,3 +150,30 @@ def test_f32_solution_quality_vs_f64():
     err = (np.linalg.norm(sols[jnp.float32] - sols[jnp.float64])
            / np.linalg.norm(sols[jnp.float64]))
     assert err < 5e-3, err
+
+
+def test_mixed_df_refinement_matches_exact_refinement():
+    """refine_pair_impl="df" (the accelerator default: double-float f32
+    residual/prep flows) reaches gmres_tol and agrees with native-f64
+    refinement to the DF envelope."""
+    dtype = jnp.float64
+    shell, shape, bodies = make_coupled_parts(192, 96, dtype)
+    t = np.linspace(0, 1, 32)
+    x = np.array([0.0, 3.0, 0.0])[None, :] + t[:, None] * np.array([0.0, 0.0, 1.0])
+    base = Params(eta=1.0, dt_initial=0.1, t_final=1.0, gmres_tol=1e-10,
+                  solver_precision="mixed", adaptive_timestep_flag=False)
+
+    sols = {}
+    for impl in ("exact", "df"):
+        params = dataclasses.replace(base, refine_pair_impl=impl)
+        system = System(params, shell_shape=shape)
+        fibers = fc.make_group(x[None], lengths=1.0, bending_rigidity=0.01,
+                               radius=0.0125, dtype=dtype)
+        state = system.make_state(fibers=fibers, shell=shell, bodies=bodies)
+        _, solution, info = system.step(state)
+        assert bool(info.converged), impl
+        assert float(info.residual_true) <= 1e-10, impl
+        sols[impl] = np.asarray(solution)
+    err = (np.linalg.norm(sols["df"] - sols["exact"])
+           / np.linalg.norm(sols["exact"]))
+    assert err < 1e-9, err
