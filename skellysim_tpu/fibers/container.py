@@ -189,15 +189,17 @@ def weighted_forces(group: FiberGroup, forces) -> jnp.ndarray:
 
 def flow(group: FiberGroup, caches: FiberCaches, r_trg, forces, eta,
          subtract_self: bool = True, evaluator: str = "direct",
-         mesh=None, impl: str = "exact") -> jnp.ndarray:
+         mesh=None, impl: str = "exact", ewald_plan=None,
+         ewald_anchors=None) -> jnp.ndarray:
     """Velocity at targets from all fiber nodes (`flow`, `:172-214`).
 
     ``forces`` is [nf, n, 3]; when ``subtract_self`` the first nf*n targets are
     assumed to be the fiber nodes themselves and each fiber's dense
     self-interaction is subtracted (it is handled by the SBT mobility instead).
     ``evaluator="ring"`` (with a mesh) rotates source blocks around the ICI
-    ring instead of the GSPMD all-gather — the reference's pair_evaluator seam
-    (`fiber_container_base.cpp:20-33`).
+    ring instead of the GSPMD all-gather; ``evaluator="ewald"`` (with an
+    `ops.ewald.EwaldPlan`) sums in O(N log N) — the reference's
+    pair_evaluator seam (`fiber_container_base.cpp:20-33`).
     """
     wf = weighted_forces(group, forces)
     if evaluator == "ring" and mesh is not None:
@@ -205,6 +207,29 @@ def flow(group: FiberGroup, caches: FiberCaches, r_trg, forces, eta,
 
         vel = ring_stokeslet(node_positions(group), r_trg, wf.reshape(-1, 3),
                              eta, mesh=mesh, impl=impl)
+    elif evaluator == "ewald" and ewald_plan is not None:
+        from ..ops import ewald as ew
+
+        if ewald_anchors is None:
+            ewald_anchors = ew.plan_anchors(ewald_plan, r_trg.dtype)
+            ewald_plan = ew.strip_anchors(ewald_plan)
+        pos = node_positions(group)
+        # inactive slots replicate slot 0 (`grow_capacity`), which would
+        # pile their nodes into one cell and blow up the plan's bucket
+        # capacity; spread them over the cell region instead — their
+        # weighted forces are zero, so only occupancy changes. The plan
+        # reserved room for them (`plan_ewald(n_fill=...)`).
+        act = jnp.repeat(group.active, group.n_nodes)
+        fills = ew.fill_positions(ewald_plan, ewald_anchors[1],
+                                  pos.shape[0], pos.dtype)
+        pos = jnp.where(act[:, None], pos, fills)
+        n_self = group.n_fibers * group.n_nodes if subtract_self else 0
+        if n_self:
+            # the leading targets are the fiber nodes: keep them consistent
+            # with the (spread) source positions so self pairs stay exact
+            r_trg = jnp.concatenate([pos, r_trg[n_self:]], axis=0)
+        vel = ew._stokeslet_ewald_impl(ewald_plan, ewald_anchors, pos, r_trg,
+                                       wf.reshape(-1, 3), n_self)
     else:
         vel = kernels.stokeslet_direct(node_positions(group), r_trg,
                                        wf.reshape(-1, 3), eta, impl=impl)

@@ -60,8 +60,16 @@ class Params:
     # ("CPU"/"GPU"/"FMM", `include/params.hpp:50`): "direct" = dense blocked
     # kernels (GSPMD inserts all-gathers on a mesh); "ring" = source blocks
     # rotate the ICI ring via collective-permute (free-space fiber systems on
-    # a mesh; falls back to direct when a shell/bodies are present)
+    # a mesh; falls back to direct when a shell/bodies are present); "ewald" =
+    # O(N log N) spectral Ewald (`ops.ewald` — the slot the reference fills
+    # with STKFMM) for the fiber Stokeslet flows, re-planned host-side each
+    # step like the reference's FMM tree rebuild
     pair_evaluator: str = "direct"
+    # target relative accuracy of the Ewald evaluator; in "mixed" solver
+    # precision the Ewald path serves only the f32 Krylov interior (the f64
+    # refinement residual stays on the dense double-float tile), so 1e-6
+    # does not cap the converged residual
+    ewald_tol: float = 1e-6
     # pairwise-kernel tile implementation: "exact" (displacement-tensor form,
     # the reference's semantics bit-for-bit) or "mxu" (matmul form — the
     # O(N^2*3) contractions ride the MXU; see kernels.stokeslet_block_mxu's

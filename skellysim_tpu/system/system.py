@@ -91,10 +91,10 @@ class System:
 
     def __init__(self, params: Params, shell_shape: PeripheryShape | None = None,
                  mesh=None):
-        if params.pair_evaluator not in ("direct", "ring"):
+        if params.pair_evaluator not in ("direct", "ring", "ewald"):
             raise ValueError(
                 f"unknown pair_evaluator {params.pair_evaluator!r}; "
-                "runtime values are 'direct' or 'ring'")
+                "runtime values are 'direct', 'ring', or 'ewald'")
         if params.solver_precision not in ("full", "mixed"):
             raise ValueError(
                 f"unknown solver_precision {params.solver_precision!r}; "
@@ -108,9 +108,11 @@ class System:
             raise ValueError(
                 f"unknown refine_pair_impl {params.refine_pair_impl!r}; "
                 "use 'auto', 'exact', or 'df'")
-        self._solve_jit = jax.jit(self._solve_impl)
+        self._solve_jit = jax.jit(self._solve_impl,
+                                  static_argnames=("ewald_plan",))
         self._collision_jit = jax.jit(self._check_collision)
-        self._vel_jit = jax.jit(self._velocity_at_targets_impl)
+        self._vel_jit = jax.jit(self._velocity_at_targets_impl,
+                                static_argnames=("ewald_plan",))
 
     @property
     def _refine_impl(self) -> str:
@@ -148,7 +150,8 @@ class System:
         return r_trg, T
 
     def _fiber_flow(self, state: SimState, caches, r_trg, forces,
-                    subtract_self: bool = True, impl: str | None = None):
+                    subtract_self: bool = True, impl: str | None = None,
+                    ewald_plan=None, ewald_anchors=None):
         """Fiber-source flow through the selected pair evaluator
         (the reference's `params.pair_evaluator` seam,
         `fiber_container_base.cpp:20-33`). The ring path pads the target rows
@@ -159,6 +162,14 @@ class System:
         runs fall back to its exact (native-dtype) tile."""
         if impl is None:
             impl = self.params.kernel_impl
+        if ewald_plan is not None and impl != "df":
+            # the O(N log N) evaluator serves the fast tiers; "df" flows (the
+            # mixed solver's f64 residual/prep) stay dense — the Ewald
+            # tolerance must not cap the refined residual
+            return fc.flow(state.fibers, caches, r_trg, forces,
+                           self.params.eta, subtract_self=subtract_self,
+                           evaluator="ewald", ewald_plan=ewald_plan,
+                           ewald_anchors=ewald_anchors)
         if not self._ring_active():
             return fc.flow(state.fibers, caches, r_trg, forces, self.params.eta,
                            subtract_self=subtract_self, evaluator="direct",
@@ -311,7 +322,8 @@ class System:
 
     # ------------------------------------------------------------------- prep
 
-    def _prep(self, state: SimState):
+    def _prep(self, state: SimState, ewald_plan=None,
+              ewald_anchors=None):
         """All velocities/forces/RHS/BC assembly (`prep_state_for_solver`,
         `system.cpp:398-458`). Returns (state, fiber caches, body caches,
         shell RHS, body RHS)."""
@@ -345,7 +357,9 @@ class System:
                               jnp.zeros_like(fibers.x))
 
             v_all = v_all + self._fiber_flow(state, caches, r_all, external,
-                                             impl=impl_flow)
+                                             impl=impl_flow,
+                                             ewald_plan=ewald_plan,
+                                             ewald_anchors=ewald_anchors)
 
         if state.bodies is not None:
             body_caches = bd.update_cache(state.bodies, p.eta,
@@ -377,7 +391,8 @@ class System:
     # ------------------------------------------------------- operator closures
 
     def _apply_matvec(self, state: SimState, caches, body_caches, x_flat,
-                      lo=None, flow_impl: str | None = None):
+                      lo=None, flow_impl: str | None = None, ewald_plan=None,
+                      ewald_anchors=None):
         """Coupled operator A x (`apply_matvec`, `system.cpp:269-324`).
 
         ``lo`` is an optional (state, caches, body_caches) triple whose float
@@ -420,7 +435,9 @@ class System:
             v_all = v_all + self._fiber_flow(f_state, f_caches, r_all,
                                              fw.astype(lo_dtype),
                                              subtract_self=True,
-                                             impl=flow_impl)
+                                             impl=flow_impl,
+                                             ewald_plan=ewald_plan,
+                                             ewald_anchors=ewald_anchors)
 
         if shell is not None and (fibers is not None or bodies is not None):
             # shell flow is evaluated at fiber and body nodes only; the shell
@@ -486,9 +503,11 @@ class System:
 
     # ------------------------------------------------------------------- solve
 
-    def _solve_impl(self, state: SimState):
+    def _solve_impl(self, state: SimState, ewald_plan=None,
+                    ewald_anchors=None):
         p = self.params
-        state, caches, body_caches, shell_rhs, body_rhs = self._prep(state)
+        state, caches, body_caches, shell_rhs, body_rhs = self._prep(
+            state, ewald_plan=ewald_plan, ewald_anchors=ewald_anchors)
 
         rhs_parts = []
         if caches is not None:
@@ -513,9 +532,12 @@ class System:
                        if state.time.dtype == jnp.float64 else p.kernel_impl)
             result = gmres_ir(
                 lambda v: self._apply_matvec(state, caches, body_caches, v,
-                                             flow_impl=hi_impl),
+                                             flow_impl=hi_impl,
+                                             ewald_plan=ewald_plan,
+                                             ewald_anchors=ewald_anchors),
                 lambda v: self._apply_matvec(state, caches, body_caches, v,
-                                             lo=lo),
+                                             lo=lo, ewald_plan=ewald_plan,
+                                             ewald_anchors=ewald_anchors),
                 rhs,
                 precond_lo=lambda v: self._apply_precond(lo[0], lo[1], lo[2], v),
                 tol=p.gmres_tol, inner_tol=p.inner_tol,
@@ -523,7 +545,10 @@ class System:
                 max_refine=p.max_refine)
         else:
             result = gmres(
-                lambda v: self._apply_matvec(state, caches, body_caches, v), rhs,
+                lambda v: self._apply_matvec(state, caches, body_caches, v,
+                                             ewald_plan=ewald_plan,
+                                             ewald_anchors=ewald_anchors),
+                rhs,
                 precond=lambda v: self._apply_precond(state, caches, body_caches, v),
                 tol=p.gmres_tol, restart=p.gmres_restart, maxiter=p.gmres_maxiter)
 
@@ -561,7 +586,8 @@ class System:
 
     # -------------------------------------------------------- velocity field
 
-    def _velocity_at_targets_impl(self, state: SimState, solution, r_trg):
+    def _velocity_at_targets_impl(self, state: SimState, solution, r_trg,
+                                  ewald_plan=None, ewald_anchors=None):
         """Velocity field at arbitrary targets from a solved state
         (`velocity_at_targets`, `system.cpp:330-384`).
 
@@ -590,9 +616,11 @@ class System:
             if p.periphery_interaction_flag and shell is not None:
                 f_on_fibers = f_on_fibers + self._periphery_force_fibers(state)
             # through the pair-evaluator seam so listener-mode evaluator
-            # switches (direct/ring) genuinely change the computation
+            # switches (direct/ring/ewald) genuinely change the computation
             v = v + self._fiber_flow(state, caches, r_trg, f_on_fibers,
-                                     subtract_self=False)
+                                     subtract_self=False,
+                                     ewald_plan=ewald_plan,
+                                     ewald_anchors=ewald_anchors)
 
         if bodies is not None:
             nb = bodies.n_bodies
@@ -630,8 +658,12 @@ class System:
         return v
 
     def velocity_at_targets(self, state: SimState, solution, r_trg):
-        """Jitted velocity field evaluation at [n, 3] targets."""
-        return self._vel_jit(state, solution, r_trg)
+        """Jitted velocity field evaluation at [n, 3] targets; the ewald
+        evaluator (when configured) plans over nodes + targets so off-node
+        probes stay inside the cell region."""
+        plan, anchors = self._ewald_args(state, extra_targets=r_trg)
+        return self._vel_jit(state, solution, r_trg, ewald_plan=plan,
+                             ewald_anchors=anchors)
 
     def _check_collision(self, state: SimState):
         """Fiber/shell + body collision gate (`check_collision`, `system.cpp:576-595`)."""
@@ -656,10 +688,52 @@ class System:
 
     # -------------------------------------------------------------- public API
 
+    def make_ewald_plan(self, state: SimState, extra_targets=None):
+        """Host-side Ewald plan over every ACTIVE hydrodynamic node — the
+        analogue of the reference's per-step FMM tree rebuild
+        (`kernels.hpp:78-122`). Quantized planning (`ops.ewald.plan_ewald`)
+        keeps the plan — and so the compiled solve — stable while the
+        geometry drifts. Inactive fiber slots (dynamic-instability padding,
+        which replicate slot 0's coordinates) are excluded from the bounding
+        box and reserved as spread `n_fill` capacity instead — clustered
+        padding would otherwise blow up the per-cell bucket size.
+        ``extra_targets`` extends the box to off-node evaluation points
+        (velocity fields)."""
+        from ..ops.ewald import plan_ewald
+
+        import numpy as _np
+
+        n_fill = 0
+        parts = []
+        if state.fibers is not None:
+            act = _np.asarray(state.fibers.active)
+            x = _np.asarray(state.fibers.x)
+            parts.append(x[act].reshape(-1, 3))
+            n_fill = int((~act).sum()) * state.fibers.n_nodes
+        if state.shell is not None:
+            parts.append(_np.asarray(state.shell.nodes))
+        if state.bodies is not None:
+            parts.append(_np.asarray(bd.place(state.bodies)[0]).reshape(-1, 3))
+        if extra_targets is not None:
+            parts.append(_np.asarray(extra_targets).reshape(-1, 3))
+        pts = _np.concatenate(parts, axis=0)
+        return plan_ewald(pts, eta=self.params.eta,
+                          tol=self.params.ewald_tol, n_fill=n_fill)
+
+    def _ewald_args(self, state: SimState, extra_targets=None):
+        """(stripped static plan, traced anchors) or (None, None)."""
+        if self.params.pair_evaluator != "ewald":
+            return None, None
+        from ..ops.ewald import plan_anchors, strip_anchors
+
+        plan = self.make_ewald_plan(state, extra_targets=extra_targets)
+        return strip_anchors(plan), plan_anchors(plan)
+
     def step(self, state: SimState):
         """One trial step at state.dt: solve + advance components (`step`,
         `system.cpp:482-492`). Returns (new_state, solution, info)."""
-        return self._solve_jit(state)
+        plan, anchors = self._ewald_args(state)
+        return self._solve_jit(state, ewald_plan=plan, ewald_anchors=anchors)
 
     def run(self, state: SimState, *, writer=None, max_steps: int | None = None,
             rng=None, metrics_path: str | None = None,
