@@ -525,7 +525,8 @@ def _bench_640k_matvec(n_fibers, n_nodes, dtype, trials=2):
                 "first_call_s": round(t_first, 1),
                 "rel_err_vs_dense": float(err),
                 "speedup_vs_dense": round(wall / max(t_steady, 1e-9), 1),
-                "grid_M": plan.M, "cells": plan.cells,
+                "grid_M": plan.M, "cells": plan.cells3,
+                "near_mode": plan.near_mode, "K": plan.K,
                 "max_occ": plan.max_occ, "P": plan.P,
                 "xi": round(plan.xi, 3)}
         except Exception as e:

@@ -230,6 +230,9 @@ def flow(group: FiberGroup, caches: FiberCaches, r_trg, forces, eta,
             r_trg = jnp.concatenate([pos, r_trg[n_self:]], axis=0)
         vel = ew._stokeslet_ewald_impl(ewald_plan, ewald_anchors, pos, r_trg,
                                        wf.reshape(-1, 3), n_self)
+        # the kernel scales as 1/eta and the plan baked plan.eta in; honor
+        # this call's eta like the direct/ring branches do
+        vel = vel * (ewald_plan.eta / eta)
     else:
         vel = kernels.stokeslet_direct(node_positions(group), r_trg,
                                        wf.reshape(-1, 3), eta, impl=impl)

@@ -704,12 +704,14 @@ class System:
         import numpy as _np
 
         n_fill = 0
+        n_src = 0
         parts = []
         if state.fibers is not None:
             act = _np.asarray(state.fibers.active)
             x = _np.asarray(state.fibers.x)
             parts.append(x[act].reshape(-1, 3))
             n_fill = int((~act).sum()) * state.fibers.n_nodes
+            n_src = parts[0].shape[0]
         if state.shell is not None:
             parts.append(_np.asarray(state.shell.nodes))
         if state.bodies is not None:
@@ -718,7 +720,8 @@ class System:
             parts.append(_np.asarray(extra_targets).reshape(-1, 3))
         pts = _np.concatenate(parts, axis=0)
         return plan_ewald(pts, eta=self.params.eta,
-                          tol=self.params.ewald_tol, n_fill=n_fill)
+                          tol=self.params.ewald_tol, n_fill=n_fill,
+                          n_src=n_src)
 
     def _ewald_args(self, state: SimState, extra_targets=None):
         """(stripped static plan, traced anchors) or (None, None)."""

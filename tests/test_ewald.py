@@ -277,3 +277,36 @@ def test_ewald_anchor_hop_reuses_compiled_program():
     # translation invariance of the physics
     np.testing.assert_allclose(np.asarray(u2), np.asarray(u1),
                                rtol=0, atol=1e-8)
+
+
+def test_block_sparse_near_field_on_fiber_cloud():
+    """Line-clustered clouds auto-select the block-sparse near field
+    (no occupancy padding waste); it agrees with the cells mode and the
+    dense oracle."""
+    import dataclasses
+
+    rng = np.random.default_rng(43)
+    nf, nn = 60, 64
+    origins = rng.uniform(-5, 5, (nf, 3))
+    dirs = rng.normal(size=(nf, 3))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    t = np.linspace(0, 1, nn)
+    pts = jnp.asarray((origins[:, None, :]
+                       + t[None, :, None] * dirs[:, None, :]).reshape(-1, 3))
+    f = jnp.asarray(rng.standard_normal((len(pts), 3)))
+
+    plan = ewald.plan_ewald(np.asarray(pts), eta=1.0, tol=1e-5)
+    assert plan.near_mode == "blocks", (plan.near_mode, plan.max_occ)
+    assert plan.K >= 8
+    u = np.asarray(ewald.stokeslet_ewald(plan, pts, pts, f))
+
+    sub = rng.choice(len(pts), 256, replace=False)
+    ref = np.asarray(kernels.stokeslet_direct(
+        pts, jnp.asarray(np.asarray(pts)[sub]), f, 1.0))
+    rel = np.linalg.norm(u[sub] - ref) / np.linalg.norm(ref)
+    assert rel < 1e-4, rel
+
+    plan_c = dataclasses.replace(plan, near_mode="cells")
+    uc = np.asarray(ewald.stokeslet_ewald(plan_c, pts, pts, f))
+    agree = np.abs(u - uc).max()
+    assert agree < 1e-5, agree
