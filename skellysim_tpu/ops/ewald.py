@@ -528,8 +528,7 @@ def _near_field_blocks(plan: EwaldPlan, r_src, f_src, r_trg):
     d2 = jnp.sum(gap * gap, axis=-1)                  # [TB, SB]
     _, sidx = lax.top_k(-d2, K)                       # [TB, K] nearest blocks
 
-    def per_tblock(args):
-        t_pts, idx = args
+    def per_tblock(t_pts, idx):
         s_pts = sp[idx].reshape(K * B, 3)
         s_f = sf[idx].reshape(K * B, 3)
         return stokeslet_near_block(t_pts, s_pts, s_f, plan.xi)
@@ -542,7 +541,7 @@ def _near_field_blocks(plan: EwaldPlan, r_src, f_src, r_trg):
         widths = ((0, pad_c),) + ((0, 0),) * (a.ndim - 1)
         return jnp.pad(a, widths).reshape((n_chunks, chunk) + a.shape[1:])
 
-    u = lax.map(lambda args: jax.vmap(lambda t, i: per_tblock((t, i)))(*args),
+    u = lax.map(lambda args: jax.vmap(per_tblock)(*args),
                 (padded(tp), padded(sidx)))
     u = u.reshape(-1, 3)[:n_t]
     return u / (8.0 * math.pi * plan.eta)
@@ -584,21 +583,55 @@ def _window_indices(plan: EwaldPlan, pts_local, dtype):
     return flat, w3
 
 
+#: elements per gridding chunk — the [chunk, P^3] index/weight/value
+#: intermediates would otherwise reach several GB at BASELINE point counts
+_GRID_CHUNK_BUDGET = 16_000_000
+
+
+def _point_chunks(plan: EwaldPlan, n):
+    P3 = plan.P ** 3
+    chunk = max(1, min(n, _GRID_CHUNK_BUDGET // P3))
+    return chunk, -(-n // chunk)
+
+
 def _spread(plan: EwaldPlan, pts_local, values, dtype):
-    """Type-1 gridding: scatter values [N, 3] onto the [M, M, M, 3] grid."""
+    """Type-1 gridding: scatter values [N, 3] onto the [M, M, M, 3] grid,
+    in point chunks so the [chunk, P, P, P] intermediates stay bounded."""
     M = plan.M
-    flat, w3 = _window_indices(plan, pts_local, dtype)
-    grid = jnp.zeros((M * M * M, 3), dtype=dtype)
-    contrib = w3[..., None] * values[:, None, None, None, :]
-    grid = grid.at[flat.reshape(-1)].add(contrib.reshape(-1, 3))
+    n = pts_local.shape[0]
+    chunk, n_chunks = _point_chunks(plan, n)
+    pad = n_chunks * chunk - n
+    # padded points spread zero values: harmless wherever they land
+    pts_p = jnp.pad(pts_local, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 3)
+    val_p = jnp.pad(values, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 3)
+
+    def body(grid, args):
+        pts_c, val_c = args
+        flat, w3 = _window_indices(plan, pts_c, dtype)
+        contrib = w3[..., None] * val_c[:, None, None, None, :]
+        return grid.at[flat.reshape(-1)].add(contrib.reshape(-1, 3)), None
+
+    grid, _ = lax.scan(body, jnp.zeros((M * M * M, 3), dtype=dtype),
+                       (pts_p, val_p))
     return grid.reshape(M, M, M, 3)
 
 
 def _interp(plan: EwaldPlan, pts_local, grid, dtype):
-    """Type-2 interpolation: gather grid [M, M, M, 3] at points [N, 3]."""
-    flat, w3 = _window_indices(plan, pts_local, dtype)
-    vals = grid.reshape(-1, 3)[flat.reshape(-1)].reshape(flat.shape + (3,))
-    return jnp.einsum("npqr,npqrk->nk", w3, vals)
+    """Type-2 interpolation: gather grid [M, M, M, 3] at points [N, 3],
+    chunked like `_spread`."""
+    n = pts_local.shape[0]
+    chunk, n_chunks = _point_chunks(plan, n)
+    pad = n_chunks * chunk - n
+    pts_p = jnp.pad(pts_local, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 3)
+    flat_grid = grid.reshape(-1, 3)
+
+    def body(pts_c):
+        flat, w3 = _window_indices(plan, pts_c, dtype)
+        vals = flat_grid[flat.reshape(-1)].reshape(flat.shape + (3,))
+        return jnp.einsum("npqr,npqrk->nk", w3, vals)
+
+    out = lax.map(body, pts_p)
+    return out.reshape(n_chunks * chunk, 3)[:n]
 
 
 def _far_field(plan: EwaldPlan, lo, r_src, f_src, r_trg):
@@ -649,7 +682,13 @@ def _stokeslet_ewald_impl(plan: EwaldPlan, anchors, r_src, r_trg, f_src,
     ``anchors`` is the [2, 3] (box_lo, cell_lo) traced operand."""
     lo_box = anchors[0].astype(r_src.dtype)
     lo_cell = anchors[1].astype(r_src.dtype)
-    if plan.near_mode == "blocks":
+    # blocks mode is only partition-safe when the runtime target array leads
+    # with the sources (the solve layout the plan measured K against);
+    # disjoint probe sets (n_self == 0) re-blockify from their own offset,
+    # where a straddling block can out-count plan.K and top_k silently
+    # drops within-rc pairs — those calls take the cells path, whose
+    # capacity was measured on the full planning cloud (probes included)
+    if plan.near_mode == "blocks" and n_self == r_src.shape[0]:
         u_near = _near_field_blocks(plan, r_src, f_src, r_trg)
     else:
         u_near = _near_field(plan, lo_cell, r_src, f_src, r_trg)
