@@ -180,6 +180,13 @@ class Params:
     adaptive_timestep_flag: bool = True
     pair_evaluator: str = "TPU"
     fiber_type: str = "FiniteDifference"
+    # TPU-specific extensions (no reference analogue; see runtime Params):
+    # solver precision tier, Ewald evaluator tolerance, pairwise tile, and
+    # the mixed solver's refinement tile
+    solver_precision: str = "full"
+    ewald_tol: float = 1e-6
+    kernel_impl: str = "exact"
+    refine_pair_impl: str = "auto"
 
 
 @dataclass
@@ -567,9 +574,16 @@ def to_runtime_params(p: Params) -> runtime_params.Params:
         seed=p.seed,
         implicit_motor_activation_delay=p.implicit_motor_activation_delay,
         periphery_interaction_flag=p.periphery_interaction_flag,
-        # reference evaluator names (CPU/GPU/FMM/TPU) all map to the dense
-        # direct path; "ring" opts into the collective-permute ring kernels
-        pair_evaluator="ring" if p.pair_evaluator.lower() == "ring" else "direct",
+        # reference evaluator names: "FMM" (the reference's fast evaluator)
+        # maps to the spectral-Ewald fast path, "ring" opts into the
+        # collective-permute ring kernels, CPU/GPU/TPU map to dense direct
+        pair_evaluator={"ring": "ring", "ewald": "ewald",
+                        "fmm": "ewald"}.get(p.pair_evaluator.lower(),
+                                            "direct"),
+        solver_precision=p.solver_precision,
+        ewald_tol=p.ewald_tol,
+        kernel_impl=p.kernel_impl,
+        refine_pair_impl=p.refine_pair_impl,
         dynamic_instability=runtime_params.DynamicInstability(
             **dataclasses.asdict(p.dynamic_instability)),
         periphery_binding=runtime_params.PeripheryBinding(

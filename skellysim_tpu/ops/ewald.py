@@ -371,8 +371,11 @@ def plan_ewald(points, eta, tol=1e-6, max_grid=448, target_occ=32.0,
 
         # K measured with the RUNTIME partitions: source blocks over the
         # leading n_src points (the fiber nodes `stokeslet_ewald` will see),
-        # target blocks over the full cloud (solve targets are its leading
-        # run; probe-target partitions are sub-bboxes, hence fewer matches)
+        # target blocks over the full cloud — valid ONLY for target arrays
+        # that lead with the sources (the solve layout). Disjoint probe
+        # sets re-blockify from their own offset and can out-count K
+        # (straddling blocks); `_stokeslet_ewald_impl` routes those calls
+        # to the cells path, so do NOT weaken its n_self gate.
         s_lo, s_hi = bboxes(pts[:n_src_eff])
         t_lo, t_hi = bboxes(pts)
         gap = np.maximum(0.0, np.maximum(s_lo[None] - t_hi[:, None],

@@ -282,3 +282,27 @@ def test_param_tools_sample_to_arc_closed_curve():
     xs, ts = param_tools.sample_to_arc(np.array([1.0, 4.0]), circle,
                                        precision=4000)
     np.testing.assert_allclose(ts, [1.0, 4.0], rtol=1e-4)
+
+
+def test_fmm_evaluator_name_maps_to_ewald(tmp_path):
+    """The reference's "FMM" evaluator name selects the spectral-Ewald fast
+    path; TPU-specific extension fields round-trip through TOML."""
+    from skellysim_tpu.config import schema
+
+    cfg = schema.Config()
+    cfg.params.pair_evaluator = "FMM"
+    cfg.params.solver_precision = "mixed"
+    cfg.params.ewald_tol = 1e-7
+    path = tmp_path / "skelly_config.toml"
+    cfg.save(str(path))
+    p = schema.load_config(str(path)).params
+    assert p.solver_precision == "mixed"
+    assert p.ewald_tol == 1e-7
+    rt = schema.to_runtime_params(p)
+    assert rt.pair_evaluator == "ewald"
+    assert rt.solver_precision == "mixed"
+    assert rt.ewald_tol == 1e-7
+    rt2 = schema.to_runtime_params(schema.Params(pair_evaluator="ewald"))
+    assert rt2.pair_evaluator == "ewald"
+    rt3 = schema.to_runtime_params(schema.Params(pair_evaluator="CPU"))
+    assert rt3.pair_evaluator == "direct"
