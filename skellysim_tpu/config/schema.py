@@ -559,6 +559,20 @@ def load_config(path: str):
     return cfg
 
 
+_EVALUATOR_NAMES = {"cpu": "direct", "gpu": "direct", "tpu": "direct",
+                    "direct": "direct", "ring": "ring", "fmm": "ewald",
+                    "ewald": "ewald"}
+
+
+def _runtime_evaluator(name: str) -> str:
+    try:
+        return _EVALUATOR_NAMES[name.strip().lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown pair_evaluator {name!r}; valid names: "
+            + ", ".join(sorted(_EVALUATOR_NAMES))) from None
+
+
 def to_runtime_params(p: Params) -> runtime_params.Params:
     """Schema-level Params → runtime (jit-static) Params."""
     return runtime_params.Params(
@@ -576,10 +590,9 @@ def to_runtime_params(p: Params) -> runtime_params.Params:
         periphery_interaction_flag=p.periphery_interaction_flag,
         # reference evaluator names: "FMM" (the reference's fast evaluator)
         # maps to the spectral-Ewald fast path, "ring" opts into the
-        # collective-permute ring kernels, CPU/GPU/TPU map to dense direct
-        pair_evaluator={"ring": "ring", "ewald": "ewald",
-                        "fmm": "ewald"}.get(p.pair_evaluator.lower(),
-                                            "direct"),
+        # collective-permute ring kernels, CPU/GPU/TPU map to dense direct;
+        # anything else is a typo the user must see, not a silent fallback
+        pair_evaluator=_runtime_evaluator(p.pair_evaluator),
         solver_precision=p.solver_precision,
         ewald_tol=p.ewald_tol,
         kernel_impl=p.kernel_impl,

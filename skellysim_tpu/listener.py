@@ -10,11 +10,16 @@ to stdout. A zero-length message terminates the server.
 
 The `evaluator` field selected CPU/GPU/FMM backends in the reference
 (`listener.cpp:117`, `System::set_evaluator`, `system.cpp:389-393`); it maps
-onto our pair-evaluator seam: "CPU"/"GPU" -> "direct" (dense XLA kernels —
-the device is whatever backend JAX runs on), "FMM" -> "ring" (the distributed
-source-block rotation, the structural analogue of the reference's only
-multi-rank evaluator). Our native names are also accepted. An invalid
-frame_no answers with a zero-length response like the reference
+onto our pair-evaluator seam (case-insensitive): "FMM" -> "ewald" (the
+spectral-Ewald fast evaluator filling the reference's FMM slot),
+"CPU"/"GPU" -> "direct" (dense XLA kernels — the device is whatever backend
+JAX runs on); our native names ("direct"/"ring"/"ewald") are also accepted.
+Scope: the switch accelerates `velocity_field` requests (which plan over
+nodes + targets); streamline/vortex-line INTEGRATION deliberately stays on
+the dense evaluator — integrator points roam outside any pre-built plan's
+cell/FFT region, where the gridded far field would wrap periodically, and
+the plan cannot be rebuilt inside the integrator's jit. An invalid frame_no
+answers with a zero-length response like the reference
 (`listener.cpp:111-116`).
 """
 
@@ -38,8 +43,10 @@ _LINE_DEFAULTS = dict(dt_init=0.1, t_final=1.0, abs_err=1e-10, rel_err=1e-6,
                       back_integrate=True)
 
 #: reference evaluator names (`listener.cpp:117`) -> runtime pair evaluators
-EVALUATOR_MAP = {"CPU": "direct", "GPU": "direct", "FMM": "ring",
-                 "direct": "direct", "ring": "ring"}
+#: lowercase reference/native names -> runtime pair evaluators (lookup is
+#: case-insensitive, matching the TOML mapping in `config.schema`)
+EVALUATOR_MAP = {"cpu": "direct", "gpu": "direct", "fmm": "ewald",
+                 "direct": "direct", "ring": "ring", "ewald": "ewald"}
 
 
 def switch_evaluator(system, evaluator: str | None):
@@ -49,7 +56,7 @@ def switch_evaluator(system, evaluator: str | None):
     over the local devices when the System has none — without one the ring
     path would silently fall back to direct, making the switch a
     cache-discarding no-op."""
-    ev = EVALUATOR_MAP.get(evaluator) if evaluator else None
+    ev = EVALUATOR_MAP.get(evaluator.lower()) if evaluator else None
     if ev is None or ev == system.params.pair_evaluator:
         return system, False
     from .system import System

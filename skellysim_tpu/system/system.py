@@ -616,7 +616,9 @@ class System:
             if p.periphery_interaction_flag and shell is not None:
                 f_on_fibers = f_on_fibers + self._periphery_force_fibers(state)
             # through the pair-evaluator seam so listener-mode evaluator
-            # switches (direct/ring/ewald) genuinely change the computation
+            # switches genuinely change the computation (ewald engages when
+            # the caller supplies a plan — velocity_at_targets does;
+            # streamline integrators stay dense by design)
             v = v + self._fiber_flow(state, caches, r_trg, f_on_fibers,
                                      subtract_self=False,
                                      ewald_plan=ewald_plan,

@@ -174,7 +174,7 @@ def test_builder_rejects_mixed_resolution(tmp_path):
 
 def test_listener_evaluator_mapping():
     """Reference evaluator names map onto the pair-evaluator seam
-    (`listener.cpp:117` -> direct/ring)."""
+    (`listener.cpp:117` -> direct/ring/ewald)."""
     from skellysim_tpu.listener import switch_evaluator
     from skellysim_tpu.params import Params
     from skellysim_tpu.system import System
@@ -184,7 +184,9 @@ def test_listener_evaluator_mapping():
         s2, switched = switch_evaluator(system, name)
         assert not switched and s2 is system, name
     s2, switched = switch_evaluator(system, "FMM")
-    assert switched and s2.params.pair_evaluator == "ring"
+    assert switched and s2.params.pair_evaluator == "ewald"
+    s2r, switched = switch_evaluator(system, "ring")
+    assert switched and s2r.params.pair_evaluator == "ring"
     # and back
     s3, switched = switch_evaluator(s2, "CPU")
     assert switched and s3.params.pair_evaluator == "direct"

@@ -306,3 +306,5 @@ def test_fmm_evaluator_name_maps_to_ewald(tmp_path):
     assert rt2.pair_evaluator == "ewald"
     rt3 = schema.to_runtime_params(schema.Params(pair_evaluator="CPU"))
     assert rt3.pair_evaluator == "direct"
+    with pytest.raises(ValueError, match="unknown pair_evaluator"):
+        schema.to_runtime_params(schema.Params(pair_evaluator="spectral"))
