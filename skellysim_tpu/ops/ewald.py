@@ -56,7 +56,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["EwaldPlan", "plan_ewald", "stokeslet_ewald", "strip_anchors",
+__all__ = ["EwaldPlan", "plan_ewald", "stokeslet_ewald",
+           "stresslet_ewald", "strip_anchors",
            "plan_anchors", "fill_positions", "stokeslet_near_block",
            "g_far_pair", "bhat_far_trunc"]
 
@@ -114,6 +115,53 @@ def stokeslet_near_block(trg, src, f_src, xi):
     u = jnp.einsum("ts,sk->tk", a - c, f_src) \
         + jnp.einsum("ts,tsk->tk", df * (b + c * rinv * rinv), d)
     return u
+
+
+def stresslet_near_block_ewald(trg, src, S, xi):
+    """Unscaled stresslet near-field partial sum of one block pair.
+
+    From the screened-biharmonic split (multiply by 1/(8 pi eta) outside):
+    with phi = B_far, a = (phi'' - phi'/r)/r, c3 = phi''' - 3a,
+    e = (phi''' + 2 phi''/r - 2 phi'/r^2)/2, the FAR kernel is
+      u_far_i = -[ c3 (rh.S.rh) rh_i + (a - e)(((S + S^T) rh)_i
+                   + tr(S) rh_i) ]
+    and the near kernel is the exact stresslet minus it:
+      u_near_i = -[ (3/r^2 - c3)(rh.S.rh) rh_i - (a - e)(...) ].
+    phi' = erf(xi r), phi'' = g e^{-(xi r)^2} (g = 2 xi/sqrt(pi)),
+    phi''' = -2 xi^2 r g e^{-(xi r)^2}. All coefficients decay like
+    e^{-(xi r)^2} net of the exact kernel, and every one vanishes at r = 0
+    (B_far is smooth and even), so there is no self term. Coincident pairs
+    masked like `kernels.stresslet_block`.
+    """
+    g = 2.0 * xi / _SQRT_PI
+    d = trg[:, None, :] - src[None, :, :]
+    r2 = jnp.sum(d * d, axis=-1)
+    mask = r2 > 0.0
+    r2s = jnp.where(mask, r2, 1.0)
+    rinv = jnp.where(mask, lax.rsqrt(r2s), 0.0)
+    r = r2 * rinv
+    rinv2 = rinv * rinv
+    expf = jnp.exp(-(xi * r) ** 2) * jnp.where(mask, 1.0, 0.0)
+    erf_r = jax.scipy.special.erf(xi * r)
+    p1 = erf_r * rinv                  # phi'/r (0 at masked pairs via rinv)
+    p2 = g * expf                      # phi''
+    p3 = -2.0 * xi * xi * r * g * expf  # phi'''
+    a = (p2 - p1) * rinv
+    c3 = p3 - 3.0 * a
+    ame = -0.5 * p3                    # a - e simplifies to -phi'''/2
+
+    # near = exact - far = exact + c3-channel + (a-e)-channel:
+    #   [ -3(rhSrh)/r^2 + c3 (rhSrh) ] rh_i + (a-e)(((S+S^T) rh)_i + tr rh_i)
+    dSd = jnp.einsum("tsi,sij,tsj->ts", d, S, d)      # d.S.d
+    rhSrh = dSd * rinv2                                # rh.S.rh
+    coeff_exact = -3.0 * rhSrh * rinv2                 # -3(rhSrh)/r^2
+    chan1 = (coeff_exact + c3 * rhSrh) * rinv          # * rh_i = * d_i rinv
+    Ssym_d = jnp.einsum("sij,tsj->tsi", S, d) + jnp.einsum(
+        "sji,tsj->tsi", S, d)                          # (S + S^T) d
+    trS = jnp.einsum("sii->s", S)
+    u = chan1[..., None] * d \
+        + ame[..., None] * (Ssym_d + trS[None, :, None] * d) * rinv[..., None]
+    return jnp.sum(u, axis=1)
 
 
 def bhat_far_trunc(k, xi, R):
@@ -434,7 +482,8 @@ _NBR_OFFSETS = np.array([(i, j, k) for i in (-1, 0, 1)
 _NEAR_TILE_BUDGET = 3_000_000
 
 
-def _near_field(plan: EwaldPlan, cell_lo, r_src, f_src, r_trg):
+def _near_field(plan: EwaldPlan, cell_lo, r_src, f_src, r_trg,
+                near_fn=None):
     """Cell-list near field: dense G_near tiles over the 27 neighbor cells.
 
     Static shapes throughout ([cells, max_occ] buckets padded with far
@@ -442,7 +491,13 @@ def _near_field(plan: EwaldPlan, cell_lo, r_src, f_src, r_trg):
     de-duplicated by a 27x27 mask so edge cells don't double-count. Cells
     are processed in chunks via lax.map so peak memory is bounded by
     `_NEAR_TILE_BUDGET` elements regardless of the cell count.
+
+    ``near_fn(trg, src, payload, xi) -> [t, 3]`` is the screened pair tile
+    (Stokeslet by default; the stresslet evaluator passes its own), with
+    ``f_src`` of any trailing rank.
     """
+    if near_fn is None:
+        near_fn = stokeslet_near_block
     Cx, Cy, Cz = plan.cells3
     C3 = Cx * Cy * Cz
     mo = plan.max_occ
@@ -464,8 +519,10 @@ def _near_field(plan: EwaldPlan, cell_lo, r_src, f_src, r_trg):
 
     def per_cell(t_pts, n_ids, n_uniq):
         s_pts = src_b[n_ids].reshape(-1, 3)          # [27 * mo, 3]
-        s_f = jnp.where(n_uniq[:, None, None], f_b[n_ids], 0.0).reshape(-1, 3)
-        return stokeslet_near_block(t_pts, s_pts, s_f, plan.xi)
+        pay = f_b[n_ids]
+        mask = n_uniq.reshape((27,) + (1,) * (pay.ndim - 1))
+        s_f = jnp.where(mask, pay, 0.0).reshape((-1,) + f_b.shape[2:])
+        return near_fn(t_pts, s_pts, s_f, plan.xi)
 
     chunk = max(1, min(C3, _NEAR_TILE_BUDGET // max(27 * mo * mo, 1)))
     n_chunks = -(-C3 // chunk)
@@ -598,43 +655,64 @@ def _point_chunks(plan: EwaldPlan, n):
 
 
 def _spread(plan: EwaldPlan, pts_local, values, dtype):
-    """Type-1 gridding: scatter values [N, 3] onto the [M, M, M, 3] grid,
+    """Type-1 gridding: scatter values [N, C] onto the [M, M, M, C] grid,
     in point chunks so the [chunk, P, P, P] intermediates stay bounded."""
     M = plan.M
     n = pts_local.shape[0]
+    C = values.shape[-1]
     chunk, n_chunks = _point_chunks(plan, n)
     pad = n_chunks * chunk - n
     # padded points spread zero values: harmless wherever they land
     pts_p = jnp.pad(pts_local, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 3)
-    val_p = jnp.pad(values, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 3)
+    val_p = jnp.pad(values, ((0, pad), (0, 0))).reshape(n_chunks, chunk, C)
 
     def body(grid, args):
         pts_c, val_c = args
         flat, w3 = _window_indices(plan, pts_c, dtype)
         contrib = w3[..., None] * val_c[:, None, None, None, :]
-        return grid.at[flat.reshape(-1)].add(contrib.reshape(-1, 3)), None
+        return grid.at[flat.reshape(-1)].add(contrib.reshape(-1, C)), None
 
-    grid, _ = lax.scan(body, jnp.zeros((M * M * M, 3), dtype=dtype),
+    grid, _ = lax.scan(body, jnp.zeros((M * M * M, C), dtype=dtype),
                        (pts_p, val_p))
-    return grid.reshape(M, M, M, 3)
+    return grid.reshape(M, M, M, C)
 
 
 def _interp(plan: EwaldPlan, pts_local, grid, dtype):
-    """Type-2 interpolation: gather grid [M, M, M, 3] at points [N, 3],
+    """Type-2 interpolation: gather grid [M, M, M, C] at points [N, 3],
     chunked like `_spread`."""
     n = pts_local.shape[0]
+    C = grid.shape[-1]
     chunk, n_chunks = _point_chunks(plan, n)
     pad = n_chunks * chunk - n
     pts_p = jnp.pad(pts_local, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 3)
-    flat_grid = grid.reshape(-1, 3)
+    flat_grid = grid.reshape(-1, C)
 
     def body(pts_c):
         flat, w3 = _window_indices(plan, pts_c, dtype)
-        vals = flat_grid[flat.reshape(-1)].reshape(flat.shape + (3,))
+        vals = flat_grid[flat.reshape(-1)].reshape(flat.shape + (C,))
         return jnp.einsum("npqr,npqrk->nk", w3, vals)
 
     out = lax.map(body, pts_p)
-    return out.reshape(n_chunks * chunk, 3)[:n]
+    return out.reshape(n_chunks * chunk, C)[:n]
+
+
+def _kgrid(plan: EwaldPlan, dtype):
+    """Shared spectral geometry: (kx, ky, kz, k2, scalar fold) where the
+    scalar folds the truncated-screened transform, the h^3 quadrature
+    factor, the window deconvolution, and 1/(8 pi eta) — identical for the
+    Stokeslet and stresslet far fields."""
+    M = plan.M
+    h = plan.h
+    k_full = (2.0 * math.pi * jnp.fft.fftfreq(M, d=h)).astype(dtype)
+    k_half = (2.0 * math.pi * jnp.fft.rfftfreq(M, d=h)).astype(dtype)
+    kx = k_full[:, None, None]
+    ky = k_full[None, :, None]
+    kz = k_half[None, None, :]
+    k2 = kx * kx + ky * ky + kz * kz
+    Bhat = bhat_far_trunc(jnp.sqrt(k2), plan.xi, plan.R)
+    what = ((4.0 * math.pi * plan.tau) ** 1.5) * jnp.exp(-plan.tau * k2)
+    scalar = Bhat * (h ** 3) / (what * what) / (8.0 * math.pi * plan.eta)
+    return kx, ky, kz, k2, scalar
 
 
 def _far_field(plan: EwaldPlan, lo, r_src, f_src, r_trg):
@@ -652,21 +730,13 @@ def _far_field(plan: EwaldPlan, lo, r_src, f_src, r_trg):
     """
     dtype = r_src.dtype
     M = plan.M
-    h = plan.h
 
     H = _spread(plan, r_src - lo, f_src, dtype)           # [M, M, M, 3]
     Hk = jnp.fft.rfftn(H, axes=(0, 1, 2))                 # [M, M, M//2+1, 3]
 
-    k_full = (2.0 * math.pi * jnp.fft.fftfreq(M, d=h)).astype(dtype)
-    k_half = (2.0 * math.pi * jnp.fft.rfftfreq(M, d=h)).astype(dtype)
-    kx = k_full[:, None, None]
-    ky = k_full[None, :, None]
-    kz = k_half[None, None, :]
-    k2 = kx * kx + ky * ky + kz * kz
-    Bhat = bhat_far_trunc(jnp.sqrt(k2), plan.xi, plan.R)
-    what = ((4.0 * math.pi * plan.tau) ** 1.5) * jnp.exp(-plan.tau * k2)
-    # Khat = -(k^2 I - k k^T) Bhat / (8 pi eta); fold all scalars together
-    coeff = -Bhat * (h ** 3) / (what * what) / (8.0 * math.pi * plan.eta)
+    kx, ky, kz, k2, scalar = _kgrid(plan, dtype)
+    # Khat = -(k^2 I - k k^T) Bhat / (8 pi eta)
+    coeff = -scalar
 
     kdotF = kx * Hk[..., 0] + ky * Hk[..., 1] + kz * Hk[..., 2]
     Uk = jnp.stack([
@@ -676,6 +746,66 @@ def _far_field(plan: EwaldPlan, lo, r_src, f_src, r_trg):
     ], axis=-1)
     U = jnp.fft.irfftn(Uk, s=(M, M, M), axes=(0, 1, 2))
     return _interp(plan, r_trg - lo, U.astype(dtype), dtype)
+
+
+def _far_field_stresslet(plan: EwaldPlan, lo, r_dl, f_dl, r_trg):
+    """Gridded stresslet (double-layer) far field.
+
+    Spreads the 9-component source, applies the k-space multiplier
+      uhat_i = (i Bhat/(8 pi eta)) [ k_i (k.Shat.k)
+               - (k^2/2)(((Shat + Shat^T) k)_i + tr(Shat) k_i) ]
+    (sign pinned by `tests/test_ewald.py` against the closed-form screened
+    stresslet), with the same window deconvolution as the Stokeslet path.
+    """
+    dtype = r_dl.dtype
+    M = plan.M
+
+    H = _spread(plan, r_dl - lo, f_dl.reshape(-1, 9), dtype)
+    Hk = jnp.fft.rfftn(H, axes=(0, 1, 2))                 # [M, M, Mh, 9]
+
+    kx, ky, kz, k2, scalar = _kgrid(plan, dtype)
+    coeff = 1j * scalar
+
+    kv = (kx, ky, kz)
+    # k.Shat.k and ((Shat + Shat^T) k)_i from the 9 channels (row-major jk)
+    kSk = sum(kv[j] * kv[k] * Hk[..., 3 * j + k]
+              for j in range(3) for k in range(3))
+    Uk = jnp.stack([
+        coeff * (kv[i] * kSk
+                 - 0.5 * k2 * (sum(kv[k] * (Hk[..., 3 * i + k]
+                                            + Hk[..., 3 * k + i])
+                                   for k in range(3))
+                               + (Hk[..., 0] + Hk[..., 4] + Hk[..., 8])
+                               * kv[i]))
+        for i in range(3)], axis=-1)
+    U = jnp.fft.irfftn(Uk, s=(M, M, M), axes=(0, 1, 2))
+    return _interp(plan, r_trg - lo, U.astype(dtype), dtype)
+
+
+@partial(jax.jit, static_argnames=("plan",))
+def _stresslet_ewald_impl(plan: EwaldPlan, anchors, r_dl, r_trg, f_dl):
+    lo_box = anchors[0].astype(r_dl.dtype)
+    lo_cell = anchors[1].astype(r_dl.dtype)
+    # always the cells near field: the blocks-mode K was measured for the
+    # fiber-node source partition, not shell/body double-layer sources
+    u_near = _near_field(plan, lo_cell, r_dl, f_dl, r_trg,
+                         near_fn=stresslet_near_block_ewald)
+    u_far = _far_field_stresslet(plan, lo_box, r_dl, f_dl, r_trg)
+    # no self term: every coefficient of the screened double-layer kernel
+    # vanishes at r = 0 (B_far is smooth and even)
+    return u_near + u_far
+
+
+def stresslet_ewald(plan: EwaldPlan, r_dl, r_trg, f_dl):
+    """Singular stresslet (double-layer) sum via spectral Ewald.
+
+    Same semantics as `kernels.stresslet_direct` (``f_dl`` [n_src, 3, 3],
+    coincident pairs drop, factor 1/(8 pi eta)); the anchors enter traced
+    like `stokeslet_ewald`.
+    """
+    return _stresslet_ewald_impl(strip_anchors(plan),
+                                 plan_anchors(plan, r_dl.dtype),
+                                 r_dl, r_trg, f_dl)
 
 
 @partial(jax.jit, static_argnames=("plan", "n_self"))
