@@ -33,16 +33,46 @@ __all__ = ["stokeslet_direct_df", "stresslet_direct_df"]
 
 
 # Every rounded intermediate that error-extraction expressions subtract back
-# is wrapped in an optimization barrier: XLA's algebraic simplifier (and the
-# excess-precision mode the TPU stack pins on, --xla_allow_excess_precision)
-# otherwise cancels patterns like (a + b) - a symbolically, collapsing the
-# compensation terms to zero — measured: the jitted rsqrt regressed from
-# 1e-14 (eager) to f32 seed accuracy before the barriers.
+# is wrapped in `_bar` (a plain optimization barrier). That alone is NOT
+# sufficient on every pipeline: the current XLA CPU stack REMOVES
+# optimization barriers during compilation (measured, jax 0.9: 5 barriers
+# in the StableHLO, zero in the optimized HLO), after which LLVM's FMA
+# contraction can evaluate a CLONED expression inconsistently across
+# consumer fusions — fl(e + x*y) fused as fma(x, y, e) in one clone, two
+# roundings in the other — so the `s` a two-sum returns and the `s` its
+# error extraction consumed are DIFFERENT values, and the compensation no
+# longer captures the rounding of anything (measured: 5.9e-8 instead of
+# 1e-14 relative on the squared displacement, at fusion-shape-dependent
+# block sizes).
+#
+# The only inexact-product-feeding-add sites in the whole DF chain are the
+# two full-width cross products in `_df_mul` (Dekker's partial products in
+# `_two_prod` are exact by construction, and every other chain is add/sub
+# only, which FMA contraction cannot touch). Those two sites get `_mbar`:
+# `select(x == x, x, 0)` is value-preserving (inputs are non-NaN), cannot
+# be folded without NaN reasoning, and emits a real select between the mul
+# and any consumer add at the LLVM level. Hardening only these two keeps
+# the rest of the graph fusion-friendly — the select everywhere variant
+# blew CPU compile time up >50x at production block shapes.
+#
+# Default tiles are (256, 1024): XLA:CPU compile time scales with the tile
+# AREA for this op-dense graph (~13 s at 256x1024 vs many minutes at
+# 1024x4096 with the hardening in place); the runtime cost of the extra
+# scan iterations is noise next to the per-pair arithmetic.
 _bar = lax.optimization_barrier
 
 
+def _mbar(x):
+    """Contraction breaker for an inexact product about to be summed."""
+    return jnp.where(x == x, x, jnp.zeros_like(x))
+
+
 def _two_sum(a, b):
-    """Error-free a + b = s + e (Knuth; no magnitude ordering required)."""
+    """Error-free a + b = s + e (Knuth; no magnitude ordering required).
+
+    Add/sub only: FMA contraction cannot rewrite it, so it is exact as long
+    as its OPERANDS are deterministic values — which `_mbar` on the cross
+    products in `_df_mul` guarantees for every caller in this module."""
     s = _bar(a + b)
     bb = _bar(s - a)
     e = (a - _bar(s - bb)) + (b - bb)
@@ -50,7 +80,8 @@ def _two_sum(a, b):
 
 
 def _quick_two_sum(a, b):
-    """Error-free a + b = s + e assuming |a| >= |b|."""
+    """Error-free a + b = s + e assuming |a| >= |b| (see `_two_sum` on
+    operand determinism)."""
     s = _bar(a + b)
     e = b - (s - a)
     return s, e
@@ -63,13 +94,25 @@ def _split_factor(dtype):
 
 
 def _two_prod(a, b):
-    """Error-free a * b = p + e via Dekker splitting (no FMA dependency)."""
+    """Error-free a * b = p + e via Dekker splitting (no FMA dependency).
+
+    The split muls ``c * a`` are `_mbar`-hardened: Dekker's half extraction
+    depends on the ROUNDING of c*a, and FMA contraction of c*a into the
+    following subtract (fma(c, a, -a)) skips exactly that rounding, leaving
+    a_hi a non-half and the "exact" partial products inexact (measured:
+    3.8e-8 at fusion-shape-dependent block sizes). ``p = a * b`` needs only
+    `_bar`: both its consumers subtract it, and the partial products that
+    meet it in ``a_hi * b_hi - p`` are exact, so contraction there is
+    value-preserving."""
     c = _split_factor(a.dtype)
-    p = _bar(a * b)
-    a_big = _bar(c * a)
+    # p is also hardened: its own mul can contract into the consuming
+    # subtraction (a_hi*b_hi - p -> fma(-a, b, ...)), skipping p's rounding
+    # in one clone but not the returned value
+    p = _bar(_mbar(a * b))
+    a_big = _bar(_mbar(c * a))
     a_hi = _bar(a_big - _bar(a_big - a))
     a_lo = a - a_hi
-    b_big = _bar(c * b)
+    b_big = _bar(_mbar(c * b))
     b_hi = _bar(b_big - _bar(b_big - b))
     b_lo = b - b_hi
     e = ((a_hi * b_hi - p) + a_hi * b_lo + a_lo * b_hi) + a_lo * b_lo
@@ -84,7 +127,10 @@ def _df_add(xh, xl, yh, yl):
 
 def _df_mul(xh, xl, yh, yl):
     p, e = _two_prod(xh, yh)
-    e = e + (xh * yl + xl * yh)
+    # the cross products are full-width (inexact) muls feeding an add:
+    # break FMA contraction so clones cannot evaluate them inconsistently
+    # (`_two_prod`'s partial products are Dekker-split EXACT and need none)
+    e = e + (_mbar(xh * yl) + _mbar(xl * yh))
     return _quick_two_sum(p, e)
 
 
@@ -299,8 +345,8 @@ def _direct_df(block_fn, r_src, r_trg, payload, eta, block_size, source_block):
 
 
 @partial(jax.jit, static_argnames=("block_size", "source_block"))
-def stresslet_direct_df(r_dl, r_trg, f_dl, eta, *, block_size: int = 1024,
-                        source_block: int = 4096):
+def stresslet_direct_df(r_dl, r_trg, f_dl, eta, *, block_size: int = 256,
+                        source_block: int = 1024):
     """Singular stresslet (double-layer) sum in double-float arithmetic.
 
     Same semantics as `kernels.stresslet_direct` (``f_dl`` is [n_src, 3, 3],
@@ -314,8 +360,8 @@ def stresslet_direct_df(r_dl, r_trg, f_dl, eta, *, block_size: int = 1024,
 
 
 @partial(jax.jit, static_argnames=("block_size", "source_block"))
-def stokeslet_direct_df(r_src, r_trg, f_src, eta, *, block_size: int = 1024,
-                        source_block: int = 4096):
+def stokeslet_direct_df(r_src, r_trg, f_src, eta, *, block_size: int = 256,
+                        source_block: int = 1024):
     """Singular Stokeslet sum with double-float per-pair arithmetic.
 
     Same semantics as `kernels.stokeslet_direct` (self pairs drop, factor
