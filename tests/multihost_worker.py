@@ -1,0 +1,69 @@
+"""Worker for the 2-process jax.distributed smoke test.
+
+Launched by `tests/test_multihost.py` as two subprocesses (the CI-runnable
+counterpart of the reference's 2-rank mpiexec ctest tier,
+`/root/reference/tests/core/unit_tests/CMakeLists.txt:12-19`): each process
+owns 2 virtual CPU devices, joins the distributed runtime through
+`parallel.multihost.initialize`, and drives one ring-evaluator Stokes sum
+sharded over the GLOBAL 4-device mesh — collective-permutes cross the
+process boundary. Prints "MULTIHOST-OK" on success.
+"""
+
+import sys
+
+port, pid = sys.argv[1], int(sys.argv[2])
+
+# platform pinning (JAX_PLATFORMS=cpu, 2 virtual devices) comes from the
+# launching test's environment: jax.distributed.initialize must be the FIRST
+# jax call in the process, so the in-process bootstrap helper (which probes
+# jax.device_count) cannot be used here
+from skellysim_tpu.parallel import multihost
+
+assert multihost.initialize(f"localhost:{port}", 2, pid) is True
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from skellysim_tpu.parallel import make_mesh
+from skellysim_tpu.parallel.ring import ring_stokeslet
+
+info = multihost.process_info()
+assert info["process_count"] == 2, info
+assert info["local_device_count"] == 2, info
+assert info["global_device_count"] == 4, info
+
+mesh = make_mesh()
+assert mesh.size == 4
+
+rng = np.random.default_rng(0)
+n = 16
+r = rng.uniform(-1.0, 1.0, (n, 3))
+f = rng.standard_normal((n, 3))
+sharding = NamedSharding(mesh, P("fib"))
+
+
+def ga(a):
+    return jax.make_array_from_callback(a.shape, sharding,
+                                        lambda idx: a[idx])
+
+
+out = ring_stokeslet(ga(r), ga(r), ga(f), 1.3, mesh=mesh)
+rep = jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))(out)
+got = np.asarray(rep.addressable_data(0))
+
+# plain-NumPy dense oracle (no device work): same masking semantics
+d = r[:, None, :] - r[None, :, :]
+r2 = (d * d).sum(-1)
+np.fill_diagonal(r2, np.inf)
+rinv = 1.0 / np.sqrt(r2)
+df = np.einsum("tsk,sk->ts", d, f)
+ref = (np.einsum("ts,sk->tk", rinv, f)
+       + np.einsum("ts,tsk->tk", df * rinv**3, d)) / (8 * np.pi * 1.3)
+
+err = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+assert err < 5e-9, err
+print("MULTIHOST-OK", pid, flush=True)
