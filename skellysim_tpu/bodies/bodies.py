@@ -51,6 +51,15 @@ class BodyGroup(NamedTuple):
     osc_phase: jnp.ndarray        # [nb]
     radius: jnp.ndarray           # [nb] attachment radius (spheres; 0 otherwise)
     kind_sphere: jnp.ndarray      # [nb] bool: sphere (True) / ellipsoid (False)
+    #: [nb, 3] ellipsoid semiaxes in the body frame (zeros for spheres /
+    #: generic bodies) — drives the rigid-motion containment override in
+    #: velocity fields (`system.cpp:371-380` handles ellipsoids too)
+    semiaxes: jnp.ndarray = None
+    #: int32 [nb] original config-order index. With multiple shape/resolution
+    #: buckets the solver layout is bucket-major; `config_rank` is the GLOBAL
+    #: body id fibers' `binding_body` refers to, and trajectory writers sort
+    #: bodies back to it so the wire stays reference- (config-) ordered.
+    config_rank: jnp.ndarray = None
 
     @property
     def n_bodies(self) -> int:
@@ -80,7 +89,8 @@ class BodyCaches(NamedTuple):
 def make_group(nodes_ref, normals_ref, weights, *, position=None, orientation=None,
                nucleation_sites_ref=None, external_force=0.0, external_torque=0.0,
                ext_force_type=EXTFORCE_LINEAR, osc_amplitude=0.0, osc_omega=0.0,
-               osc_phase=0.0, radius=0.0, kind="sphere", dtype=jnp.float64) -> BodyGroup:
+               osc_phase=0.0, radius=0.0, kind="sphere", semiaxes=0.0,
+               config_rank=None, dtype=jnp.float64) -> BodyGroup:
     nodes_ref = jnp.asarray(nodes_ref, dtype=dtype)
     if nodes_ref.ndim == 2:
         nodes_ref = nodes_ref[None]
@@ -116,7 +126,44 @@ def make_group(nodes_ref, normals_ref, weights, *, position=None, orientation=No
         osc_phase=mat(osc_phase, (nb,)),
         radius=mat(radius, (nb,)),
         kind_sphere=jnp.broadcast_to(jnp.asarray(kind == "sphere"), (nb,)),
+        semiaxes=mat(semiaxes, (nb, 3)),
+        config_rank=(jnp.arange(nb, dtype=jnp.int32) if config_rank is None
+                     else jnp.asarray(config_rank, dtype=jnp.int32)),
     )
+
+
+def as_buckets(bodies) -> tuple:
+    """Normalize a bodies field (None | BodyGroup | iterable of buckets) to
+    a tuple. `BodyGroup` is itself a NamedTuple, so the single-group test
+    must precede generic tuple handling."""
+    if bodies is None:
+        return ()
+    if isinstance(bodies, BodyGroup):
+        return (bodies,)
+    return tuple(bodies)
+
+
+def n_total(bodies) -> int:
+    """Total body count across buckets (the global `binding_body` id space)."""
+    return sum(g.n_bodies for g in as_buckets(bodies))
+
+
+def local_binding(fibers, group: BodyGroup, n_bodies_total: int):
+    """Remap fibers' GLOBAL `binding_body` ids into ``group``-local slots.
+
+    Returns a fibers view whose `binding_body` is the local slot for fibers
+    bound to a body in this bucket and -1 otherwise — what the per-bucket
+    `link_conditions` / `repin_to_bodies` expect. The lookup table is built
+    from `config_rank` (the global id of each slot), host-independent and
+    jit-safe (static shapes).
+    """
+    ranks = (group.config_rank if group.config_rank is not None
+             else jnp.arange(group.n_bodies, dtype=jnp.int32))
+    lookup = jnp.full((max(n_bodies_total, 1),), -1, dtype=jnp.int32)
+    lookup = lookup.at[ranks].set(jnp.arange(group.n_bodies, dtype=jnp.int32))
+    bb = fibers.binding_body
+    local = jnp.where(bb >= 0, lookup[jnp.clip(bb, 0, n_bodies_total - 1)], -1)
+    return fibers._replace(binding_body=local)
 
 
 # ----------------------------------------------------------------- kinematics
@@ -220,13 +267,19 @@ def update_RHS(group: BodyGroup, v_on_bodies):
 
 
 def flow(group: BodyGroup, caches: BodyCaches, r_trg, x_bodies, forces_torques,
-         eta, impl: str = "exact"):
+         eta, impl: str = "exact", ewald_plan=None, ewald_anchors=None):
     """Body -> target velocities (`flow_spherical`, `body_container.cpp:269-339`):
     double-layer stresslet from node densities + Stokeslet from COM forces +
     rotlet from COM torques. ``forces_torques`` is [nb, 6]. Pass
     ``x_bodies=None`` to skip the stresslet term (e.g. the explicit RHS flow,
     which only carries COM forces/torques). The COM Stokeslet/rotlet stay on
-    the exact tile regardless of ``impl`` — nb sources are negligible."""
+    the exact tile regardless of ``impl`` — nb sources are negligible.
+
+    With an ``ewald_plan`` (covering body nodes + targets) the node-density
+    double layer sums through the spectral-Ewald stresslet — the
+    one-evaluator-serves-all seam (`body_container.cpp:552-573` routes body
+    flows through the FMM). Coincident body-node targets drop in both modes
+    (no stresslet self term)."""
     nb, n = group.n_bodies, group.n_nodes
     if x_bodies is None:
         v = jnp.zeros_like(r_trg)
@@ -234,8 +287,18 @@ def flow(group: BodyGroup, caches: BodyCaches, r_trg, x_bodies, forces_torques,
         densities = x_bodies[:, :3 * n].reshape(nb * n, 3)
         normals = caches.normals.reshape(nb * n, 3)
         f_dl = 2.0 * eta * normals[:, :, None] * densities[:, None, :]
-        v = kernels.stresslet_direct(caches.nodes.reshape(nb * n, 3), r_trg,
-                                     f_dl, eta, impl=impl)
+        if ewald_plan is not None:
+            from ..ops import ewald as ew
+
+            if ewald_anchors is None:
+                ewald_anchors = ew.plan_anchors(ewald_plan, r_trg.dtype)
+                ewald_plan = ew.strip_anchors(ewald_plan)
+            v = ew._stresslet_ewald_impl(
+                ewald_plan, ewald_anchors, caches.nodes.reshape(nb * n, 3),
+                r_trg, f_dl) * (ewald_plan.eta / eta)
+        else:
+            v = kernels.stresslet_direct(caches.nodes.reshape(nb * n, 3),
+                                         r_trg, f_dl, eta, impl=impl)
     v = v + kernels.stokeslet_direct(group.position, r_trg, forces_torques[:, :3], eta)
     v = v + kernels.rotlet(group.position, r_trg, forces_torques[:, 3:], eta)
     return v
@@ -355,3 +418,29 @@ def check_collision_pairwise(group: BodyGroup, threshold):
     both_spheres = group.kind_sphere[:, None] & group.kind_sphere[None, :]
     offdiag = ~jnp.eye(nb, dtype=bool)
     return jnp.any((d2 < rsum**2) & both_spheres & offdiag)
+
+
+def check_collision_pairwise_multi(buckets, threshold):
+    """Sphere-sphere collisions across ALL buckets (collision only needs the
+    per-body position/radius/kind columns, which concatenate trivially)."""
+    buckets = as_buckets(buckets)
+    if not buckets:
+        return jnp.asarray(False)
+    flat = BodyGroup(
+        nodes_ref=jnp.zeros((n_total(buckets), 0, 3)),
+        normals_ref=None, weights=None, nucleation_sites_ref=None,
+        position=jnp.concatenate([g.position for g in buckets]),
+        orientation=None, solution=None, velocity=None, angular_velocity=None,
+        external_force=None, external_torque=None, ext_force_type=None,
+        osc_amplitude=None, osc_omega=None, osc_phase=None,
+        radius=jnp.concatenate([g.radius for g in buckets]),
+        kind_sphere=jnp.concatenate([g.kind_sphere for g in buckets]))
+    return check_collision_pairwise(flat, threshold)
+
+
+def check_collision_shell_multi(buckets, shell_radius, threshold):
+    buckets = as_buckets(buckets)
+    hit = jnp.asarray(False)
+    for g in buckets:
+        hit = hit | check_collision_shell(g, shell_radius, threshold)
+    return hit

@@ -35,47 +35,63 @@ def _load_npz(path: str, what: str) -> dict:
         return {k: z[k] for k in z.files}
 
 
-def build_fibers(cfg_fibers: list, dtype) -> fc.FiberGroup | None:
+def build_fibers(cfg_fibers: list, dtype):
+    """FiberGroup (one resolution) or tuple of per-resolution buckets.
+
+    Mixed n_nodes configs bucket by resolution in first-appearance order —
+    the batched counterpart of the reference's mixed-resolution
+    `std::list` container (`fiber_finite_difference.cpp:519-562`). Each
+    fiber's config position is recorded as `config_rank` so trajectory
+    output stays reference- (config-) ordered.
+    """
     if not cfg_fibers:
         return None
-    n_nodes = {f.n_nodes for f in cfg_fibers}
-    if len(n_nodes) != 1:
-        raise ValueError(
-            f"all fibers must share n_nodes (got {sorted(n_nodes)}); "
-            "mixed-resolution buckets are not supported in one group")
-    n = n_nodes.pop()
-    x = np.stack([np.asarray(f.x, dtype=float).reshape(n, 3) for f in cfg_fibers])
-    parent_body = np.array([f.parent_body for f in cfg_fibers], dtype=np.int32)
-    parent_site = np.array([f.parent_site for f in cfg_fibers], dtype=np.int32)
-    minus_clamped = np.array([f.minus_clamped or f.parent_body >= 0
-                              for f in cfg_fibers])
-    return fc.make_group(
-        x,
-        lengths=np.array([f.length for f in cfg_fibers]),
-        bending_rigidity=np.array([f.bending_rigidity for f in cfg_fibers]),
-        radius=np.array([f.radius for f in cfg_fibers]),
-        force_scale=np.array([f.force_scale for f in cfg_fibers]),
-        minus_clamped=minus_clamped,
-        binding_body=parent_body, binding_site=parent_site,
-        dtype=dtype)
+    by_n: dict = {}
+    for rank, f in enumerate(cfg_fibers):
+        by_n.setdefault(int(f.n_nodes), []).append((rank, f))
+
+    def one_bucket(items):
+        ranks = [r for r, _ in items]
+        fibs = [f for _, f in items]
+        n = fibs[0].n_nodes
+        x = np.stack([np.asarray(f.x, dtype=float).reshape(n, 3) for f in fibs])
+        parent_body = np.array([f.parent_body for f in fibs], dtype=np.int32)
+        parent_site = np.array([f.parent_site for f in fibs], dtype=np.int32)
+        minus_clamped = np.array([f.minus_clamped or f.parent_body >= 0
+                                  for f in fibs])
+        return fc.make_group(
+            x,
+            lengths=np.array([f.length for f in fibs]),
+            bending_rigidity=np.array([f.bending_rigidity for f in fibs]),
+            radius=np.array([f.radius for f in fibs]),
+            force_scale=np.array([f.force_scale for f in fibs]),
+            minus_clamped=minus_clamped,
+            binding_body=parent_body, binding_site=parent_site,
+            config_rank=np.array(ranks, dtype=np.int32),
+            dtype=dtype)
+
+    groups = [one_bucket(items) for items in by_n.values()]
+    return groups[0] if len(groups) == 1 else tuple(groups)
 
 
-def build_bodies(cfg_bodies: list, config_dir: str, dtype) -> bd.BodyGroup | None:
+def build_bodies(cfg_bodies: list, config_dir: str, dtype):
+    """BodyGroup (one shape/resolution) or tuple of per-(shape, n_nodes,
+    n_sites) buckets.
+
+    Mixed body types/sizes bucket in first-appearance order — the batched
+    counterpart of the reference's polymorphic `BodyContainer`
+    (`body_container.cpp:523-550`). `config_rank` records each body's
+    config position: it is the GLOBAL id fibers' `parent_body` refers to
+    and the trajectory's wire order.
+    """
     if not cfg_bodies:
         return None
     if any(b.shape == "deformable" for b in cfg_bodies):
         from .bodies import deformable
 
         deformable.make_group()  # raises: declared-but-unimplemented parity stub
-    pre = [_load_npz(os.path.join(config_dir, b.precompute_file), "body")
-           for b in cfg_bodies]
-    n_nodes = {p["node_positions_ref"].shape[0] for p in pre}
-    if len(n_nodes) != 1:
-        raise ValueError("all bodies must share n_nodes (one batched group)")
-    site_counts = {len(b.nucleation_sites) // 3 for b in cfg_bodies}
-    if len(site_counts) != 1:
-        raise ValueError("all bodies must share n_nucleation_sites")
-    ns = site_counts.pop()
+    pre_all = [_load_npz(os.path.join(config_dir, b.precompute_file), "body")
+               for b in cfg_bodies]
 
     def runtime_quat(b):
         # TOML orientation follows the schema/Eigen-coeffs order [x, y, z, w]
@@ -84,7 +100,7 @@ def build_bodies(cfg_bodies: list, config_dir: str, dtype) -> bd.BodyGroup | Non
         x, y, z, w = np.asarray(b.orientation, dtype=float)
         return np.array([w, x, y, z])
 
-    def sites_ref(b):
+    def sites_ref(b, ns):
         # config nucleation sites are lab-frame at t=0; body-frame storage must
         # undo the configured orientation (lab = pos + R(q) @ ref,
         # `body_spherical.cpp:158`), so ref = R(q)^T @ (lab - pos)
@@ -94,33 +110,47 @@ def build_bodies(cfg_bodies: list, config_dir: str, dtype) -> bd.BodyGroup | Non
         R = np.asarray(quat.rotation_matrix(runtime_quat(b)))
         return (s - np.asarray(b.position)) @ R  # (R^T @ d^T)^T = d @ R
 
-    shapes = {b.shape for b in cfg_bodies}
-    if len(shapes) != 1:
-        # a mixed batch would silently demote spheres to kind="generic" and
-        # lose their shell-collision handling; refuse until per-kind batching
-        raise ValueError(f"all bodies must share one shape (got {sorted(shapes)})")
+    by_key: dict = {}
+    for rank, (b, p) in enumerate(zip(cfg_bodies, pre_all)):
+        key = (b.shape, p["node_positions_ref"].shape[0],
+               len(b.nucleation_sites) // 3)
+        by_key.setdefault(key, []).append((rank, b, p))
 
-    ext_type = [bd.EXTFORCE_OSCILLATORY if b.external_force_type == "Oscillatory"
-                else bd.EXTFORCE_LINEAR for b in cfg_bodies]
-    return bd.make_group(
-        np.stack([p["node_positions_ref"] for p in pre]),
-        np.stack([p["node_normals_ref"] for p in pre]),
-        np.stack([p["node_weights"] for p in pre]),
-        position=np.stack([b.position for b in cfg_bodies]),
-        orientation=np.stack([runtime_quat(b) for b in cfg_bodies]),
-        nucleation_sites_ref=np.stack([sites_ref(b) for b in cfg_bodies]),
-        external_force=np.stack([b.external_force for b in cfg_bodies]),
-        external_torque=np.stack([b.external_torque for b in cfg_bodies]),
-        ext_force_type=np.array(ext_type, dtype=np.int32),
-        osc_amplitude=np.array([b.external_oscillation_force_amplitude
-                                for b in cfg_bodies]),
-        osc_omega=np.array([2 * np.pi * b.external_oscillation_force_frequency
-                            for b in cfg_bodies]),
-        osc_phase=np.array([b.external_oscillation_force_phase
-                            for b in cfg_bodies]),
-        radius=np.array([b.radius for b in cfg_bodies]),
-        kind="sphere" if shapes == {"sphere"} else "generic",
-        dtype=dtype)
+    def one_bucket(key, items):
+        shape, _, ns = key
+        ranks = [r for r, _, _ in items]
+        bods = [b for _, b, _ in items]
+        pre = [p for _, _, p in items]
+        ext_type = [bd.EXTFORCE_OSCILLATORY
+                    if b.external_force_type == "Oscillatory"
+                    else bd.EXTFORCE_LINEAR for b in bods]
+        return bd.make_group(
+            np.stack([p["node_positions_ref"] for p in pre]),
+            np.stack([p["node_normals_ref"] for p in pre]),
+            np.stack([p["node_weights"] for p in pre]),
+            position=np.stack([b.position for b in bods]),
+            orientation=np.stack([runtime_quat(b) for b in bods]),
+            nucleation_sites_ref=np.stack([sites_ref(b, ns) for b in bods]),
+            external_force=np.stack([b.external_force for b in bods]),
+            external_torque=np.stack([b.external_torque for b in bods]),
+            ext_force_type=np.array(ext_type, dtype=np.int32),
+            osc_amplitude=np.array([b.external_oscillation_force_amplitude
+                                    for b in bods]),
+            osc_omega=np.array([2 * np.pi * b.external_oscillation_force_frequency
+                                for b in bods]),
+            osc_phase=np.array([b.external_oscillation_force_phase
+                                for b in bods]),
+            radius=np.array([b.radius for b in bods]),
+            kind=shape if shape in ("sphere", "ellipsoid") else "generic",
+            # semiaxes drive the ellipsoid rigid-motion containment override
+            # in velocity fields (`system.cpp:371-380`); zero for others
+            semiaxes=np.array([b.axis_length if b.shape == "ellipsoid"
+                               else [0.0, 0.0, 0.0] for b in bods]),
+            config_rank=np.array(ranks, dtype=np.int32),
+            dtype=dtype)
+
+    groups = [one_bucket(key, items) for key, items in by_key.items()]
+    return groups[0] if len(groups) == 1 else tuple(groups)
 
 
 def build_periphery(cfg_periphery, config_dir: str, dtype, precond_dtype=None):
@@ -197,9 +227,15 @@ def build_simulation(config, config_dir: str = ".", dtype=jnp.float64,
             and mesh is not None):
         # round the fiber batch up to a mesh-divisible node count with inert
         # padding fibers so user configs never hit the ring divisibility
-        # ValueError (System._fiber_flow)
-        fibers = fc.grow_capacity(fibers, fibers.n_fibers,
-                                  node_multiple=mesh.size)
+        # ValueError (System._fiber_flow); each bucket is padded to a
+        # mesh-divisible node count, so the concatenated total divides too
+        if isinstance(fibers, fc.FiberGroup):
+            fibers = fc.grow_capacity(fibers, fibers.n_fibers,
+                                      node_multiple=mesh.size)
+        else:
+            fibers = tuple(fc.grow_capacity(g, g.n_fibers,
+                                            node_multiple=mesh.size)
+                           for g in fibers)
 
     system = System(params, shell_shape=shape, mesh=mesh)
     state = system.make_state(

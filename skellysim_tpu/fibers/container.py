@@ -46,6 +46,11 @@ class FiberGroup(NamedTuple):
     binding_body: jnp.ndarray   # int32 [nf], -1 = unbound
     binding_site: jnp.ndarray   # int32 [nf]
     active: jnp.ndarray         # bool [nf]
+    #: int32 [nf] original config-order rank. With multiple resolution
+    #: buckets the solver layout is bucket-major; trajectory writers sort
+    #: fibers back to this rank so the wire stays reference-ordered
+    #: (`trajectory_reader.cpp` reads fibers in config order).
+    config_rank: jnp.ndarray = None
 
     @property
     def n_fibers(self) -> int:
@@ -86,7 +91,8 @@ class FiberCaches(NamedTuple):
 def make_group(x, lengths, bending_rigidity, radius, *, eta=None,
                penalty=fd_fiber.DEFAULT_PENALTY, beta_tstep=fd_fiber.DEFAULT_BETA_TSTEP,
                force_scale=0.0, v_growth=0.0, minus_clamped=False,
-               binding_body=None, binding_site=None, dtype=jnp.float64) -> FiberGroup:
+               binding_body=None, binding_site=None, config_rank=None,
+               dtype=jnp.float64) -> FiberGroup:
     """Build a FiberGroup from [nf, n, 3] positions and broadcastable per-fiber params."""
     x = jnp.asarray(x, dtype=dtype)
     nf, n = x.shape[0], x.shape[1]
@@ -107,7 +113,20 @@ def make_group(x, lengths, bending_rigidity, radius, *, eta=None,
         binding_body=vec(-1 if binding_body is None else binding_body, jnp.int32),
         binding_site=vec(-1 if binding_site is None else binding_site, jnp.int32),
         active=jnp.ones(nf, dtype=jnp.bool_),
+        config_rank=(jnp.arange(nf, dtype=jnp.int32) if config_rank is None
+                     else jnp.asarray(config_rank, dtype=jnp.int32)),
     )
+
+
+def as_buckets(fibers) -> tuple:
+    """Normalize a fibers field (None | FiberGroup | iterable of groups) to
+    a tuple of resolution buckets. `FiberGroup` is itself a NamedTuple, so
+    the single-group test must precede any generic tuple handling."""
+    if fibers is None:
+        return ()
+    if isinstance(fibers, FiberGroup):
+        return (fibers,)
+    return tuple(fibers)
 
 
 def node_positions(group: FiberGroup) -> jnp.ndarray:
@@ -201,46 +220,82 @@ def flow(group: FiberGroup, caches: FiberCaches, r_trg, forces, eta,
     `ops.ewald.EwaldPlan`) sums in O(N log N) — the reference's
     pair_evaluator seam (`fiber_container_base.cpp:20-33`).
     """
-    wf = weighted_forces(group, forces)
-    if evaluator == "ring" and mesh is not None:
-        from ..parallel.ring import ring_stokeslet
+    return flow_multi((group,), (caches,), r_trg, (forces,), eta,
+                      subtract_self=subtract_self, evaluator=evaluator,
+                      mesh=mesh, impl=impl, ewald_plan=ewald_plan,
+                      ewald_anchors=ewald_anchors)
 
-        vel = ring_stokeslet(node_positions(group), r_trg, wf.reshape(-1, 3),
-                             eta, mesh=mesh, impl=impl)
+
+def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
+               subtract_self: bool = True, evaluator: str = "direct",
+               mesh=None, impl: str = "exact", ewald_plan=None,
+               ewald_anchors=None) -> jnp.ndarray:
+    """`flow` over a tuple of resolution buckets in ONE evaluator pass.
+
+    The TPU answer to the reference's mixed-resolution `std::list` container
+    (`fiber_container_finite_difference.cpp:519-562`): each resolution is a
+    dense vmapped bucket, and the all-to-all flow concatenates every
+    bucket's sources so the pair evaluator (dense tile, ICI ring, or one
+    Ewald grid) runs once over the union instead of once per bucket. When
+    ``subtract_self`` the leading targets must be the concatenated fiber
+    nodes in bucket order; each bucket's dense self-interaction is
+    subtracted at its own slice.
+    """
+    pos = jnp.concatenate([node_positions(g) for g in buckets], axis=0)
+    wf = jnp.concatenate([weighted_forces(g, f).reshape(-1, 3)
+                          for g, f in zip(buckets, forces_list)], axis=0)
+    n_fib_nodes = pos.shape[0]
+    if evaluator == "ring" and mesh is not None:
+        if impl == "df":
+            from ..parallel.ring import ring_stokeslet_df
+
+            vel = ring_stokeslet_df(pos, r_trg, wf, eta, mesh=mesh)
+        else:
+            from ..parallel.ring import ring_stokeslet
+
+            vel = ring_stokeslet(pos, r_trg, wf, eta, mesh=mesh, impl=impl)
     elif evaluator == "ewald" and ewald_plan is not None:
         from ..ops import ewald as ew
 
         if ewald_anchors is None:
             ewald_anchors = ew.plan_anchors(ewald_plan, r_trg.dtype)
             ewald_plan = ew.strip_anchors(ewald_plan)
-        pos = node_positions(group)
         # inactive slots replicate slot 0 (`grow_capacity`), which would
         # pile their nodes into one cell and blow up the plan's bucket
         # capacity; spread them over the cell region instead — their
         # weighted forces are zero, so only occupancy changes. The plan
         # reserved room for them (`plan_ewald(n_fill=...)`).
-        act = jnp.repeat(group.active, group.n_nodes)
+        act = jnp.concatenate([jnp.repeat(g.active, g.n_nodes)
+                               for g in buckets])
         fills = ew.fill_positions(ewald_plan, ewald_anchors[1],
-                                  pos.shape[0], pos.dtype)
-        pos = jnp.where(act[:, None], pos, fills)
-        n_self = group.n_fibers * group.n_nodes if subtract_self else 0
+                                  n_fib_nodes, pos.dtype)
+        # index fills by compacted rank among the inactive slots so the
+        # runtime fill set is exactly the first-n_fill sequence prefix the
+        # planner counted occupancy for — raw slot indices would select an
+        # arbitrary subsequence whose phases can locally align and overflow
+        # the planned per-cell bucket capacity (silent point eviction)
+        rank = jnp.clip(jnp.cumsum(~act) - 1, 0, None)
+        pos = jnp.where(act[:, None], pos, fills[rank])
+        n_self = n_fib_nodes if subtract_self else 0
         if n_self:
             # the leading targets are the fiber nodes: keep them consistent
             # with the (spread) source positions so self pairs stay exact
             r_trg = jnp.concatenate([pos, r_trg[n_self:]], axis=0)
         vel = ew._stokeslet_ewald_impl(ewald_plan, ewald_anchors, pos, r_trg,
-                                       wf.reshape(-1, 3), n_self)
+                                       wf, n_self)
         # the kernel scales as 1/eta and the plan baked plan.eta in; honor
         # this call's eta like the direct/ring branches do
         vel = vel * (ewald_plan.eta / eta)
     else:
-        vel = kernels.stokeslet_direct(node_positions(group), r_trg,
-                                       wf.reshape(-1, 3), eta, impl=impl)
+        vel = kernels.stokeslet_direct(pos, r_trg, wf, eta, impl=impl)
     if subtract_self:
-        self_vel = jnp.einsum("fij,fj->fi", caches.stokeslet,
-                              wf.reshape(group.n_fibers, -1))
-        nfn = group.n_fibers * group.n_nodes
-        vel = vel.at[:nfn].add(-self_vel.reshape(-1, 3))
+        off = 0
+        for g, caches in zip(buckets, caches_list):
+            nfn = g.n_fibers * g.n_nodes
+            self_vel = jnp.einsum("fij,fj->fi", caches.stokeslet,
+                                  wf[off:off + nfn].reshape(g.n_fibers, -1))
+            vel = vel.at[off:off + nfn].add(-self_vel.reshape(-1, 3))
+            off += nfn
     return vel
 
 

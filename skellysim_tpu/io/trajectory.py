@@ -32,6 +32,21 @@ FIBER_TYPE_NONE = 0
 FIBER_TYPE_FINITE_DIFFERENCE = 1
 
 
+def _bucket_list(fibers) -> list:
+    """SimState.fibers (group | tuple of resolution buckets | None) -> list."""
+    from ..fibers.container import as_buckets
+
+    return list(as_buckets(fibers))
+
+
+def _active_ranks(group) -> np.ndarray:
+    """Config-order ranks of the active slots (slot order)."""
+    active = np.asarray(group.active)
+    if group.config_rank is None:
+        return np.flatnonzero(active)
+    return np.asarray(group.config_rank)[active]
+
+
 # ---------------------------------------------------------------- frame build
 
 def _fiber_maps(fibers):
@@ -76,29 +91,50 @@ def _fiber_maps(fibers):
 
 
 def _body_maps(bodies):
-    """Bodies as [spherical, deformable, ellipsoidal] (`body_container.hpp:158`)."""
-    spheres, ellipsoids = [], []
-    if bodies is None:
-        return [spheres, [], ellipsoids]
-    pos = np.asarray(bodies.position, dtype=np.float64)
-    orient = np.asarray(bodies.orientation, dtype=np.float64)
-    sol = np.asarray(bodies.solution, dtype=np.float64)
-    kind_sphere = np.asarray(bodies.kind_sphere)
-    for i in range(pos.shape[0]):
-        m = {
-            "radius_": float(bodies.radius[i]),
-            "position_": eigen.pack_matrix(pos[i]),
-            "orientation_": eigen.pack_quat(orient[i]),
-            "solution_vec_": eigen.pack_matrix(sol[i]),
-        }
-        (spheres if kind_sphere[i] else ellipsoids).append(m)
+    """Bodies as [spherical, deformable, ellipsoidal] (`body_container.hpp:158`).
+
+    Multiple shape/resolution buckets merge back into config order within
+    each kind (`config_rank`), matching the reference's declaration-order
+    serialization of its mixed container."""
+    from ..bodies.bodies import as_buckets
+
+    entries = []                       # (rank, is_sphere, map)
+    for g in as_buckets(bodies):
+        pos = np.asarray(g.position, dtype=np.float64)
+        orient = np.asarray(g.orientation, dtype=np.float64)
+        sol = np.asarray(g.solution, dtype=np.float64)
+        kind_sphere = np.asarray(g.kind_sphere)
+        ranks = (np.asarray(g.config_rank) if g.config_rank is not None
+                 else np.arange(g.n_bodies))
+        for i in range(pos.shape[0]):
+            m = {
+                "radius_": float(g.radius[i]),
+                "position_": eigen.pack_matrix(pos[i]),
+                "orientation_": eigen.pack_quat(orient[i]),
+                "solution_vec_": eigen.pack_matrix(sol[i]),
+            }
+            entries.append((int(ranks[i]), bool(kind_sphere[i]), m))
+    entries.sort(key=lambda t: t[0])
+    spheres = [m for _, is_s, m in entries if is_s]
+    ellipsoids = [m for _, is_s, m in entries if not is_s]
     return [spheres, [], ellipsoids]
 
 
 def state_to_frame(state, rng_state=None) -> dict:
-    """Encode a SimState as a trajectory-v1 frame map."""
-    if state.fibers is not None:
-        fibers_field = [FIBER_TYPE_FINITE_DIFFERENCE, _fiber_maps(state.fibers)]
+    """Encode a SimState as a trajectory-v1 frame map.
+
+    With multiple resolution buckets, fibers are merged back into config
+    order (by `config_rank`) so the wire stays reference-ordered — the
+    reference writes its mixed-resolution `std::list` in declaration order.
+    """
+    buckets = _bucket_list(state.fibers)
+    if buckets:
+        entries = []
+        for g in buckets:
+            entries.extend(zip(_active_ranks(g).tolist(), _fiber_maps(g)))
+        entries.sort(key=lambda t: t[0])
+        fibers_field = [FIBER_TYPE_FINITE_DIFFERENCE,
+                        [m for _, m in entries]]
     else:
         fibers_field = [FIBER_TYPE_NONE, []]
     shell_sol = (np.asarray(state.shell.density, dtype=np.float64)
@@ -185,6 +221,12 @@ def _fiber_array_bytes(fibers) -> bytes:
 
 def _fiber_array_bytes_py(fibers) -> bytes:
     """Pure-Python encode of the active-fiber map array, field-vectorized."""
+    chunks = _fiber_chunk_bytes_py(fibers)
+    return eigen.mp_array_header(len(chunks)) + b"".join(chunks)
+
+
+def _fiber_chunk_bytes_py(fibers) -> list:
+    """Per-active-fiber msgpack map bytes (slot order), field-vectorized."""
     x = np.asarray(fibers.x, dtype=np.float64)
     tension = np.asarray(fibers.tension, dtype=np.float64)
     active = np.nonzero(np.asarray(fibers.active))[0]
@@ -209,7 +251,7 @@ def _fiber_array_bytes_py(fibers) -> bytes:
     kb = _FIBER_KEY_BYTES
     map_head = eigen.mp_map_header(len(_FIBER_KEYS))
     n_nodes_b = msgpack.packb(n)
-    parts = [eigen.mp_array_header(len(active))]
+    parts = []
     for i in active:
         parts.append(b"".join([
             map_head,
@@ -226,16 +268,30 @@ def _fiber_array_bytes_py(fibers) -> bytes:
             kb[10], x_head, x_rows[i].tobytes(),
             kb[11], msgpack.packb(bool(minus_clamped[i])),
         ]))
-    return b"".join(parts)
+    return parts
 
 
 def frame_bytes(state, rng_state=None) -> bytes:
     """Raw msgpack bytes of a trajectory-v1 frame; decoders cannot tell this
     apart from ``msgpack.packb(state_to_frame(state, rng_state))``."""
-    if state.fibers is not None:
+    buckets = _bucket_list(state.fibers)
+    if len(buckets) == 1 and np.all(np.diff(_active_ranks(buckets[0])) > 0):
+        # single bucket in config order: the native C++ fast path applies
         fibers_b = (eigen.mp_array_header(2)
                     + msgpack.packb(FIBER_TYPE_FINITE_DIFFERENCE)
-                    + _fiber_array_bytes(state.fibers))
+                    + _fiber_array_bytes(buckets[0]))
+    elif buckets:
+        # mixed resolutions (or permuted ranks): per-fiber byte chunks from
+        # the field-vectorized encoder, merged back into config order
+        entries = []
+        for g in buckets:
+            entries.extend(zip(_active_ranks(g).tolist(),
+                               _fiber_chunk_bytes_py(g)))
+        entries.sort(key=lambda t: t[0])
+        fibers_b = (eigen.mp_array_header(2)
+                    + msgpack.packb(FIBER_TYPE_FINITE_DIFFERENCE)
+                    + eigen.mp_array_header(len(entries))
+                    + b"".join(c for _, c in entries))
     else:
         fibers_b = msgpack.packb([FIBER_TYPE_NONE, []])
     shell_sol = (np.asarray(state.shell.density, dtype=np.float64)
@@ -463,58 +519,84 @@ def frame_to_state(frame: dict, template_state, dtype=None):
     from ..fibers import container as fc
 
     if dtype is None:
-        dtype = (template_state.fibers.x.dtype if template_state.fibers is not None
-                 else jnp.float64)
+        tb = _bucket_list(template_state.fibers)
+        dtype = tb[0].x.dtype if tb else jnp.float64
     state = template_state
 
     fiber_maps = frame["fibers"][1] if frame["fibers"][0] else []
     if fiber_maps:
-        n_nodes = {f["n_nodes_"] for f in fiber_maps}
-        if len(n_nodes) != 1:
-            raise NotImplementedError(
-                "mixed fiber resolutions in one trajectory frame")
-        x = np.stack([np.asarray(f["x_"]).reshape(-1, 3) for f in fiber_maps])
-        fibers = fc.make_group(
-            x,
-            lengths=np.array([f["length_"] for f in fiber_maps]),
-            bending_rigidity=np.array([f["bending_rigidity_"] for f in fiber_maps]),
-            radius=np.array([f["radius_"] for f in fiber_maps]),
-            penalty=np.array([f["penalty_param_"] for f in fiber_maps]),
-            beta_tstep=np.array([f["beta_tstep_"] for f in fiber_maps]),
-            force_scale=np.array([f["force_scale_"] for f in fiber_maps]),
-            minus_clamped=np.array([f["minus_clamped_"] for f in fiber_maps]),
-            binding_body=np.array([f["binding_site_"][0] for f in fiber_maps]),
-            binding_site=np.array([f["binding_site_"][1] for f in fiber_maps]),
-            dtype=dtype)
-        fibers = fibers._replace(
-            tension=jnp.asarray(np.stack([f["tension_"] for f in fiber_maps]),
-                                dtype=dtype),
-            length_prev=jnp.asarray([f["length_prev_"] for f in fiber_maps],
-                                    dtype=dtype))
-        state = state._replace(fibers=fibers)
+        # regroup by resolution into buckets, first-appearance order (the
+        # same stable bucketing the builder applies to the config), with the
+        # frame position recorded as config_rank so a re-written trajectory
+        # keeps the wire order
+        by_n: dict = {}
+        for rank, f in enumerate(fiber_maps):
+            by_n.setdefault(int(f["n_nodes_"]), []).append((rank, f))
+
+        def one_bucket(items):
+            ranks = [r for r, _ in items]
+            maps = [f for _, f in items]
+            x = np.stack([np.asarray(f["x_"]).reshape(-1, 3) for f in maps])
+            g = fc.make_group(
+                x,
+                lengths=np.array([f["length_"] for f in maps]),
+                bending_rigidity=np.array([f["bending_rigidity_"] for f in maps]),
+                radius=np.array([f["radius_"] for f in maps]),
+                penalty=np.array([f["penalty_param_"] for f in maps]),
+                beta_tstep=np.array([f["beta_tstep_"] for f in maps]),
+                force_scale=np.array([f["force_scale_"] for f in maps]),
+                minus_clamped=np.array([f["minus_clamped_"] for f in maps]),
+                binding_body=np.array([f["binding_site_"][0] for f in maps]),
+                binding_site=np.array([f["binding_site_"][1] for f in maps]),
+                config_rank=np.array(ranks, dtype=np.int32),
+                dtype=dtype)
+            return g._replace(
+                tension=jnp.asarray(np.stack([f["tension_"] for f in maps]),
+                                    dtype=dtype),
+                length_prev=jnp.asarray([f["length_prev_"] for f in maps],
+                                        dtype=dtype))
+
+        groups = [one_bucket(items) for items in by_n.values()]
+        state = state._replace(
+            fibers=groups[0] if len(groups) == 1 else tuple(groups))
     elif template_state.fibers is not None:
         state = state._replace(fibers=None)
 
-    bodies = [b for sub in frame["bodies"] for b in sub]
-    if bodies:
-        if state.bodies is None or state.bodies.n_bodies != len(bodies):
+    bodies_wire = [b for sub in frame["bodies"] for b in sub]
+    if bodies_wire:
+        from ..bodies.bodies import BodyGroup, as_buckets
+
+        b_list = list(as_buckets(state.bodies))
+        if not b_list or sum(g.n_bodies for g in b_list) != len(bodies_wire):
             raise ValueError("trajectory bodies do not match the configured state")
-        # the wire groups bodies as [spheres..., ellipsoids...]; undo that
-        # regrouping against the template's kind order
-        kind_sphere = np.asarray(state.bodies.kind_sphere)
-        wire_order = ([i for i in range(len(bodies)) if kind_sphere[i]]
-                      + [i for i in range(len(bodies)) if not kind_sphere[i]])
-        position = np.empty((len(bodies), 3))
-        orientation = np.empty((len(bodies), 4))
-        solution = np.empty((len(bodies), bodies[0]["solution_vec_"].shape[0]))
-        for wire_slot, template_i in enumerate(wire_order):
-            position[template_i] = bodies[wire_slot]["position_"]
-            orientation[template_i] = bodies[wire_slot]["orientation_"]
-            solution[template_i] = bodies[wire_slot]["solution_vec_"]
-        state = state._replace(bodies=state.bodies._replace(
-            position=jnp.asarray(position, dtype=dtype),
-            orientation=jnp.asarray(orientation, dtype=dtype),
-            solution=jnp.asarray(solution, dtype=dtype)))
+        # the wire groups bodies as [spheres..., ellipsoids...] each in
+        # config order; map wire slots back to (bucket, slot) through the
+        # template's kind + config_rank
+        entries = []                   # (is_ellipsoid, rank, bucket, slot)
+        for bi, g in enumerate(b_list):
+            ks = np.asarray(g.kind_sphere)
+            ranks = (np.asarray(g.config_rank) if g.config_rank is not None
+                     else np.arange(g.n_bodies))
+            for slot in range(g.n_bodies):
+                entries.append((not bool(ks[slot]), int(ranks[slot]),
+                                bi, slot))
+        entries.sort()
+        pos = [np.asarray(g.position).copy() for g in b_list]
+        orient = [np.asarray(g.orientation).copy() for g in b_list]
+        sol = [np.asarray(g.solution).copy() for g in b_list]
+        for wire_slot, (_, _, bi, slot) in enumerate(entries):
+            m = bodies_wire[wire_slot]
+            pos[bi][slot] = m["position_"]
+            orient[bi][slot] = m["orientation_"]
+            sol[bi][slot] = m["solution_vec_"]
+        new_b = tuple(
+            g._replace(position=jnp.asarray(pos[bi], dtype=dtype),
+                       orientation=jnp.asarray(orient[bi], dtype=dtype),
+                       solution=jnp.asarray(sol[bi], dtype=dtype))
+            for bi, g in enumerate(b_list))
+        state = state._replace(
+            bodies=(new_b[0] if isinstance(state.bodies, BodyGroup)
+                    else new_b))
 
     shell_sol = np.asarray(frame["shell"]["solution_vec_"])
     if state.shell is not None and shell_sol.size == state.shell.density.shape[0]:

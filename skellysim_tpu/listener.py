@@ -14,11 +14,15 @@ onto our pair-evaluator seam (case-insensitive): "FMM" -> "ewald" (the
 spectral-Ewald fast evaluator filling the reference's FMM slot),
 "CPU"/"GPU" -> "direct" (dense XLA kernels — the device is whatever backend
 JAX runs on); our native names ("direct"/"ring"/"ewald") are also accepted.
-Scope: the switch accelerates `velocity_field` requests (which plan over
-nodes + targets); streamline/vortex-line INTEGRATION deliberately stays on
-the dense evaluator — integrator points roam outside any pre-built plan's
-cell/FFT region, where the gridded far field would wrap periodically, and
-the plan cannot be rebuilt inside the integrator's jit. An invalid frame_no
+Scope: the switch covers `velocity_field` requests AND streamline /
+vortex-line integration, matching the reference's whole-request evaluator
+switch (`listener.cpp:117` + `system.cpp:389-393`): each request plans over
+the frame's nodes, the line seeds, and an EXTENDED box (the node/seed
+bounding box grown by half a diameter per side), so integrator points can
+roam well beyond the seeds before leaving the planned cell/FFT region.
+Trajectories that escape even the extended box read wrapped far-field
+values — the same box-bound behavior as the reference's FMM evaluator,
+whose octree must also contain every evaluation point. An invalid frame_no
 answers with a zero-length response like the reference
 (`listener.cpp:111-116`).
 """
@@ -28,6 +32,7 @@ from __future__ import annotations
 import dataclasses
 import struct
 import sys
+import weakref
 
 import msgpack
 import numpy as np
@@ -45,19 +50,28 @@ _LINE_DEFAULTS = dict(dt_init=0.1, t_final=1.0, abs_err=1e-10, rel_err=1e-6,
 #: reference evaluator names (`listener.cpp:117`) -> runtime pair evaluators
 #: lowercase reference/native names -> runtime pair evaluators (lookup is
 #: case-insensitive, matching the TOML mapping in `config.schema`)
-EVALUATOR_MAP = {"cpu": "direct", "gpu": "direct", "fmm": "ewald",
+EVALUATOR_MAP = {"cpu": "direct", "gpu": "direct", "tpu": "direct",
+                 "fmm": "ewald",
                  "direct": "direct", "ring": "ring", "ewald": "ewald"}
 
 
 def switch_evaluator(system, evaluator: str | None):
     """Rebuild the System for a requested evaluator (`System::set_evaluator`,
-    `system.cpp:389-393`). Returns (system, switched); unknown or absent
-    names keep the current evaluator. Switching to "ring" creates a mesh
-    over the local devices when the System has none — without one the ring
-    path would silently fall back to direct, making the switch a
-    cache-discarding no-op."""
-    ev = EVALUATOR_MAP.get(evaluator.lower()) if evaluator else None
-    if ev is None or ev == system.params.pair_evaluator:
+    `system.cpp:389-393`). Returns (system, switched); an absent name keeps
+    the current evaluator, an unrecognized one raises (the same
+    reject-config-typos policy as the TOML schema path — silently keeping
+    the old evaluator would misattribute every subsequent result).
+    Switching to "ring" creates a mesh over the local devices when the
+    System has none — without one the ring path would silently fall back to
+    direct, making the switch a cache-discarding no-op."""
+    if not evaluator:
+        return system, False
+    ev = EVALUATOR_MAP.get(evaluator.lower())
+    if ev is None:
+        raise ValueError(
+            f"unknown evaluator {evaluator!r} in listener request; valid "
+            "names: " + ", ".join(sorted(EVALUATOR_MAP)))
+    if ev == system.params.pair_evaluator:
         return system, False
     from .system import System
 
@@ -90,6 +104,43 @@ def _pack_lines(lines: list) -> list:
              "time": eigen.pack_matrix(ln["time"])} for ln in lines]
 
 
+def _extended_corners(state, system, seeds: np.ndarray) -> np.ndarray:
+    """Corner points of the node/seed bounding box grown by half a diameter
+    per side — extra plan targets that give line integrators room to roam
+    inside the Ewald cell/FFT region."""
+    pts = [np.asarray(system._node_positions(state))]
+    if seeds.size:
+        pts.append(seeds)
+    pts = np.vstack(pts)
+    lo, hi = pts.min(axis=0), pts.max(axis=0)
+    margin = 0.5 * max(float(np.linalg.norm(hi - lo)), 1.0)
+    lo, hi = lo - margin, hi + margin
+    return np.array([[x, y, z] for x in (lo[0], hi[0])
+                     for y in (lo[1], hi[1]) for z in (lo[2], hi[2])])
+
+
+#: per-System cache of (plan -> stable velocity-field fn): the fn's identity
+#: keys the streamline integrator's jit cache, so repeated requests with the
+#: same (quantized) plan reuse the compiled program
+_VEL_FNS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _vel_fn_for(system, plan):
+    per = _VEL_FNS.setdefault(system, {})
+    fn = per.get(plan)
+    if fn is None:
+        if plan is None:
+            def fn(pts, state, solution, _sys=system):
+                return _sys._velocity_at_targets_impl(state, solution, pts)
+        else:
+            def fn(pts, state, solution, anchors, _sys=system, _plan=plan):
+                return _sys._velocity_at_targets_impl(
+                    state, solution, pts, ewald_plan=_plan,
+                    ewald_anchors=anchors)
+        per[plan] = fn
+    return fn
+
+
 def process_request(system, template_state, reader: TrajectoryReader,
                     cmd: dict, vel_fn=None) -> dict | None:
     """One request → response dict, or None for an invalid frame.
@@ -106,18 +157,31 @@ def process_request(system, template_state, reader: TrajectoryReader,
     state = frame_to_state(frame, template_state)
     solution = solution_from_state(state)
 
-    if vel_fn is None:
-        def vel_fn(pts, state, solution):
-            return system._velocity_at_targets_impl(state, solution, pts)
-
     sl_req = cmd.get("streamlines") or {}
     vl_req = cmd.get("vortexlines") or {}
     vf_req = cmd.get("velocity_field") or {}
 
-    sl = compute_streamlines(vel_fn, _seeds(sl_req), **_line_kwargs(sl_req),
-                             field_args=(state, solution))
-    vl = compute_vortex_lines(vel_fn, _seeds(vl_req), **_line_kwargs(vl_req),
-                              field_args=(state, solution))
+    seeds_sl = _seeds(sl_req)
+    seeds_vl = _seeds(vl_req)
+    if (system.params.pair_evaluator == "ewald"
+            and (seeds_sl.size or seeds_vl.size)):
+        # per-request extended-box plan: line integration goes through the
+        # fast evaluator too, like the reference's whole-request switch
+        # (`listener.cpp:117`); the quantized plan keys a reused jit program
+        corners = _extended_corners(state, system,
+                                    np.vstack([seeds_sl, seeds_vl]))
+        plan, anchors = system._ewald_args(state, extra_targets=corners)
+        vel_fn = _vel_fn_for(system, plan)
+        field_args = (state, solution, anchors)
+    else:
+        if vel_fn is None:
+            vel_fn = _vel_fn_for(system, None)
+        field_args = (state, solution)
+
+    sl = compute_streamlines(vel_fn, seeds_sl, **_line_kwargs(sl_req),
+                             field_args=field_args)
+    vl = compute_vortex_lines(vel_fn, seeds_vl, **_line_kwargs(vl_req),
+                              field_args=field_args)
 
     vf_x = vf_req.get("x")
     if vf_x is not None and np.asarray(vf_x).size:
@@ -150,12 +214,6 @@ def serve(config_file: str = "skelly_config.toml",
     reader = TrajectoryReader(traj)
     print(f"Entering listener mode ({len(reader)} frames)", file=sys.stderr)
 
-    # one velocity-field function for the server's lifetime: its identity keys
-    # the streamline integrator's jit cache, so frames swap via field_args
-    # without recompiling
-    def vel_fn(pts, state, solution):
-        return system._velocity_at_targets_impl(state, solution, pts)
-
     while True:
         hdr = stdin.read(8)
         if len(hdr) < 8:
@@ -173,14 +231,19 @@ def serve(config_file: str = "skelly_config.toml",
             payload += chunk
         cmd = eigen.decode_tree(msgpack.unpackb(payload, raw=False))
 
-        system, switched = switch_evaluator(system, cmd.get("evaluator"))
-        if switched:
-            # new System -> new jit cache; rebind the stable velocity fn
-            def vel_fn(pts, state, solution, _sys=system):
-                return _sys._velocity_at_targets_impl(state, solution, pts)
-
-        response = process_request(system, template_state, reader, cmd,
-                                   vel_fn=vel_fn)
+        try:
+            system, switched = switch_evaluator(system, cmd.get("evaluator"))
+        except ValueError as e:
+            # reject the request (zero-length answer, like an invalid frame)
+            # but keep serving — one typo'd client must not kill the server
+            print(f"listener: {e}", file=sys.stderr)
+            stdout.write(struct.pack("<Q", 0))
+            stdout.flush()
+            continue
+        # velocity-field fns are cached per (system, plan) in _vel_fn_for,
+        # so an evaluator switch naturally rebinds while repeated frames on
+        # one evaluator reuse the compiled integrator
+        response = process_request(system, template_state, reader, cmd)
         if response is None:
             stdout.write(struct.pack("<Q", 0))
             stdout.flush()

@@ -45,11 +45,14 @@ def shard_state(state, mesh: Mesh, *, allow_replicated_shell: bool = False):
     row_sharding = NamedSharding(mesh, P(FIBER_AXIS, None))
     rep_sharding = NamedSharding(mesh, P())
 
-    nf = state.fibers.n_fibers if state.fibers is not None else 0
+    from ..fibers.container import as_buckets
+
+    nfs = {g.n_fibers for g in as_buckets(state.fibers) if g.n_fibers > 0}
 
     def place(leaf):
         leaf = jax.numpy.asarray(leaf)
-        if leaf.ndim >= 1 and nf > 0 and leaf.shape[0] == nf and nf % mesh.size == 0:
+        if (leaf.ndim >= 1 and leaf.shape[0] in nfs
+                and leaf.shape[0] % mesh.size == 0):
             return jax.device_put(leaf, fib_sharding)
         return jax.device_put(leaf, rep_sharding)
 

@@ -95,11 +95,20 @@ class Params:
     # in "mixed" mode: "exact" = native f64 (fast on CPU, ~100x slower than
     # f32 on TPUs, whose f64 is software-emulated), "df" = double-float f32
     # (`ops.df_kernels`, ~1e-14 relative — far beyond gmres_tol needs),
-    # "auto" = "df" on accelerators, "exact" on CPU. The ring evaluator has
-    # no DF tile; ring runs keep native f64 residuals
+    # "auto" = "df" on accelerators, "exact" on CPU. The ring evaluator
+    # serves "df" with its own double-float tiles
+    # (`parallel.ring.ring_stokeslet_df` / `ring_stresslet_df`)
     refine_pair_impl: str = "auto"
     # max refinement sweeps in "mixed" mode
     max_refine: int = 8
+    # pair_evaluator="ewald" routes a component's pairwise flow through the
+    # spectral-Ewald evaluator only when its SOURCE count reaches this bound;
+    # below it the dense tile is strictly cheaper than an extra FFT-grid
+    # pass (a 400-node body against 640k targets is ~0.26 Gpairs — tens of
+    # ms dense, vs a full M^3 grid round-trip). Host-side static dispatch,
+    # mirroring how the reference only pays FMM setup for point sets that
+    # warrant it; set to 0 to force every flow through Ewald (parity tests)
+    ewald_min_sources: int = 2048
     implicit_motor_activation_delay: float = 0.0
     periphery_interaction_flag: bool = False
     dynamic_instability: DynamicInstability = field(default_factory=DynamicInstability)

@@ -130,7 +130,7 @@ def update_RHS(v_on_shell):
 
 
 def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct",
-         mesh=None, impl: str = "exact"):
+         mesh=None, impl: str = "exact", ewald_plan=None, ewald_anchors=None):
     """Shell -> target velocities via the double-layer stresslet
     (`periphery.cpp:55-79`): f_dl = 2 eta n (x) rho.
 
@@ -139,12 +139,28 @@ def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct
     (reference: one evaluator serves all components, `kernels.hpp:78-122`).
     Zero-strength far-point pads make the node count mesh-divisible; callers
     pad the *target* rows (see `System._ring_pad_targets`).
+
+    ``evaluator="ewald"`` (with a plan covering shell nodes + targets) sums
+    the double layer in O(N log N) via the spectral-Ewald stresslet — the
+    reference's one-evaluator-serves-all design (`periphery.cpp:337-352`
+    routes the shell's stresslet through the FMM). The shell's
+    SELF-interaction is not computed here in any mode: `System._apply_matvec`
+    evaluates this flow at fiber/body rows only, the self block living in
+    the dense stored operator.
     """
     rho = density.reshape(-1, 3)
     f_dl = 2.0 * eta * shell.normals[:, :, None] * rho[:, None, :]
-    if evaluator == "ring" and mesh is not None:
-        from ..parallel.ring import ring_stresslet
+    if evaluator == "ewald" and ewald_plan is not None:
+        from ..ops import ewald as ew
 
+        if ewald_anchors is None:
+            ewald_anchors = ew.plan_anchors(ewald_plan, r_trg.dtype)
+            ewald_plan = ew.strip_anchors(ewald_plan)
+        vel = ew._stresslet_ewald_impl(ewald_plan, ewald_anchors,
+                                       shell.nodes, r_trg, f_dl)
+        # the screened kernels scale as 1/eta and the plan baked plan.eta in
+        return vel * (ewald_plan.eta / eta)
+    if evaluator == "ring" and mesh is not None:
         src = shell.nodes
         pad = (-src.shape[0]) % mesh.size
         if pad:
@@ -152,6 +168,12 @@ def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct
                 [src, jnp.full((pad, 3), 1e7, dtype=src.dtype)], axis=0)
             f_dl = jnp.concatenate(
                 [f_dl, jnp.zeros((pad, 3, 3), dtype=f_dl.dtype)], axis=0)
+        if impl == "df":
+            from ..parallel.ring import ring_stresslet_df
+
+            return ring_stresslet_df(src, r_trg, f_dl, eta, mesh=mesh)
+        from ..parallel.ring import ring_stresslet
+
         return ring_stresslet(src, r_trg, f_dl, eta, mesh=mesh, impl=impl)
     return kernels.stresslet_direct(shell.nodes, r_trg, f_dl, eta, impl=impl)
 

@@ -32,16 +32,61 @@ from ..utils.rng import SimRNG
 _grow_capacity = fc.grow_capacity
 
 
+def _bucket_bindings(groups):
+    """(occupied {(gid, site)}, n_bound) over the given fiber groups —
+    fibers bind by GLOBAL body id, so occupancy must aggregate every
+    bucket (the reference's one flat bitmap, `dynamic_instability.cpp:63`)."""
+    occupied = set()
+    n_bound = 0
+    for g in groups:
+        if g.n_fibers == 0:
+            continue
+        bb = np.asarray(g.binding_body)
+        bs = np.asarray(g.binding_site)
+        bound = np.asarray(g.active) & (bb >= 0)
+        occupied |= set(zip(bb[bound].tolist(), bs[bound].tolist()))
+        n_bound += int(bound.sum())
+    return occupied, n_bound
+
+
 def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
-                              node_multiple: int = 1):
+                              node_multiple: int = 1, _extra_occupied=None,
+                              _extra_bound: int = 0, _rank_floor: int = -1):
     """One nucleation/catastrophe update. Returns a new SimState.
 
     Runs on host between solves (like the reference, which calls it at the top
-    of `prep_state_for_solver`, `system.cpp:403`).
+    of `prep_state_for_solver`, `system.cpp:403`). With multiple resolution
+    buckets, nucleation/catastrophe act on the bucket whose resolution
+    matches `dynamic_instability.n_nodes` (the reference nucleates at one
+    resolution too, `dynamic_instability.cpp:128-139`); other buckets pass
+    through untouched but their site occupancy, bound-fiber count, and
+    config ranks still feed the global bookkeeping (the reference's flat
+    site bitmap spans all fibers).
     """
     di = params.dynamic_instability
     if di.n_nodes == 0:
         return state
+    if (state.fibers is not None
+            and not isinstance(state.fibers, fc.FiberGroup)):
+        buckets = list(fc.as_buckets(state.fibers))
+        idx = next((i for i, g in enumerate(buckets)
+                    if g.n_nodes == di.n_nodes), None)
+        if idx is None:
+            raise NotImplementedError(
+                f"dynamic_instability.n_nodes={di.n_nodes} matches no fiber "
+                f"bucket (resolutions: {[g.n_nodes for g in buckets]}); add "
+                "an (empty-capacity) bucket at that resolution")
+        others = [g for i, g in enumerate(buckets) if i != idx]
+        occ, n_bound = _bucket_bindings(others)
+        rank_floor = max(
+            (int(np.asarray(g.config_rank).max(initial=-1))
+             for g in others if g.config_rank is not None), default=-1)
+        sub = apply_dynamic_instability(
+            state._replace(fibers=buckets[idx]), params, rng,
+            capacity_factor, node_multiple, _extra_occupied=occ,
+            _extra_bound=n_bound, _rank_floor=rank_floor)
+        buckets[idx] = sub.fibers
+        return state._replace(fibers=tuple(buckets))
     fibers = state.fibers
     bodies = state.bodies
     dt = float(state.dt)
@@ -49,8 +94,8 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
     if fibers is not None and fibers.n_nodes != di.n_nodes:
         raise NotImplementedError(
             "dynamic_instability.n_nodes must match the fiber group resolution "
-            f"({di.n_nodes} != {fibers.n_nodes}); mixed-resolution buckets are "
-            "not implemented")
+            f"({di.n_nodes} != {fibers.n_nodes}); use a tuple of buckets for "
+            "mixed resolutions")
 
     # ---------------------------------------------- catastrophe + growth
     if fibers is not None and fibers.n_fibers > 0:
@@ -82,23 +127,42 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
         n_active_old = 0
 
     # ---------------------------------------------------------- nucleation
-    if bodies is None or bodies.nucleation_sites_ref.shape[1] == 0:
-        return state._replace(fibers=_as_device(fibers, state))
-    nb, ns = bodies.n_bodies, bodies.nucleation_sites_ref.shape[1]
-    n_sites = nb * ns
+    from ..bodies import bodies as bd
 
-    occupied = np.zeros(n_sites, dtype=bool)
+    # global site table across every body bucket (the reference's flat
+    # bitmap over all sites, `dynamic_instability.cpp:63,87`); fibers bind
+    # by GLOBAL body id (`BodyGroup.config_rank`)
+    site_tab = []                               # (global_id, site, origin, com)
+    for g in bd.as_buckets(bodies):
+        ns_b = g.nucleation_sites_ref.shape[1]
+        if ns_b == 0:
+            continue
+        _, _, sites_lab = bd.place(g)
+        sites_lab = np.asarray(sites_lab)       # [nb, ns_b, 3]
+        pos = np.asarray(g.position)
+        ranks = (np.asarray(g.config_rank) if g.config_rank is not None
+                 else np.arange(g.n_bodies))
+        for lb in range(g.n_bodies):
+            for s_i in range(ns_b):
+                site_tab.append((int(ranks[lb]), s_i,
+                                 sites_lab[lb, s_i], pos[lb]))
+    if not site_tab:
+        return state._replace(fibers=_as_device(fibers, state))
+    n_sites = len(site_tab)
+
+    occupied = set(_extra_occupied or ())
     if fibers is not None and fibers.n_fibers > 0:
         bb = np.asarray(fibers.binding_body)
         bs = np.asarray(fibers.binding_site)
         bound = np.asarray(fibers.active) & (bb >= 0)
-        occupied[bb[bound] * ns + bs[bound]] = True
+        occupied |= set(zip(bb[bound].tolist(), bs[bound].tolist()))
 
-    free_sites = np.flatnonzero(~occupied)
-    n_inactive_old = n_sites - n_active_old
+    free_sites = [k for k, (gid, s_i, _, _) in enumerate(site_tab)
+                  if (gid, s_i) not in occupied]
+    n_inactive_old = n_sites - n_active_old - _extra_bound
     n_nucleate = min(
         rng.distributed.poisson_int(dt * di.nucleation_rate * n_inactive_old),
-        free_sites.size)
+        len(free_sites))
 
     # sequential uniform draws without replacement (`dynamic_instability.cpp:118-126`)
     chosen = []
@@ -109,21 +173,14 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
     if not chosen:
         return state._replace(fibers=_as_device(fibers, state))
 
-    from ..bodies import bodies as bd
-
-    _, _, sites_lab = bd.place(bodies)
-    sites_lab = np.asarray(sites_lab)          # [nb, ns, 3]
-    body_pos = np.asarray(bodies.position)     # [nb, 3]
-
     new_x, new_body, new_site = [], [], []
     s = np.linspace(0.0, di.min_length, di.n_nodes)
     for flat in chosen:
-        i_body, i_site = divmod(int(flat), ns)
-        origin = sites_lab[i_body, i_site]
-        u_dir = origin - body_pos[i_body]
+        gid, i_site, origin, com = site_tab[int(flat)]
+        u_dir = origin - com
         u_dir = u_dir / np.linalg.norm(u_dir)
         new_x.append(origin[None, :] + s[:, None] * u_dir[None, :])
-        new_body.append(i_body)
+        new_body.append(gid)
         new_site.append(i_site)
 
     if fibers is None or fibers.n_fibers == 0:
@@ -132,7 +189,9 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
             np.stack(new_x), lengths=di.min_length,
             bending_rigidity=di.bending_rigidity, radius=di.radius,
             minus_clamped=True, binding_body=np.array(new_body),
-            binding_site=np.array(new_site), dtype=dtype)
+            binding_site=np.array(new_site),
+            config_rank=_rank_floor + 1 + np.arange(len(new_x)),
+            dtype=dtype)
         fibers = fc.grow_capacity(fibers, fibers.n_fibers, node_multiple)
         return state._replace(fibers=fibers)
 
@@ -157,12 +216,18 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
     handled = {"x", "tension", "length", "length_prev", "bending_rigidity",
                "radius", "penalty", "beta_tstep", "v_growth", "force_scale",
                "minus_clamped", "plus_pinned", "binding_body", "binding_site",
-               "active"}
+               "active", "config_rank"}
     if set(arr) - handled:
         raise RuntimeError(
             f"nucleation slot-fill does not reset fiber fields {set(arr) - handled}; "
             "recycled slots would inherit dead fibers' values")
+    # fresh config ranks: nucleated fibers append after every existing fiber
+    # in the trajectory's config order — across ALL buckets (_rank_floor
+    # carries the other buckets' max; a collision would scramble the wire
+    # order)
+    next_rank = max(int(arr["config_rank"].max(initial=-1)), _rank_floor) + 1
     for k, slot in enumerate(slots):
+        arr["config_rank"][slot] = next_rank + k
         arr["x"][slot] = new_x[k]
         arr["tension"][slot] = 0.0
         arr["length"][slot] = di.min_length

@@ -40,7 +40,13 @@ def _cast_floats(tree, dtype):
 
 
 class SimState(NamedTuple):
-    """Complete simulation state (a pytree)."""
+    """Complete simulation state (a pytree).
+
+    ``fibers`` is a single `FiberGroup` or a TUPLE of them — one bucket per
+    fiber resolution, the batched answer to the reference's mixed-resolution
+    `std::list` container (`fiber_container_finite_difference.cpp:519-562`).
+    Bucket order is the solution-vector order.
+    """
 
     time: jnp.ndarray
     dt: jnp.ndarray
@@ -49,6 +55,28 @@ class SimState(NamedTuple):
     background: Optional[BackgroundFlow]
     shell: Optional[PeripheryState] = None
     bodies: Optional[bd.BodyGroup] = None
+
+
+#: tuple-of-buckets view of a fibers field (`fc.as_buckets`)
+fiber_buckets = fc.as_buckets
+
+#: tuple-of-buckets view of a bodies field (`bd.as_buckets`) — one bucket
+#: per body shape/resolution, the reference's mixed `BodyContainer`
+#: (`body_container.cpp:523-550`)
+body_buckets = bd.as_buckets
+
+
+def _rewrap_bodies(bodies, new_buckets: tuple):
+    if isinstance(bodies, bd.BodyGroup):
+        return new_buckets[0]
+    return tuple(new_buckets)
+
+
+def _rewrap_fibers(fibers, new_buckets: tuple):
+    """Rebuild the fibers field in its original shape (group vs tuple)."""
+    if isinstance(fibers, fc.FiberGroup):
+        return new_buckets[0]
+    return tuple(new_buckets)
 
 
 class StepInfo(NamedTuple):
@@ -72,15 +100,14 @@ def solution_from_state(state: SimState):
     reference's reconstruction on resume (`trajectory_reader.cpp:227-249`).
     """
     parts = []
-    if state.fibers is not None:
-        f = state.fibers
+    for f in fiber_buckets(state.fibers):
         parts.append(jnp.concatenate(
             [f.x[:, :, 0], f.x[:, :, 1], f.x[:, :, 2], f.tension],
             axis=1).reshape(-1))
     if state.shell is not None:
         parts.append(state.shell.density)
-    if state.bodies is not None:
-        parts.append(state.bodies.solution.reshape(-1))
+    for g in bd.as_buckets(state.bodies):
+        parts.append(g.solution.reshape(-1))
     if not parts:
         raise ValueError("state has no implicit components")
     return jnp.concatenate(parts)
@@ -149,60 +176,84 @@ class System:
             r_trg = jnp.concatenate([r_trg, far], axis=0)
         return r_trg, T
 
-    def _fiber_flow(self, state: SimState, caches, r_trg, forces,
+    def _fiber_flow(self, state: SimState, caches_list, r_trg, forces_list,
                     subtract_self: bool = True, impl: str | None = None,
                     ewald_plan=None, ewald_anchors=None):
         """Fiber-source flow through the selected pair evaluator
         (the reference's `params.pair_evaluator` seam,
-        `fiber_container_base.cpp:20-33`). The ring path pads the target rows
-        to a mesh multiple and rotates fiber-node source blocks around the ICI
-        ring; shell/body target rows ride along in the padded target set.
-        ``impl`` overrides `params.kernel_impl` (the mixed solver's f64
-        residual passes "df"); the ring evaluator has no DF tile, so ring
-        runs fall back to its exact (native-dtype) tile."""
+        `fiber_container_base.cpp:20-33`). All resolution buckets contribute
+        sources to ONE evaluator pass (`fc.flow_multi`). The ring path pads
+        the target rows to a mesh multiple and rotates fiber-node source
+        blocks around the ICI ring; shell/body target rows ride along in the
+        padded target set. ``impl`` overrides `params.kernel_impl`; the
+        mixed solver's f64 residual passes "df", which the ring evaluator
+        serves with its own double-float tile
+        (`parallel.ring.ring_stokeslet_df`)."""
+        buckets = fiber_buckets(state.fibers)
         if impl is None:
             impl = self.params.kernel_impl
-        if ewald_plan is not None and impl != "df":
-            # the O(N log N) evaluator serves the fast tiers; "df" flows (the
-            # mixed solver's f64 residual/prep) stay dense — the Ewald
-            # tolerance must not cap the refined residual
-            return fc.flow(state.fibers, caches, r_trg, forces,
-                           self.params.eta, subtract_self=subtract_self,
-                           evaluator="ewald", ewald_plan=ewald_plan,
-                           ewald_anchors=ewald_anchors)
+        if ewald_plan is not None:
+            # the O(N log N) evaluator serves whoever passes a plan; callers
+            # whose flows must stay dense (the mixed solver's f64
+            # residual/prep — the Ewald tolerance must not cap the refined
+            # residual) pass ewald_plan=None, gating on the flow's ROLE
+            # rather than the tile name (refine_pair_impl="auto" resolves to
+            # "exact" on CPU, so an impl-name gate leaked those flows here)
+            return fc.flow_multi(buckets, caches_list, r_trg, forces_list,
+                                 self.params.eta, subtract_self=subtract_self,
+                                 evaluator="ewald", ewald_plan=ewald_plan,
+                                 ewald_anchors=ewald_anchors)
         if not self._ring_active():
-            return fc.flow(state.fibers, caches, r_trg, forces, self.params.eta,
-                           subtract_self=subtract_self, evaluator="direct",
-                           impl=impl)
-        nfn = state.fibers.n_fibers * state.fibers.n_nodes
+            return fc.flow_multi(buckets, caches_list, r_trg, forces_list,
+                                 self.params.eta, subtract_self=subtract_self,
+                                 evaluator="direct", impl=impl)
+        nfn = sum(g.n_fibers * g.n_nodes for g in buckets)
         if nfn % self.mesh.size != 0:
             raise ValueError(
-                f"pair_evaluator='ring' requires n_fibers*n_nodes ({nfn}) to be "
-                f"divisible by the mesh size ({self.mesh.size}); round the "
-                f"fiber batch up to a multiple of {self.mesh.size} fibers "
-                "(inactive padding fibers are free)")
+                f"pair_evaluator='ring' requires the total fiber node count "
+                f"({nfn}) to be divisible by the mesh size ({self.mesh.size}); "
+                "round the fiber batch up (inactive padding fibers are free)")
         r_pad, T = self._ring_pad_targets(r_trg)
-        vel = fc.flow(state.fibers, caches, r_pad, forces, self.params.eta,
-                      subtract_self=subtract_self, evaluator="ring",
-                      mesh=self.mesh,
-                      impl="exact" if impl == "df" else impl)
+        vel = fc.flow_multi(buckets, caches_list, r_pad, forces_list,
+                            self.params.eta, subtract_self=subtract_self,
+                            evaluator="ring", mesh=self.mesh, impl=impl)
         return vel[:T]
 
     def _shell_flow(self, state: SimState, r_trg, density,
-                    impl: str | None = None):
+                    impl: str | None = None, ewald_plan=None,
+                    ewald_anchors=None):
         """Shell -> target flow through the pair-evaluator seam
         (`include/kernels.hpp:78-122`: one evaluator serves all components).
         The density->f_dl math and source padding live in `peri.flow`; only
-        the target padding is System's job."""
+        the target padding is System's job. A supplied ``ewald_plan`` routes
+        the double layer through the spectral-Ewald stresslet (the
+        reference's `periphery.cpp:337-352` FMM path) when the shell is
+        large enough to warrant it (`params.ewald_min_sources`); callers
+        whose flows must stay dense (mixed-mode refinement/prep) pass no
+        plan."""
         if impl is None:
             impl = self.params.kernel_impl
+        if (ewald_plan is not None
+                and state.shell.n_nodes >= self.params.ewald_min_sources):
+            return peri.flow(state.shell, r_trg, density, self.params.eta,
+                             evaluator="ewald", ewald_plan=ewald_plan,
+                             ewald_anchors=ewald_anchors)
         if not self._ring_active():
             return peri.flow(state.shell, r_trg, density, self.params.eta,
                              impl=impl)
         r_pad, T = self._ring_pad_targets(r_trg)
         return peri.flow(state.shell, r_pad, density, self.params.eta,
-                         evaluator="ring", mesh=self.mesh,
-                         impl="exact" if impl == "df" else impl)[:T]
+                         evaluator="ring", mesh=self.mesh, impl=impl)[:T]
+
+    def _body_ewald_args(self, group, ewald_plan, ewald_anchors):
+        """(plan, anchors) for one body bucket's double-layer flow, or
+        (None, None) when its node count is below `params.ewald_min_sources`
+        (dense is strictly cheaper than an extra FFT-grid pass there)."""
+        if (ewald_plan is None or group is None
+                or group.n_bodies * group.n_nodes
+                < self.params.ewald_min_sources):
+            return None, None
+        return ewald_plan, ewald_anchors
 
     # ------------------------------------------------------------- state setup
 
@@ -220,12 +271,13 @@ class System:
         if shell is not None and background is not None and background.is_active():
             # `sanity_check`, system.cpp:625-626
             raise ValueError("background sources are incompatible with peripheries")
+        fb = fiber_buckets(fibers)
         if fibers is not None:
-            dtype = fibers.x.dtype
+            dtype = fb[0].x.dtype
         elif shell is not None:
             dtype = shell.density.dtype
         elif bodies is not None:
-            dtype = bodies.solution.dtype
+            dtype = body_buckets(bodies)[0].solution.dtype
         else:
             dtype = jnp.float64
         return SimState(
@@ -247,30 +299,31 @@ class System:
         singularity.
         """
         parts = []
-        if state.fibers is not None:
-            parts.append(fc.node_positions(state.fibers))
+        for g in fiber_buckets(state.fibers):
+            parts.append(fc.node_positions(g))
         if state.shell is not None:
             parts.append(state.shell.nodes)
-        if state.bodies is not None:
-            nodes = (body_caches.nodes if body_caches is not None
-                     else bd.place(state.bodies)[0])
+        b_list = body_buckets(state.bodies)
+        for i, g in enumerate(b_list):
+            nodes = (body_caches[i].nodes if body_caches is not None
+                     else bd.place(g)[0])
             parts.append(nodes.reshape(-1, 3))
         if not parts:
             return jnp.zeros((0, 3), dtype=jnp.float64)
         return jnp.concatenate(parts, axis=0)
 
     def _counts(self, state: SimState):
-        nf_nodes = (state.fibers.n_fibers * state.fibers.n_nodes
-                    if state.fibers is not None else 0)
+        nf_nodes = sum(g.n_fibers * g.n_nodes
+                       for g in fiber_buckets(state.fibers))
         ns_nodes = state.shell.n_nodes if state.shell is not None else 0
-        nb_nodes = (state.bodies.n_bodies * state.bodies.n_nodes
-                    if state.bodies is not None else 0)
+        nb_nodes = sum(g.n_bodies * g.n_nodes
+                       for g in body_buckets(state.bodies))
         return nf_nodes, ns_nodes, nb_nodes
 
     def _sizes(self, state: SimState):
-        fib = fc.solution_size(state.fibers) if state.fibers is not None else 0
+        fib = sum(fc.solution_size(g) for g in fiber_buckets(state.fibers))
         shell = state.shell.solution_size if state.shell is not None else 0
-        body = state.bodies.solution_size if state.bodies is not None else 0
+        body = sum(g.solution_size for g in body_buckets(state.bodies))
         return fib, shell, body
 
     def _external_flows(self, state: SimState, r_trg):
@@ -285,28 +338,29 @@ class System:
     # ------------------------------------------------- fiber-periphery coupling
 
     def _periphery_force_fibers(self, state: SimState):
-        """Steric wall force on fiber nodes [nf, n, 3] (`periphery_force`).
+        """Steric wall force on fiber nodes, one [nf, n, 3] array per bucket
+        (`periphery_force`).
 
         Applied unconditionally during the solve, like the reference's
         `prep_state_for_solver` (`system.cpp:422`); the
         periphery_interaction_flag only gates post-processing
         (`velocity_at_targets`, `system.cpp:340-341`).
         """
-        fibers = state.fibers
+        buckets = fiber_buckets(state.fibers)
         fp = self.params.fiber_periphery_interaction
         if state.shell is None:
-            return jnp.zeros_like(fibers.x)
+            return [jnp.zeros_like(g.x) for g in buckets]
         shape = self.shell_shape
-        return jax.vmap(
+        return [jax.vmap(
             lambda x, mc: peri.fiber_steric_force(shape, x, fp.f_0, fp.l_0, mc)
-        )(fibers.x, fibers.minus_clamped)
+        )(g.x, g.minus_clamped) for g in buckets]
 
     def _update_plus_pinning(self, state: SimState) -> SimState:
         """Hinge plus ends near an attachment-active periphery
         (`update_boundary_conditions`, `fiber_finite_difference.cpp:74-91`)."""
         pb = self.params.periphery_binding
-        fibers = state.fibers
-        if state.shell is None or not pb.active or fibers is None:
+        buckets = fiber_buckets(state.fibers)
+        if state.shell is None or not pb.active or not buckets:
             return state
         shape = self.shell_shape
 
@@ -317,8 +371,9 @@ class System:
             near = peri.check_collision(shape, x, pb.threshold)
             return in_window & near
 
-        pinned = jax.vmap(one)(fibers.x)
-        return state._replace(fibers=fibers._replace(plus_pinned=pinned))
+        new = tuple(g._replace(plus_pinned=jax.vmap(one)(g.x))
+                    for g in buckets)
+        return state._replace(fibers=_rewrap_fibers(state.fibers, new))
 
     # ------------------------------------------------------------------- prep
 
@@ -329,7 +384,7 @@ class System:
         shell RHS, body RHS)."""
         p = self.params
         state = self._update_plus_pinning(state)
-        fibers = state.fibers
+        buckets = fiber_buckets(state.fibers)
         caches = None
         body_caches = None
         shell_rhs = None
@@ -342,46 +397,64 @@ class System:
         precond_dtype = (jnp.float32 if p.solver_precision == "mixed" else None)
         # mixed mode evaluates the (f64) prep flows through the refinement
         # tile — on accelerators that is double-float f32 (~1e-14, sets the
-        # RHS accuracy floor) instead of the emulated-f64 cliff
-        impl_flow = (self._refine_impl
-                     if p.solver_precision == "mixed"
-                     and state.time.dtype == jnp.float64 else p.kernel_impl)
+        # RHS accuracy floor) instead of the emulated-f64 cliff; those flows
+        # also stay DENSE (plan withheld below) so ewald_tol cannot cap the
+        # RHS accuracy
+        refine_prep = (p.solver_precision == "mixed"
+                       and state.time.dtype == jnp.float64)
+        impl_flow = self._refine_impl if refine_prep else p.kernel_impl
+        prep_plan = None if refine_prep else ewald_plan
+        prep_anchors = None if refine_prep else ewald_anchors
 
-        if fibers is not None:
-            caches = fc.update_cache(fibers, state.dt, p.eta)
-            nf, n = fibers.n_fibers, fibers.n_nodes
+        if buckets:
+            caches = [fc.update_cache(g, state.dt, p.eta) for g in buckets]
 
             external = self._periphery_force_fibers(state)
-            motor = jnp.where(state.time >= p.implicit_motor_activation_delay,
-                              fc.generate_constant_force(fibers, caches),
-                              jnp.zeros_like(fibers.x))
+            motor = [jnp.where(state.time >= p.implicit_motor_activation_delay,
+                               fc.generate_constant_force(g, c),
+                               jnp.zeros_like(g.x))
+                     for g, c in zip(buckets, caches)]
 
             v_all = v_all + self._fiber_flow(state, caches, r_all, external,
                                              impl=impl_flow,
-                                             ewald_plan=ewald_plan,
-                                             ewald_anchors=ewald_anchors)
+                                             ewald_plan=prep_plan,
+                                             ewald_anchors=prep_anchors)
 
-        if state.bodies is not None:
-            body_caches = bd.update_cache(state.bodies, p.eta,
-                                          precond_dtype=precond_dtype)
+        b_list = body_buckets(state.bodies)
+        if b_list:
+            body_caches = [bd.update_cache(g, p.eta,
+                                           precond_dtype=precond_dtype)
+                           for g in b_list]
             # external body forces/torques induce explicit flow everywhere
             # (`system.cpp:430-443`)
-            ext_ft = bd.external_forces_torques(state.bodies, state.time)
-            v_all = v_all + bd.flow(state.bodies, body_caches, r_all, None,
-                                    ext_ft, p.eta, impl=impl_flow)
+            for g, bc in zip(b_list, body_caches):
+                ext_ft = bd.external_forces_torques(g, state.time)
+                v_all = v_all + bd.flow(g, bc, r_all, None, ext_ft, p.eta,
+                                        impl=impl_flow)
 
         v_all = v_all + self._external_flows(state, r_all)
 
-        if state.bodies is not None:
-            v_bodies = v_all[nf_nodes + ns_nodes:].reshape(
-                state.bodies.n_bodies, state.bodies.n_nodes, 3)
-            body_rhs = bd.update_RHS(state.bodies, v_bodies)
+        if b_list:
+            body_rhs = []
+            off = nf_nodes + ns_nodes
+            for g in b_list:
+                nbn = g.n_bodies * g.n_nodes
+                v_bodies = v_all[off:off + nbn].reshape(
+                    g.n_bodies, g.n_nodes, 3)
+                body_rhs.append(bd.update_RHS(g, v_bodies))
+                off += nbn
 
-        if fibers is not None:
-            v_fib = v_all[:nf_nodes].reshape(nf, n, 3)
-            caches = fc.update_rhs_and_bc(fibers, caches, state.dt, p.eta,
-                                          v_fib, motor + external, external,
-                                          precond_dtype=precond_dtype)
+        if buckets:
+            off = 0
+            new_caches = []
+            for g, c, mo, ex in zip(buckets, caches, motor, external):
+                nfn = g.n_fibers * g.n_nodes
+                v_fib = v_all[off:off + nfn].reshape(g.n_fibers, g.n_nodes, 3)
+                new_caches.append(fc.update_rhs_and_bc(
+                    g, c, state.dt, p.eta, v_fib, mo + ex, ex,
+                    precond_dtype=precond_dtype))
+                off += nfn
+            caches = new_caches
         if state.shell is not None:
             v_shell = v_all[nf_nodes:nf_nodes + ns_nodes]
             shell_rhs = peri.update_RHS(v_shell)
@@ -410,7 +483,7 @@ class System:
         p = self.params
         if flow_impl is None:
             flow_impl = p.kernel_impl
-        fibers = state.fibers
+        buckets = fiber_buckets(state.fibers)
         shell = state.shell
         bodies = state.bodies
         fib_size, shell_size, body_size = self._sizes(state)
@@ -427,78 +500,123 @@ class System:
         r_all = self._node_positions(f_state, f_bcaches)
         v_all = jnp.zeros_like(r_all)
 
-        x_fib = None
-        if fibers is not None:
-            nf, n = fibers.n_fibers, fibers.n_nodes
-            x_fib = x_flat[:fib_size].reshape(nf, 4 * n)
-            fw = fc.apply_fiber_force(fibers, caches, x_fib)
+        x_fibs = []
+        if buckets:
+            off = 0
+            for g in buckets:
+                size = fc.solution_size(g)
+                x_fibs.append(x_flat[off:off + size].reshape(g.n_fibers,
+                                                             4 * g.n_nodes))
+                off += size
+            fws = [fc.apply_fiber_force(g, c, xf)
+                   for g, c, xf in zip(buckets, caches, x_fibs)]
             v_all = v_all + self._fiber_flow(f_state, f_caches, r_all,
-                                             fw.astype(lo_dtype),
+                                             [fw.astype(lo_dtype) for fw in fws],
                                              subtract_self=True,
                                              impl=flow_impl,
                                              ewald_plan=ewald_plan,
                                              ewald_anchors=ewald_anchors)
 
-        if shell is not None and (fibers is not None or bodies is not None):
+        if shell is not None and (buckets or bodies is not None):
             # shell flow is evaluated at fiber and body nodes only; the shell
             # self-interaction lives in the dense operator (`system.cpp:301-315`)
             r_fibbody = jnp.concatenate(
                 [r_all[:nf_nodes], r_all[nf_nodes + ns_nodes:]], axis=0)
             v_shell2fibbody = self._shell_flow(f_state, r_fibbody,
                                                x_shell.astype(lo_dtype),
-                                               impl=flow_impl)
+                                               impl=flow_impl,
+                                               ewald_plan=ewald_plan,
+                                               ewald_anchors=ewald_anchors)
             v_all = v_all.at[:nf_nodes].add(v_shell2fibbody[:nf_nodes])
             v_all = v_all.at[nf_nodes + ns_nodes:].add(v_shell2fibbody[nf_nodes:])
 
-        v_boundary = None
-        x_bodies = None
-        if bodies is not None:
-            nb, n_b = bodies.n_bodies, bodies.n_nodes
-            x_bodies = x_flat[fib_size + shell_size:].reshape(nb, 3 * n_b + 6)
-            if fibers is not None:
-                v_boundary, body_ft = bd.link_conditions(
-                    bodies, body_caches, fibers, caches, x_fib, x_bodies)
-            else:
-                body_ft = jnp.zeros((nb, 6), dtype=hi_dtype)
-            v_all = v_all + bd.flow(f_state.bodies, f_bcaches, r_all,
-                                    x_bodies.astype(lo_dtype),
-                                    body_ft.astype(lo_dtype), p.eta,
-                                    impl=flow_impl)
+        v_boundaries = None
+        x_bods = []
+        b_list = body_buckets(bodies)
+        f_b_list = body_buckets(f_state.bodies)
+        if b_list:
+            nbt = bd.n_total(b_list)
+            off_b = fib_size + shell_size
+            for g in b_list:
+                size = g.solution_size
+                x_bods.append(x_flat[off_b:off_b + size].reshape(
+                    g.n_bodies, 3 * g.n_nodes + 6))
+                off_b += size
+            body_fts = [jnp.zeros((g.n_bodies, 6), dtype=hi_dtype)
+                        for g in b_list]
+            if buckets:
+                # link conditions per (fiber bucket x body bucket): each
+                # fiber's GLOBAL binding_body id remaps to a bucket-local
+                # slot (-1 elsewhere), so a fiber contributes to exactly one
+                # body bucket and v_boundary sums correctly
+                v_boundaries = [jnp.zeros((g.n_fibers, 7), dtype=hi_dtype)
+                                for g in buckets]
+                for j, (gb, bc, xb) in enumerate(
+                        zip(b_list, body_caches, x_bods)):
+                    for i, (gf, c, xf) in enumerate(
+                            zip(buckets, caches, x_fibs)):
+                        gf_loc = bd.local_binding(gf, gb, nbt)
+                        vb, ft = bd.link_conditions(gb, bc, gf_loc, c,
+                                                    xf, xb)
+                        v_boundaries[i] = v_boundaries[i] + vb
+                        body_fts[j] = body_fts[j] + ft
+            for gb, f_gb, f_bc, xb, ft in zip(b_list, f_b_list,
+                                              f_bcaches or [None] * len(b_list),
+                                              x_bods, body_fts):
+                b_plan, b_anchors = self._body_ewald_args(gb, ewald_plan,
+                                                          ewald_anchors)
+                v_all = v_all + bd.flow(f_gb, f_bc, r_all,
+                                        xb.astype(lo_dtype),
+                                        ft.astype(lo_dtype), p.eta,
+                                        impl=flow_impl, ewald_plan=b_plan,
+                                        ewald_anchors=b_anchors)
 
         res = []
-        if fibers is not None:
-            v_fib = v_all[:nf_nodes].reshape(nf, n, 3).astype(hi_dtype)
-            if v_boundary is None:
-                v_boundary = jnp.zeros((nf, 7), dtype=hi_dtype)
-            res.append(fc.matvec(fibers, caches, x_fib, v_fib, v_boundary).reshape(-1))
+        off = 0
+        for i, (g, c, xf) in enumerate(zip(buckets, caches or [], x_fibs)):
+            nfn = g.n_fibers * g.n_nodes
+            v_fib = v_all[off:off + nfn].reshape(g.n_fibers, g.n_nodes,
+                                                 3).astype(hi_dtype)
+            vb = (v_boundaries[i] if v_boundaries is not None
+                  else jnp.zeros((g.n_fibers, 7), dtype=hi_dtype))
+            res.append(fc.matvec(g, c, xf, v_fib, vb).reshape(-1))
+            off += nfn
         if shell is not None:
             v_shell = v_all[nf_nodes:nf_nodes + ns_nodes]
             res.append(peri.matvec(f_state.shell, x_shell.astype(lo_dtype),
                                    v_shell).astype(hi_dtype))
-        if bodies is not None:
-            v_bodies = v_all[nf_nodes + ns_nodes:].reshape(nb, n_b, 3)
-            res.append(bd.matvec(f_state.bodies, f_bcaches,
-                                 x_bodies.astype(lo_dtype),
+        off = nf_nodes + ns_nodes
+        for g, f_gb, f_bc, xb in zip(b_list, f_b_list,
+                                     f_bcaches or [None] * len(b_list),
+                                     x_bods):
+            nbn = g.n_bodies * g.n_nodes
+            v_bodies = v_all[off:off + nbn].reshape(g.n_bodies, g.n_nodes, 3)
+            res.append(bd.matvec(f_gb, f_bc, xb.astype(lo_dtype),
                                  v_bodies).astype(hi_dtype).reshape(-1))
+            off += nbn
         return jnp.concatenate(res)
 
     def _apply_precond(self, state: SimState, caches, body_caches, x_flat):
         """Block preconditioner P^-1 x (`apply_preconditioner`, `system.cpp:248-262`)."""
-        fibers = state.fibers
+        buckets = fiber_buckets(state.fibers)
         fib_size, shell_size, body_size = self._sizes(state)
         res = []
-        if fibers is not None:
-            nf, n = fibers.n_fibers, fibers.n_nodes
-            x_fib = x_flat[:fib_size].reshape(nf, 4 * n)
-            res.append(fc.apply_preconditioner(fibers, caches, x_fib).reshape(-1))
+        off = 0
+        for g, c in zip(buckets, caches or []):
+            size = fc.solution_size(g)
+            x_fib = x_flat[off:off + size].reshape(g.n_fibers, 4 * g.n_nodes)
+            res.append(fc.apply_preconditioner(g, c, x_fib).reshape(-1))
+            off += size
         if state.shell is not None:
             res.append(peri.apply_preconditioner(
                 state.shell, x_flat[fib_size:fib_size + shell_size]))
-        if state.bodies is not None:
-            nb = state.bodies.n_bodies
-            x_bod = x_flat[fib_size + shell_size:].reshape(nb, -1)
+        off_b = fib_size + shell_size
+        for j, g in enumerate(body_buckets(state.bodies)):
+            size = g.solution_size
+            x_bod = x_flat[off_b:off_b + size].reshape(g.n_bodies, -1)
             res.append(bd.apply_preconditioner(
-                state.bodies, body_caches, x_bod).reshape(-1))
+                g, body_caches[j], x_bod).reshape(-1))
+            off_b += size
         return jnp.concatenate(res)
 
     # ------------------------------------------------------------------- solve
@@ -510,12 +628,12 @@ class System:
             state, ewald_plan=ewald_plan, ewald_anchors=ewald_anchors)
 
         rhs_parts = []
-        if caches is not None:
-            rhs_parts.append(caches.RHS.reshape(-1))
+        for c in (caches or []):
+            rhs_parts.append(c.RHS.reshape(-1))
         if shell_rhs is not None:
             rhs_parts.append(shell_rhs)
-        if body_rhs is not None:
-            rhs_parts.append(body_rhs.reshape(-1))
+        for br in (body_rhs or []):
+            rhs_parts.append(br.reshape(-1))
         if not rhs_parts:
             raise ValueError("state has no implicit components to solve")
         rhs = jnp.concatenate(rhs_parts)
@@ -531,10 +649,10 @@ class System:
             hi_impl = (self._refine_impl
                        if state.time.dtype == jnp.float64 else p.kernel_impl)
             result = gmres_ir(
+                # hi residual matvec: dense (no ewald plan) regardless of the
+                # refinement tile — ewald_tol must not cap residual_true
                 lambda v: self._apply_matvec(state, caches, body_caches, v,
-                                             flow_impl=hi_impl,
-                                             ewald_plan=ewald_plan,
-                                             ewald_anchors=ewald_anchors),
+                                             flow_impl=hi_impl),
                 lambda v: self._apply_matvec(state, caches, body_caches, v,
                                              lo=lo, ewald_plan=ewald_plan,
                                              ewald_anchors=ewald_anchors),
@@ -555,26 +673,50 @@ class System:
         fib_size, shell_size, body_size = self._sizes(state)
         new_state = state
         fiber_error = jnp.asarray(0.0, dtype=rhs.dtype)
-        if state.fibers is not None:
-            sol_fib = result.x[:fib_size].reshape(state.fibers.n_fibers, -1)
-            new_fibers = fc.step(state.fibers, sol_fib)
-            new_state = new_state._replace(fibers=new_fibers)
+        buckets = fiber_buckets(state.fibers)
+        if buckets:
+            off = 0
+            stepped = []
+            for g in buckets:
+                size = fc.solution_size(g)
+                sol_fib = result.x[off:off + size].reshape(g.n_fibers, -1)
+                stepped.append(fc.step(g, sol_fib))
+                off += size
+            new_state = new_state._replace(
+                fibers=_rewrap_fibers(state.fibers, stepped))
         if state.shell is not None:
             new_state = new_state._replace(shell=state.shell._replace(
                 density=result.x[fib_size:fib_size + shell_size]))
-        if state.bodies is not None:
-            sol_bod = result.x[fib_size + shell_size:].reshape(
-                state.bodies.n_bodies, -1)
-            new_bodies = bd.step(state.bodies, sol_bod, state.dt)
-            new_state = new_state._replace(bodies=new_bodies)
-            if new_state.fibers is not None:
+        b_list = body_buckets(state.bodies)
+        if b_list:
+            off_b = fib_size + shell_size
+            new_b = []
+            for g in b_list:
+                size = g.solution_size
+                sol_bod = result.x[off_b:off_b + size].reshape(g.n_bodies, -1)
+                new_b.append(bd.step(g, sol_bod, state.dt))
+                off_b += size
+            new_state = new_state._replace(
+                bodies=_rewrap_bodies(state.bodies, new_b))
+            if buckets:
                 # fibers re-pin to their (moved) nucleation sites
-                # (`system.cpp:488`, `repin_to_bodies`)
-                _, _, new_sites = bd.place(new_bodies)
-                new_state = new_state._replace(fibers=bd.repin_to_bodies(
-                    new_state.fibers, new_sites, new_bodies))
-        if new_state.fibers is not None:
-            fiber_error = fc.fiber_error(new_state.fibers)
+                # (`system.cpp:488`, `repin_to_bodies`); applied per body
+                # bucket with global->local binding remaps — a fiber is
+                # bound to at most one bucket, so the moves compose
+                nbt = bd.n_total(new_b)
+                repinned = list(fiber_buckets(new_state.fibers))
+                for gb in new_b:
+                    _, _, new_sites = bd.place(gb)
+                    repinned = [
+                        g._replace(x=bd.repin_to_bodies(
+                            bd.local_binding(g, gb, nbt), new_sites, gb).x)
+                        for g in repinned]
+                new_state = new_state._replace(
+                    fibers=_rewrap_fibers(new_state.fibers, repinned))
+        if buckets:
+            fiber_error = jnp.max(jnp.stack(
+                [fc.fiber_error(g)
+                 for g in fiber_buckets(new_state.fibers)]))
 
         info = StepInfo(converged=result.converged, iters=result.iters,
                         residual=result.residual, fiber_error=fiber_error,
@@ -598,23 +740,29 @@ class System:
         rigid motion v + omega x dx.
         """
         p = self.params
-        fibers, shell, bodies = state.fibers, state.shell, state.bodies
+        buckets = fiber_buckets(state.fibers)
+        shell, bodies = state.shell, state.bodies
         fib_size, shell_size, body_size = self._sizes(state)
         r_trg = jnp.asarray(r_trg, dtype=solution.dtype).reshape(-1, 3)
         v = jnp.zeros_like(r_trg)
 
-        caches = (fc.update_cache(fibers, state.dt, p.eta)
-                  if fibers is not None else None)
-        body_caches = (bd.update_cache(bodies, p.eta)
-                       if bodies is not None else None)
+        caches = [fc.update_cache(g, state.dt, p.eta) for g in buckets]
+        b_list = body_buckets(bodies)
+        body_caches = [bd.update_cache(g, p.eta) for g in b_list]
 
-        x_fib = None
-        if fibers is not None:
-            nf, n = fibers.n_fibers, fibers.n_nodes
-            x_fib = solution[:fib_size].reshape(nf, 4 * n)
-            f_on_fibers = fc.apply_fiber_force(fibers, caches, x_fib)
+        x_fibs = []
+        if buckets:
+            off = 0
+            for g in buckets:
+                size = fc.solution_size(g)
+                x_fibs.append(solution[off:off + size].reshape(g.n_fibers,
+                                                               4 * g.n_nodes))
+                off += size
+            f_on_fibers = [fc.apply_fiber_force(g, c, xf)
+                           for g, c, xf in zip(buckets, caches, x_fibs)]
             if p.periphery_interaction_flag and shell is not None:
-                f_on_fibers = f_on_fibers + self._periphery_force_fibers(state)
+                steric = self._periphery_force_fibers(state)
+                f_on_fibers = [f + s for f, s in zip(f_on_fibers, steric)]
             # through the pair-evaluator seam so listener-mode evaluator
             # switches genuinely change the computation (ewald engages when
             # the caller supplies a plan — velocity_at_targets does;
@@ -624,34 +772,62 @@ class System:
                                      ewald_plan=ewald_plan,
                                      ewald_anchors=ewald_anchors)
 
-        if bodies is not None:
-            nb = bodies.n_bodies
-            x_bodies = solution[fib_size + shell_size:].reshape(nb, -1)
-            if fibers is not None:
-                # like the reference, only the fiber link forces (not the
-                # external force schedule) drive the body flow here
-                _, body_ft = bd.link_conditions(
-                    bodies, body_caches, fibers, caches, x_fib, x_bodies)
-            else:
-                body_ft = jnp.zeros((nb, 6), dtype=solution.dtype)
-            v = v + bd.flow(bodies, body_caches, r_trg, x_bodies, body_ft,
-                            p.eta, impl=p.kernel_impl)
+        x_bods = []
+        if b_list:
+            nbt = bd.n_total(b_list)
+            off_b = fib_size + shell_size
+            for g in b_list:
+                size = g.solution_size
+                x_bods.append(solution[off_b:off_b + size].reshape(
+                    g.n_bodies, -1))
+                off_b += size
+            # like the reference, only the fiber link forces (not the
+            # external force schedule) drive the body flow here
+            for gb, bc, xb in zip(b_list, body_caches, x_bods):
+                body_ft = jnp.zeros((gb.n_bodies, 6), dtype=solution.dtype)
+                for g, c, xf in zip(buckets, caches, x_fibs):
+                    _, ft = bd.link_conditions(
+                        gb, bc, bd.local_binding(g, gb, nbt), c, xf, xb)
+                    body_ft = body_ft + ft
+                b_plan, b_anchors = self._body_ewald_args(gb, ewald_plan,
+                                                          ewald_anchors)
+                v = v + bd.flow(gb, bc, r_trg, xb, body_ft, p.eta,
+                                impl=p.kernel_impl, ewald_plan=b_plan,
+                                ewald_anchors=b_anchors)
 
         if shell is not None:
             v = v + self._shell_flow(state, r_trg,
-                                     solution[fib_size:fib_size + shell_size])
+                                     solution[fib_size:fib_size + shell_size],
+                                     ewald_plan=ewald_plan,
+                                     ewald_anchors=ewald_anchors)
 
         v = v + self._external_flows(state, r_trg)
 
-        if bodies is not None:
-            # rigid-motion override inside bodies (`system.cpp:364-381`);
-            # spherical containment only applies to sphere-kind bodies —
-            # other kinds keep the computed exterior flow until they get a
-            # proper containment test
-            vel6 = x_bodies[:, -6:]
-            dx = r_trg[:, None, :] - bodies.position[None, :, :]
-            inside = ((jnp.linalg.norm(dx, axis=-1) < bodies.radius[None, :])
-                      & bodies.kind_sphere[None, :])
+        if b_list:
+            # rigid-motion override inside bodies (`system.cpp:364-381`):
+            # spheres by radius, ellipsoids by the body-frame ellipsoid
+            # equation (`system.cpp:371-380` handles both kinds). The
+            # per-body columns concatenate across buckets.
+            from ..utils import quaternion as quat
+
+            vel6 = jnp.concatenate([xb[:, -6:] for xb in x_bods], axis=0)
+            position = jnp.concatenate([g.position for g in b_list], axis=0)
+            radius = jnp.concatenate([g.radius for g in b_list], axis=0)
+            kind_sphere = jnp.concatenate([g.kind_sphere for g in b_list])
+            orientation = jnp.concatenate([g.orientation for g in b_list],
+                                          axis=0)
+            semiaxes = jnp.concatenate([g.semiaxes for g in b_list], axis=0)
+
+            dx = r_trg[:, None, :] - position[None, :, :]
+            in_sphere = ((jnp.linalg.norm(dx, axis=-1) < radius[None, :])
+                         & kind_sphere[None, :])
+            rot = quat.rotation_matrix(orientation)          # [nb, 3, 3]
+            dx_body = jnp.einsum("bji,tbj->tbi", rot, dx)    # R^T dx
+            has_ax = jnp.all(semiaxes > 0.0, axis=-1)        # [nb]
+            ax_safe = jnp.where(semiaxes > 0.0, semiaxes, 1.0)
+            in_ellipsoid = (jnp.sum((dx_body / ax_safe[None]) ** 2, axis=-1)
+                            < 1.0) & has_ax[None, :] & ~kind_sphere[None, :]
+            inside = in_sphere | in_ellipsoid
             u_rigid = vel6[None, :, :3] + jnp.cross(
                 jnp.broadcast_to(vel6[None, :, 3:], dx.shape), dx)
             idx = jnp.argmax(inside, axis=1)
@@ -671,11 +847,13 @@ class System:
         """Fiber/shell + body collision gate (`check_collision`, `system.cpp:576-595`)."""
         collided = jnp.asarray(False)
         if state.bodies is not None:
-            collided = collided | bd.check_collision_pairwise(state.bodies, 0.0)
+            collided = collided | bd.check_collision_pairwise_multi(
+                state.bodies, 0.0)
             if state.shell is not None and self.shell_shape.kind == "sphere":
-                collided = collided | bd.check_collision_shell(
+                collided = collided | bd.check_collision_shell_multi(
                     state.bodies, self.shell_shape.radius, 0.0)
-        if state.shell is None or state.fibers is None:
+        buckets = fiber_buckets(state.fibers)
+        if state.shell is None or not buckets:
             return collided
         shape = self.shell_shape
 
@@ -685,8 +863,10 @@ class System:
                             x, x[-1])
             return peri.check_collision(shape, pts, 0.0)
 
-        return collided | jnp.any(
-            jax.vmap(one)(state.fibers.x, state.fibers.minus_clamped))
+        for g in buckets:
+            collided = collided | jnp.any(
+                jax.vmap(one)(g.x, g.minus_clamped))
+        return collided
 
     # -------------------------------------------------------------- public API
 
@@ -708,16 +888,16 @@ class System:
         n_fill = 0
         n_src = 0
         parts = []
-        if state.fibers is not None:
-            act = _np.asarray(state.fibers.active)
-            x = _np.asarray(state.fibers.x)
+        for g in fiber_buckets(state.fibers):
+            act = _np.asarray(g.active)
+            x = _np.asarray(g.x)
             parts.append(x[act].reshape(-1, 3))
-            n_fill = int((~act).sum()) * state.fibers.n_nodes
-            n_src = parts[0].shape[0]
+            n_fill += int((~act).sum()) * g.n_nodes
+            n_src += parts[-1].shape[0]
         if state.shell is not None:
             parts.append(_np.asarray(state.shell.nodes))
-        if state.bodies is not None:
-            parts.append(_np.asarray(bd.place(state.bodies)[0]).reshape(-1, 3))
+        for g in body_buckets(state.bodies):
+            parts.append(_np.asarray(bd.place(g)[0]).reshape(-1, 3))
         if extra_targets is not None:
             parts.append(_np.asarray(extra_targets).reshape(-1, 3))
         pts = _np.concatenate(parts, axis=0)

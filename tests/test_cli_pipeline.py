@@ -163,13 +163,18 @@ def test_precompute_spherical_periphery_pipeline(tmp_path):
     assert np.all(np.isfinite(np.asarray(new_state.fibers.x)))
 
 
-def test_builder_rejects_mixed_resolution(tmp_path):
+def test_builder_buckets_mixed_resolution(tmp_path):
+    """Mixed n_nodes configs bucket by resolution (round 4 — previously
+    rejected; the reference's mixed std::list container,
+    `fiber_finite_difference.cpp:519-562`)."""
     cfg = Config()
     f1 = Fiber(n_nodes=16); f1.fill_node_positions(np.zeros(3), np.array([0, 0, 1.0]))
     f2 = Fiber(n_nodes=32); f2.fill_node_positions(np.ones(3), np.array([0, 0, 1.0]))
     cfg.fibers = [f1, f2]
-    with pytest.raises(ValueError, match="share n_nodes"):
-        builder.build_fibers(cfg.fibers, np.float64)
+    groups = builder.build_fibers(cfg.fibers, np.float64)
+    assert isinstance(groups, tuple) and len(groups) == 2
+    assert [g.n_nodes for g in groups] == [16, 32]
+    assert [int(g.config_rank[0]) for g in groups] == [0, 1]
 
 
 def test_listener_evaluator_mapping():
@@ -180,9 +185,12 @@ def test_listener_evaluator_mapping():
     from skellysim_tpu.system import System
 
     system = System(Params(adaptive_timestep_flag=False))
-    for name in ("CPU", "GPU", None, "unknown", "direct"):
+    for name in ("CPU", "GPU", "TPU", None, "direct"):
         s2, switched = switch_evaluator(system, name)
         assert not switched and s2 is system, name
+    # unrecognized names are rejected (the schema path's reject-typos policy)
+    with pytest.raises(ValueError):
+        switch_evaluator(system, "unknown")
     s2, switched = switch_evaluator(system, "FMM")
     assert switched and s2.params.pair_evaluator == "ewald"
     s2r, switched = switch_evaluator(system, "ring")
