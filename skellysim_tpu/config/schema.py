@@ -187,6 +187,7 @@ class Params:
     ewald_tol: float = 1e-6
     kernel_impl: str = "exact"
     refine_pair_impl: str = "auto"
+    ewald_min_sources: int = 2048
 
 
 @dataclass
@@ -595,6 +596,7 @@ def to_runtime_params(p: Params) -> runtime_params.Params:
         pair_evaluator=_runtime_evaluator(p.pair_evaluator),
         solver_precision=p.solver_precision,
         ewald_tol=p.ewald_tol,
+        ewald_min_sources=p.ewald_min_sources,
         kernel_impl=p.kernel_impl,
         refine_pair_impl=p.refine_pair_impl,
         dynamic_instability=runtime_params.DynamicInstability(

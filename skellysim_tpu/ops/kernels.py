@@ -248,6 +248,16 @@ def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096,
         return stokeslet_direct_df(
             r_src, r_trg, f_src, eta, block_size=min(block_size, 1024),
             source_block=source_block or 4096)
+    if impl == "pallas":
+        # fused VMEM-tile kernel (`ops.pallas_kernels`); Mosaic lowering on
+        # real TPUs, interpret mode elsewhere (CPU tests / fallback). NOTE:
+        # the session's remote axon AOT compiler has rejected the Mosaic
+        # lowering in past rounds — this path is opt-in precisely so its
+        # status can be re-probed per deployment without touching defaults.
+        from .pallas_kernels import stokeslet_pallas
+
+        return stokeslet_pallas(r_src, r_trg, f_src, eta,
+                                interpret=jax.default_backend() == "cpu")
     factor = 1.0 / (8.0 * math.pi)
     if impl == "mxu":
         u = _pair_sum(stokeslet_block_mxu, r_trg, (r_src, f_src),
@@ -276,6 +286,12 @@ def stresslet_direct(r_dl, r_trg, f_dl, eta, *, block_size: int = 4096,
         return stresslet_direct_df(
             r_dl, r_trg, f_dl, eta, block_size=min(block_size, 1024),
             source_block=source_block or 4096)
+    if impl == "pallas":
+        # see `stokeslet_direct`'s pallas branch for the compiler caveat
+        from .pallas_kernels import stresslet_pallas
+
+        return stresslet_pallas(r_dl, r_trg, f_dl, eta,
+                                interpret=jax.default_backend() == "cpu")
     factor = 1.0 / (8.0 * math.pi)
     if impl == "mxu":
         u = _pair_sum(stresslet_block_mxu, r_trg, (r_dl, f_dl),

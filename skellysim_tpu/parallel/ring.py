@@ -58,6 +58,20 @@ def _ring_accumulate(block_fn, axis_name: str, n_dev: int, u0, *rotating):
     return u + block_fn(*rot)
 
 
+def _ring_block(impl: str, exact_block, mxu_block):
+    """Tile dispatch for the ring evaluator. Names the ring does NOT serve
+    ("df" has its own ring entry points; "pallas" has no ring tile) raise
+    instead of silently running the exact tile — a user probing a specific
+    tile on a mesh must not get exact-tile results misattributed to it."""
+    if impl == "exact":
+        return exact_block
+    if impl == "mxu":
+        return mxu_block
+    raise ValueError(
+        f"ring evaluator has no {impl!r} tile; use 'exact' or 'mxu' "
+        "(double-float rides ring_stokeslet_df / ring_stresslet_df)")
+
+
 def _ring_eval(block_fn, mesh: Mesh, axis_name: str, specs, scale, *operands):
     """shard_map a ring accumulation: operands[0] = targets (stay resident),
     operands[1:] rotate."""
@@ -84,7 +98,7 @@ def ring_stokeslet(r_src, r_trg, f_src, eta, *, mesh: Mesh,
     shard's spatial extent.
     """
     spec = P(axis_name)
-    block = stokeslet_block_mxu if impl == "mxu" else stokeslet_block
+    block = _ring_block(impl, stokeslet_block, stokeslet_block_mxu)
     return _ring_eval(block, mesh, axis_name, (spec, spec, spec),
                       1.0 / (8.0 * math.pi * eta), r_trg, r_src, f_src)
 
@@ -95,7 +109,7 @@ def ring_stresslet(r_dl, r_trg, f_dl, eta, *, mesh: Mesh,
     """Ring-parallel stresslet (double-layer) sum
     (`ops.kernels.stresslet_direct`); ``f_dl`` is [n_src, 3, 3]."""
     spec = P(axis_name)
-    block = stresslet_block_mxu if impl == "mxu" else stresslet_block
+    block = _ring_block(impl, stresslet_block, stresslet_block_mxu)
     return _ring_eval(block, mesh, axis_name,
                       (spec, spec, P(axis_name, None, None)),
                       1.0 / (8.0 * math.pi * eta), r_trg, r_dl, f_dl)

@@ -71,9 +71,12 @@ class Params:
     # does not cap the converged residual
     ewald_tol: float = 1e-6
     # pairwise-kernel tile implementation: "exact" (displacement-tensor form,
-    # the reference's semantics bit-for-bit) or "mxu" (matmul form — the
+    # the reference's semantics bit-for-bit), "mxu" (matmul form — the
     # O(N^2*3) contractions ride the MXU; see kernels.stokeslet_block_mxu's
-    # near-field cancellation caveat — for well-separated fiber clouds)
+    # near-field cancellation caveat — for well-separated fiber clouds),
+    # "df" (double-float f32, the f64-grade accuracy tier), or "pallas"
+    # (fused VMEM-tile kernels, `ops.pallas_kernels` — interpret mode off-TPU;
+    # opt-in while the deployment's Mosaic compiler support is probed)
     kernel_impl: str = "exact"
     # solver precision strategy (no reference analogue — the reference is
     # f64-everywhere on CPU; TPU XLA's LuDecomposition is f32-only and the

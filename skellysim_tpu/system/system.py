@@ -126,6 +126,12 @@ class System:
             raise ValueError(
                 f"unknown solver_precision {params.solver_precision!r}; "
                 "use 'full' or 'mixed'")
+        if params.kernel_impl not in ("exact", "mxu", "df", "pallas"):
+            # the kernel seam's else-branch would silently run "exact" for a
+            # typo'd name — reject at construction like the other knobs
+            raise ValueError(
+                f"unknown kernel_impl {params.kernel_impl!r}; "
+                "use 'exact', 'mxu', 'df', or 'pallas'")
         self.params = params
         self.shell_shape = shell_shape
         # device mesh for the ring pair evaluator (params.pair_evaluator="ring");
@@ -764,9 +770,10 @@ class System:
                 steric = self._periphery_force_fibers(state)
                 f_on_fibers = [f + s for f, s in zip(f_on_fibers, steric)]
             # through the pair-evaluator seam so listener-mode evaluator
-            # switches genuinely change the computation (ewald engages when
-            # the caller supplies a plan — velocity_at_targets does;
-            # streamline integrators stay dense by design)
+            # switches genuinely change the computation: ewald engages when
+            # the caller supplies a plan — velocity_at_targets plans over
+            # nodes + probes, and the listener's streamline integrators pass
+            # per-request extended-box plans (`listener.process_request`)
             v = v + self._fiber_flow(state, caches, r_trg, f_on_fibers,
                                      subtract_self=False,
                                      ewald_plan=ewald_plan,
