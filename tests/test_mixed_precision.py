@@ -53,11 +53,12 @@ def test_mixed_coupled_solve_hits_reference_tol():
     system = System(params, shell_shape=shape)
     state = system.make_state(fibers=fibers, shell=shell, bodies=bodies)
 
-    # the preconditioner factors really are f32 (what TPU LU requires)
+    # the preconditioner factors really are f32 (what TPU LU requires);
+    # _prep returns per-bucket lists since the heterogeneous-buckets refactor
     _, caches, body_caches, _, _ = system._prep(state)
-    assert caches.lu.dtype == jnp.float32
-    assert body_caches.lu.dtype == jnp.float32
-    assert caches.A_bc.dtype == jnp.float64  # assembly stays f64
+    assert caches[0].lu.dtype == jnp.float32
+    assert body_caches[0].lu.dtype == jnp.float32
+    assert caches[0].A_bc.dtype == jnp.float64  # assembly stays f64
 
     new_state, solution, info = system.step(state)
     assert solution.dtype == jnp.float64
