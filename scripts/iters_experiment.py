@@ -1,0 +1,162 @@
+"""Diagnose the oocyte/ellipsoid GMRES iteration counts (VERDICT r4 #5).
+
+Rebuilds the bench's BASELINE #5 scene (surface-of-revolution shell +
+clamped fibers) and prints per-restart-cycle implicit/explicit residuals
+for solver variants, so the preconditioner/restart interplay is visible.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+from skellysim_tpu.utils.bootstrap import force_cpu_devices
+
+force_cpu_devices(1)
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import jax.numpy as jnp
+
+import bench
+from skellysim_tpu.fibers import container as fc
+from skellysim_tpu.params import Params
+from skellysim_tpu.periphery import periphery as peri
+from skellysim_tpu.periphery import shapes
+from skellysim_tpu.system import System
+from skellysim_tpu.solver import gmres
+
+
+def build_scene(kind="revolution", n_fibers=16, fiber_nodes=32, shell_n=192,
+                dtype=jnp.float64):
+    if kind == "ellipsoid":
+        a, b, c = 7.8, 6.0, 6.0
+        spec = shapes.ellipsoid_shape(shell_n, a, b, c)
+        p = 1.6075
+        area = 4 * np.pi * (((a*b)**p + (a*c)**p + (b*c)**p) / 3) ** (1/p)
+        shape = peri.PeripheryShape(kind="ellipsoid", abc=(a, b, c))
+    else:
+        env = {"n_nodes_target": shell_n, "lower_bound": -3.75,
+               "upper_bound": 3.75, "T": 0.72, "p1": 0.4, "p2": 0.2,
+               "length": 7.5,
+               "height": "0.5 * T * ((1 + 2*x/length)**p1) "
+                         "* ((1 - 2*x/length)**p2) * length"}
+        spec = shapes.surface_of_revolution_shape(env)
+        area = 4 * np.pi * 2.0 ** 2
+        shape = peri.PeripheryShape(kind="generic")
+    N = len(spec.nodes)
+    normals = -spec.node_normals
+    weights = np.full(N, area / N)
+    op, M_inv = bench._device_shell_operator(spec.nodes, normals, weights,
+                                             dtype, precond_dtype=jnp.float32)
+    shell = peri.make_state(spec.nodes, normals, weights, op, M_inv,
+                            dtype=dtype, precond_dtype=jnp.float32)
+    x, nf = bench._clamped_fiber_field(spec, n_fibers, fiber_nodes, 1.0, dtype)
+    fibers = fc.make_group(x, lengths=1.0, bending_rigidity=2.5e-3,
+                           radius=0.0125, force_scale=-0.05,
+                           minus_clamped=True, dtype=dtype)
+    params = Params(eta=1.0, dt_initial=8e-3, t_final=1.0, gmres_tol=1e-10,
+                    gmres_restart=60, gmres_maxiter=300,
+                    adaptive_timestep_flag=False)
+    system = System(params, shell_shape=shape)
+    state = system.make_state(fibers=fibers, shell=shell)
+    return system, state
+
+
+def run_debug(system, state, restart, label):
+    p = system.params
+    state2, caches, body_caches, shell_rhs, body_rhs = system._prep(state)
+    rhs_parts = [c.RHS.reshape(-1) for c in (caches or [])]
+    if shell_rhs is not None:
+        rhs_parts.append(shell_rhs)
+    rhs = jnp.concatenate(rhs_parts)
+    mv = lambda v: system._apply_matvec(state2, caches, body_caches, v)
+    pc = lambda v: system._apply_precond(state2, caches, body_caches, v)
+    t0 = time.perf_counter()
+    res = gmres(mv, rhs, precond=pc, tol=p.gmres_tol, restart=restart,
+                maxiter=300, debug=True)
+    iters = int(res.iters)
+    wall = time.perf_counter() - t0
+    print(f"[{label}] iters={iters} converged={bool(res.converged)} "
+          f"implicit={float(res.residual):.3e} true={float(res.residual_true):.3e} "
+          f"wall={wall:.1f}s", flush=True)
+    return res
+
+
+def run_gs(system, state, restart, label, order="shell_first", sweeps=1):
+    """GMRES with a block GAUSS-SEIDEL preconditioner: the block-Jacobi
+    solves plus the fiber<->shell coupling applied triangularly. The
+    coupling term A_fs y_s (or A_sf y_f) is extracted from the full
+    matvec at (0, y_s) — wasteful (computes all rows) but exact for the
+    experiment."""
+    p = system.params
+    state2, caches, body_caches, shell_rhs, body_rhs = system._prep(state)
+    rhs_parts = [c.RHS.reshape(-1) for c in (caches or [])]
+    if shell_rhs is not None:
+        rhs_parts.append(shell_rhs)
+    rhs = jnp.concatenate(rhs_parts)
+    fib_size, shell_size, body_size = system._sizes(state2)
+    mv = lambda v: system._apply_matvec(state2, caches, body_caches, v)
+    pc_jac = lambda v: system._apply_precond(state2, caches, body_caches, v)
+
+    def pc_gs(x):
+        x_f = x[:fib_size]
+        x_s = x[fib_size:fib_size + shell_size]
+        zf = jnp.zeros(fib_size, dtype=x.dtype)
+        zs = jnp.zeros(shell_size, dtype=x.dtype)
+        if order == "shell_first":
+            y_s = pc_jac(jnp.concatenate([zf, x_s]))[fib_size:]
+            a = mv(jnp.concatenate([zf, y_s]))  # coupling rows
+            x_f2 = x_f - a[:fib_size]
+            y_f = pc_jac(jnp.concatenate([x_f2, zs]))[:fib_size]
+            return jnp.concatenate([y_f, y_s])
+        else:  # fiber_first
+            y_f = pc_jac(jnp.concatenate([x_f, zs]))[:fib_size]
+            a = mv(jnp.concatenate([y_f, zs]))
+            x_s2 = x_s - a[fib_size:]
+            y_s = pc_jac(jnp.concatenate([zf, x_s2]))[fib_size:]
+            return jnp.concatenate([y_f, y_s])
+
+    def pc_sym(x):
+        # symmetric sweep: shell-first then fiber-first correction on shell
+        x_f = x[:fib_size]
+        x_s = x[fib_size:fib_size + shell_size]
+        zf = jnp.zeros(fib_size, dtype=x.dtype)
+        y_s = pc_jac(jnp.concatenate([zf, x_s]))[fib_size:]
+        a = mv(jnp.concatenate([zf, y_s]))
+        x_f2 = x_f - a[:fib_size]
+        y_f = pc_jac(jnp.concatenate([x_f2, jnp.zeros(shell_size, x.dtype)]))[:fib_size]
+        a2 = mv(jnp.concatenate([y_f, jnp.zeros(shell_size, x.dtype)]))
+        x_s2 = x_s - a2[fib_size:]
+        y_s2 = pc_jac(jnp.concatenate([zf, x_s2]))[fib_size:]
+        return jnp.concatenate([y_f, y_s2])
+
+    pc = pc_sym if order == "sym" else pc_gs
+    t0 = time.perf_counter()
+    res = gmres(mv, rhs, precond=pc, tol=p.gmres_tol, restart=restart,
+                maxiter=300, debug=True)
+    iters = int(res.iters)
+    wall = time.perf_counter() - t0
+    print(f"[{label}] iters={iters} converged={bool(res.converged)} "
+          f"implicit={float(res.residual):.3e} true={float(res.residual_true):.3e} "
+          f"wall={wall:.1f}s", flush=True)
+    return res
+
+
+if __name__ == "__main__":
+    kind = sys.argv[1] if len(sys.argv) > 1 else "revolution"
+    mode = sys.argv[2] if len(sys.argv) > 2 else "all"
+    system, state = build_scene(kind)
+    if mode in ("all", "jacobi"):
+        print(f"=== {kind}: baseline block-Jacobi restart=60 ===", flush=True)
+        run_debug(system, state, 60, "jacobi")
+    if mode in ("all", "gs"):
+        print(f"=== {kind}: Gauss-Seidel shell-first ===", flush=True)
+        run_gs(system, state, 60, "gs-shell-first", order="shell_first")
+        print(f"=== {kind}: Gauss-Seidel fiber-first ===", flush=True)
+        run_gs(system, state, 60, "gs-fiber-first", order="fiber_first")
+        print(f"=== {kind}: symmetric sweep ===", flush=True)
+        run_gs(system, state, 60, "gs-sym", order="sym")

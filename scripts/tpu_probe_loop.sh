@@ -1,0 +1,24 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every ~8 min; on success write a marker file.
+# A real probe = device enumeration AND a small compiled matmul fetched to
+# host (the tunnel can enumerate while the remote AOT compiler is wedged).
+# Run in background: bash scripts/tpu_probe_loop.sh /tmp/tpu_up.marker
+MARKER="${1:-/tmp/tpu_up.marker}"
+LOG="${2:-/tmp/tpu_probe.log}"
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 300 python -c "
+import jax, numpy as np, jax.numpy as jnp
+d = jax.devices()
+y = np.asarray(jnp.ones((128,128)) @ jnp.ones((128,128)))
+print('PROBE_OK', d[0].platform, len(d), float(y[0,0]))
+" 2>/dev/null | grep PROBE_OK)
+  rc=$?
+  echo "$ts rc=$rc out=$out" >> "$LOG"
+  if [ -n "$out" ]; then
+    echo "$ts $out" > "$MARKER"
+    echo "$ts TPU UP (matmul verified)" >> "$LOG"
+    exit 0
+  fi
+  sleep 480
+done

@@ -188,6 +188,9 @@ class Params:
     kernel_impl: str = "exact"
     refine_pair_impl: str = "auto"
     ewald_min_sources: int = 2048
+    # coupled-solve preconditioner: "gs" (block Gauss-Seidel, shell-first
+    # coupling correction) or "jacobi" (the reference's independent blocks)
+    precond: str = "gs"
 
 
 @dataclass
@@ -599,6 +602,7 @@ def to_runtime_params(p: Params) -> runtime_params.Params:
         ewald_min_sources=p.ewald_min_sources,
         kernel_impl=p.kernel_impl,
         refine_pair_impl=p.refine_pair_impl,
+        precond=p.precond,
         dynamic_instability=runtime_params.DynamicInstability(
             **dataclasses.asdict(p.dynamic_instability)),
         periphery_binding=runtime_params.PeripheryBinding(

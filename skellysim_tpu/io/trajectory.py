@@ -436,13 +436,19 @@ def _scan_python(path: str):
 
 def build_index(path: str, use_native: bool = True):
     """Frame (offsets, times); written to `<path>.cindex` like the reference."""
-    # stat BEFORE scanning: a frame appended mid-scan must invalidate the index
-    mtime = os.stat(path).st_mtime
+    # stat BEFORE scanning: a frame appended mid-scan must invalidate the
+    # index. mtime is truncated to int like the reference (`reader.py:238`)
+    # so the reference's TrajectoryReader accepts our .cindex verbatim
+    # instead of rebuilding on a float-vs-int mtime mismatch; the extra
+    # "size" key (ignored by the reference reader) closes the 1-second
+    # append window whole-second mtimes can't see.
+    st = os.stat(path)
     res = _scan_native(path) if use_native else None
     if res is None:
         res = _scan_python(path)
     offsets, times = res
-    index = {"mtime": mtime, "offsets": offsets, "times": times}
+    index = {"mtime": int(st.st_mtime), "size": st.st_size,
+             "offsets": offsets, "times": times}
     with open(path + ".cindex", "wb") as fh:
         msgpack.dump(index, fh)
     return offsets, times
@@ -463,12 +469,16 @@ class TrajectoryReader:
         self.fiber_type = self.header["fiber_type"]
 
         index_file = path + ".cindex"
-        mtime = os.stat(path).st_mtime
+        st = os.stat(path)
         offsets = times = None
         if os.path.exists(index_file):
             with open(index_file, "rb") as fh:
                 index = msgpack.unpack(fh, raw=False)
-            if index.get("mtime") == mtime:
+            # "size" guards same-second appends that the reference's
+            # whole-second mtime comparison cannot detect; absent (a
+            # reference-reader-built index) it falls back to mtime alone
+            if (index.get("mtime") == int(st.st_mtime)
+                    and index.get("size", st.st_size) == st.st_size):
                 offsets, times = index["offsets"], index["times"]
         if offsets is None:
             offsets, times = build_index(path)

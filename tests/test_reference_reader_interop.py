@@ -1,0 +1,209 @@
+"""Trajectory interop proof using the REFERENCE's own reader as the oracle.
+
+Imports `/root/reference/src/skelly_sim/reader.py` (pure Python, read-only)
+and lets its `TrajectoryReader` read a trajectory written by OUR
+`TrajectoryWriter` — the definitive byte-compatibility check (VERDICT r4 #7),
+replacing re-stated schema expectations with the reference's actual decode
+path (`reader.py:198-355`).
+
+The reference module tree needs four tiny import shims for packages absent
+from this image (`toml`, `dataclass_utils`, `nptyping`,
+`function_generator`); they only satisfy module-level imports — all decode
+logic that runs is the reference's own.
+"""
+
+import sys
+import tomllib
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from skellysim_tpu.bodies import bodies as bd
+from skellysim_tpu.fibers import container as fc
+from skellysim_tpu.io import TrajectoryWriter
+from skellysim_tpu.params import Params
+from skellysim_tpu.periphery import periphery as peri
+from skellysim_tpu.system import System
+
+REF_SRC = "/root/reference/src"
+
+_STUBS = ("toml", "dataclass_utils", "nptyping", "function_generator")
+
+
+@pytest.fixture()
+def ref_reader_module():
+    """Import the reference's `skelly_sim.reader` with dependency shims,
+    cleaning all of it out of `sys.modules` afterwards."""
+    saved = {name: sys.modules.get(name)
+             for name in _STUBS + ("skelly_sim",)}
+
+    toml_stub = types.ModuleType("toml")
+    toml_stub.load = lambda f: tomllib.loads(f.read())
+
+    du_stub = types.ModuleType("dataclass_utils")
+    du_stub.check_type = lambda *a, **k: None
+
+    class _Subscriptable:
+        def __class_getitem__(cls, item):
+            return np.ndarray
+
+    npt_stub = types.ModuleType("nptyping")
+    npt_stub.NDArray = _Subscriptable
+    npt_stub.Shape = _Subscriptable
+    npt_stub.Float64 = float
+
+    fg_stub = types.ModuleType("function_generator")
+    fg_stub.FunctionGenerator = type("FunctionGenerator", (), {})
+
+    sys.modules.update({"toml": toml_stub, "dataclass_utils": du_stub,
+                        "nptyping": npt_stub, "function_generator": fg_stub})
+    sys.path.insert(0, REF_SRC)
+    try:
+        import skelly_sim.reader as ref_reader  # noqa: PLC0415
+        yield ref_reader
+    finally:
+        sys.path.remove(REF_SRC)
+        for name in list(sys.modules):
+            if name == "skelly_sim" or name.startswith("skelly_sim."):
+                del sys.modules[name]
+        for name, mod in saved.items():
+            if mod is None:
+                sys.modules.pop(name, None)
+            else:
+                sys.modules[name] = mod
+
+
+def _mixed_state():
+    """Fibers in two resolution buckets + shell + sphere/ellipsoid bodies —
+    the full wire surface, bucket-ordered internally but config-ordered on
+    the wire."""
+    rng = np.random.default_rng(7)
+    params = Params(eta=1.0, dt_initial=5e-3, t_final=1e-2, gmres_tol=1e-10,
+                    adaptive_timestep_flag=False)
+    system = System(params, shell_shape=peri.PeripheryShape(kind="generic"))
+
+    def fibgroup(nf, n, rank0):
+        x = np.cumsum(rng.standard_normal((nf, n, 3)) * 0.05, axis=1)
+        g = fc.make_group(x, lengths=1.0, bending_rigidity=0.01, radius=0.0125)
+        return g._replace(
+            tension=jnp.asarray(rng.standard_normal((nf, n))),
+            config_rank=jnp.arange(rank0, rank0 + nf))
+
+    fibers = (fibgroup(2, 16, 0), fibgroup(3, 24, 2))
+
+    def bodygroup(n_nodes, kind, rank0, nb):
+        nodes = rng.standard_normal((n_nodes, 3))
+        nodes /= np.linalg.norm(nodes, axis=1, keepdims=True)
+        g = bd.make_group(
+            np.broadcast_to(nodes[None], (nb, n_nodes, 3)),
+            nodes, np.full(n_nodes, 4 * np.pi / n_nodes),
+            position=rng.standard_normal((nb, 3)),
+            radius=np.full(nb, 1.0), kind=kind)
+        return g._replace(config_rank=jnp.arange(rank0, rank0 + nb))
+
+    bodies = (bodygroup(32, "sphere", 0, 1),
+              bodygroup(48, "ellipsoid", 1, 2))
+
+    n_shell = 20
+    shell_nodes = rng.standard_normal((n_shell, 3))
+    shell_nodes /= np.linalg.norm(shell_nodes, axis=1, keepdims=True)
+    eye = jnp.eye(3 * n_shell)
+    shell = peri.make_state(shell_nodes, -shell_nodes,
+                            np.full(n_shell, 4 * np.pi / n_shell), eye, eye)
+    shell = shell._replace(
+        density=jnp.asarray(rng.standard_normal(3 * n_shell)))
+
+    state = system.make_state(fibers=fibers, shell=shell, bodies=bodies)
+    return system, state
+
+
+def test_reference_reader_reads_our_trajectory(tmp_path, ref_reader_module):
+    toml_file = tmp_path / "skelly_config.toml"
+    toml_file.write_text('[params]\neta = 1.0\ndt_initial = 5e-3\n')
+    path = str(tmp_path / "skelly_sim.out")
+
+    system, state = _mixed_state()
+    rng_state = [["main", "0:1:2"]]
+    with TrajectoryWriter(path) as tw:
+        tw.write_frame(state, rng_state=rng_state)
+        tw.write_frame(state._replace(time=state.time + state.dt))
+
+    tr = ref_reader_module.TrajectoryReader(str(toml_file))
+    assert tr.trajectory_version == 1
+    assert tr.fiber_type == 1          # FIBER_TYPE_FINITE_DIFFERENCE
+    assert len(tr) == 2
+    assert tr.times == pytest.approx([0.0, 5e-3])
+
+    tr.load_frame(0)
+    assert set(tr.keys()) >= {"time", "dt", "rng_state", "fibers", "bodies",
+                              "shell"}
+    assert tr["time"] == pytest.approx(0.0)
+    assert tr["dt"] == pytest.approx(5e-3)
+    assert tr["rng_state"] == rng_state
+
+    # fibers come back in config order, bucket-merged, through the
+    # reference's __eigen__ decode (points along rows)
+    fibs = tr["fibers"]
+    assert len(fibs) == 5
+    expect = [(0, 0), (0, 1), (1, 0), (1, 1), (1, 2)]  # (bucket, slot)
+    for cfg_rank, (b, i) in enumerate(expect):
+        g = state.fibers[b]
+        assert fibs[cfg_rank]["n_nodes_"] == g.x.shape[1]
+        np.testing.assert_array_equal(fibs[cfg_rank]["x_"],
+                                      np.asarray(g.x[i], dtype=np.float64))
+        np.testing.assert_array_equal(
+            fibs[cfg_rank]["tension_"],
+            np.asarray(g.tension[i], dtype=np.float64))
+        assert fibs[cfg_rank]["minus_clamped_"] == bool(g.minus_clamped[i])
+
+    # bodies flatten [spheres, deformable, ellipsoids] in the reference's
+    # __getitem__; config order survives within each kind list
+    bods = tr["bodies"]
+    assert len(bods) == 3
+    np.testing.assert_array_equal(
+        bods[0]["position_"],
+        np.asarray(state.bodies[0].position[0], dtype=np.float64))
+    for j in range(2):
+        np.testing.assert_array_equal(
+            bods[1 + j]["position_"],
+            np.asarray(state.bodies[1].position[j], dtype=np.float64))
+        assert bods[1 + j]["orientation_"].shape == (4,)
+
+    np.testing.assert_array_equal(
+        tr["shell"]["solution_vec_"],
+        np.asarray(state.shell.density, dtype=np.float64))
+
+    # second frame via the reference's index path
+    tr.load_frame(1)
+    assert tr["time"] == pytest.approx(5e-3)
+
+
+def test_reference_reader_uses_our_cindex(tmp_path, ref_reader_module):
+    """Our native `.cindex` side file is accepted verbatim by the reference
+    reader (same {mtime, offsets, times} schema, `reader.py:293-329`) —
+    it must NOT fall back to a rebuild."""
+    from skellysim_tpu.io import TrajectoryReader as OurReader
+
+    toml_file = tmp_path / "skelly_config.toml"
+    toml_file.write_text('[params]\neta = 1.0\n')
+    path = str(tmp_path / "skelly_sim.out")
+
+    system, state = _mixed_state()
+    with TrajectoryWriter(path) as tw:
+        for k in range(3):
+            tw.write_frame(state._replace(time=state.time + k * state.dt))
+
+    ours = OurReader(path)           # builds + persists the .cindex
+    our_index = (tmp_path / "skelly_sim.out.cindex").read_bytes()
+    assert len(ours) == 3
+
+    tr = ref_reader_module.TrajectoryReader(str(toml_file))
+    assert len(tr) == 3
+    assert tr.times == pytest.approx(ours.times)
+    # byte-identical index => the reference reader reused ours, not rebuilt
+    assert (tmp_path / "skelly_sim.out.cindex").read_bytes() == our_index
+    tr.load_frame(2)
+    assert tr["time"] == pytest.approx(2 * float(state.dt))
