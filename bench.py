@@ -136,7 +136,15 @@ def _short_err(e: BaseException, limit: int = 200) -> str:
 
 
 def _probe_backend_once(timeout_s: float):
-    code = "import jax; print('BACKEND=' + jax.default_backend())"
+    # enumeration alone lies: the axon tunnel has been observed answering
+    # jax.default_backend() while the remote AOT compiler hangs forever
+    # (r5). A backend only counts if a small compiled matmul makes it back
+    # to the host.
+    code = ("import jax, numpy as np, jax.numpy as jnp;"
+            "b = jax.default_backend();"
+            "x = jnp.ones((128, 128));"
+            "np.asarray(x @ x);"
+            "print('BACKEND=' + b)")
     try:
         p = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, timeout=timeout_s)
@@ -547,8 +555,11 @@ def _bench_ewald_crossover(on_acc, dtype):
     from skellysim_tpu.ops import ewald as ew
     from skellysim_tpu.ops import kernels
 
+    # the CPU ladder reaches past the measured dense/Ewald crossover
+    # (~40k nodes on CPU, scripts/ewald_ladder.py) so the artifact records
+    # a speedup_vs_dense > 1 row even on fallback runs
     sizes = ((1600, 10000, 40000, 160000, 640000) if on_acc
-             else (1600, 6400))
+             else (1600, 6400, 16000, 40000))
     rng = np.random.default_rng(100)
     table = {}
     for n in sizes:
@@ -903,6 +914,24 @@ def _parent_main():
         if rem < 50:
             extra[f"group_{name}"] = {"skipped_budget": int(rem)}
             continue
+        if force_cpu and rem > 180:
+            # the tunnel is intermittent: one quick re-probe before each
+            # group can promote the REST of the run back to TPU mid-bench
+            # (VERDICT r4 #1) instead of finishing a whole round on the
+            # CPU fallback because of a wedge at t=0
+            re_backend = _probe_backend_once(timeout_s=60.0)
+            if re_backend not in (None, "cpu"):
+                force_cpu = False
+                probed = backend = re_backend
+                extra["probe_promoted"] = {"group": name,
+                                           "backend": re_backend}
+                if i == 0:
+                    # nothing has run yet — the whole bench is TPU-clean;
+                    # later promotions keep the flags because earlier
+                    # groups' numbers in `extra` were measured on CPU
+                    extra.pop("downscaled", None)
+                    extra.pop("downscale_reason", None)
+            rem = _remaining()  # a wedged re-probe burned up to 60 s
         wsum = sum(w for _, _, w in GROUPS[i:])
         t_g = max(60.0, min(rem - 15.0, rem * weight / wsum))
         out_path = os.path.join(here, f".bench_{name}.json")
@@ -979,7 +1008,13 @@ def _parent_main():
     else:
         line = {"metric": "bench_failed", "value": 0.0, "unit": "",
                 "vs_baseline": 0.0}
-    if force_cpu:
+    # the headline inherits the downscale flag from the SECTION it quotes
+    # (a mid-run TPU promotion must not launder a CPU-measured headline),
+    # plus the run-level flag if the bench ended in CPU-fallback mode
+    src = (mixed if line["metric"].endswith("mixed_wall_s")
+           else coupled if "wall_s" in line["metric"]
+           else extra.get("stokeslet_f32") or {})
+    if force_cpu or src.get("downscaled"):
         line["downscaled"] = True
     line["total_s"] = round(time.monotonic() - _T_START, 1)
     line["backend"] = backend

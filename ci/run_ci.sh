@@ -15,6 +15,9 @@ TIER="${1:-fast}"
 echo "== lint: byte-compile every source file =="
 python -m compileall -q skellysim_tpu tests scripts ci bench.py __graft_entry__.py
 
+echo "== docs: config reference in sync with the schema =="
+JAX_PLATFORMS=cpu python scripts/gen_config_reference.py --check
+
 echo "== unit/integration tests (tier: $TIER) =="
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 case "$TIER" in

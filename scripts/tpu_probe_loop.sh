@@ -7,13 +7,14 @@ MARKER="${1:-/tmp/tpu_up.marker}"
 LOG="${2:-/tmp/tpu_probe.log}"
 while true; do
   ts=$(date -u +%FT%TZ)
-  out=$(timeout 300 python -c "
+  raw=$(timeout 300 python -c "
 import jax, numpy as np, jax.numpy as jnp
 d = jax.devices()
 y = np.asarray(jnp.ones((128,128)) @ jnp.ones((128,128)))
 print('PROBE_OK', d[0].platform, len(d), float(y[0,0]))
-" 2>/dev/null | grep PROBE_OK)
-  rc=$?
+" 2>/dev/null)
+  rc=$?   # timeout/python status (124 = compile hang), not grep's
+  out=$(echo "$raw" | grep PROBE_OK)
   echo "$ts rc=$rc out=$out" >> "$LOG"
   if [ -n "$out" ]; then
     echo "$ts $out" > "$MARKER"

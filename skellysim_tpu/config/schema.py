@@ -181,8 +181,9 @@ class Params:
     pair_evaluator: str = "TPU"
     fiber_type: str = "FiniteDifference"
     # TPU-specific extensions (no reference analogue; see runtime Params):
-    # solver precision tier, Ewald evaluator tolerance, pairwise tile, and
-    # the mixed solver's refinement tile
+    # solver precision tier ("full"/"mixed"/"auto" — auto = mixed on
+    # accelerators for f64 states, full elsewhere), Ewald evaluator
+    # tolerance, pairwise tile, and the mixed solver's refinement tile
     solver_precision: str = "full"
     ewald_tol: float = 1e-6
     kernel_impl: str = "exact"

@@ -87,6 +87,12 @@ class Params:
     #             preconditioner, iterative refinement to gmres_tol
     #             (solver.gmres_ir); reaches the reference's 1e-10 tolerance
     #             with the hot loop at accelerator-native f32
+    #   "auto"  — "mixed" exactly where it pays: f64 states on an
+    #             accelerator backend (where native f64 flows are emulated
+    #             and LU is f32-only); "full" otherwise. On CPU, measured
+    #             mixed/full ratios are 2-3.5x SLOWER (f32 buys no CPU
+    #             flops but refinement sweeps still repeat the solve), so
+    #             the fallback is automatic rather than documented-only
     solver_precision: str = "full"
     # inner (f32) GMRES tolerance per refinement sweep in "mixed" mode;
     # each sweep contracts the error by about this factor. A loose inner

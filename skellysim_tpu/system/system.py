@@ -122,10 +122,10 @@ class System:
             raise ValueError(
                 f"unknown pair_evaluator {params.pair_evaluator!r}; "
                 "runtime values are 'direct', 'ring', or 'ewald'")
-        if params.solver_precision not in ("full", "mixed"):
+        if params.solver_precision not in ("full", "mixed", "auto"):
             raise ValueError(
                 f"unknown solver_precision {params.solver_precision!r}; "
-                "use 'full' or 'mixed'")
+                "use 'full', 'mixed', or 'auto'")
         if params.kernel_impl not in ("exact", "mxu", "df", "pallas"):
             # the kernel seam's else-branch would silently run "exact" for a
             # typo'd name — reject at construction like the other knobs
@@ -161,6 +161,23 @@ class System:
         if impl == "auto":
             return "df" if jax.default_backend() != "cpu" else "exact"
         return impl
+
+    def _precision_for(self, state) -> str:
+        """Resolve Params.solver_precision for one state ("full"/"mixed").
+
+        "auto" picks "mixed" only where the tier pays: f64 states on an
+        accelerator backend, where native-f64 flows hit the emulation
+        cliff and LU is f32-only. On CPU, measured mixed/full ratios are
+        2-3.5x SLOWER (refinement sweeps repeat the solve; f32 buys no
+        CPU flops), so "auto" falls back to "full" there. Host-side
+        static dispatch: dtype and backend are trace-time constants, so
+        each resolution compiles its own program."""
+        p = self.params.solver_precision
+        if p != "auto":
+            return p
+        if state.time.dtype != jnp.float64:
+            return "full"
+        return "mixed" if jax.default_backend() != "cpu" else "full"
 
     def _ring_active(self) -> bool:
         ring = self.params.pair_evaluator == "ring"
@@ -403,13 +420,14 @@ class System:
         nf_nodes, ns_nodes, nb_nodes = self._counts(state)
         v_all = jnp.zeros_like(r_all)
 
-        precond_dtype = (jnp.float32 if p.solver_precision == "mixed" else None)
+        precision = self._precision_for(state)
+        precond_dtype = (jnp.float32 if precision == "mixed" else None)
         # mixed mode evaluates the (f64) prep flows through the refinement
         # tile — on accelerators that is double-float f32 (~1e-14, sets the
         # RHS accuracy floor) instead of the emulated-f64 cliff; those flows
         # also stay DENSE (plan withheld below) so ewald_tol cannot cap the
         # RHS accuracy
-        refine_prep = (p.solver_precision == "mixed"
+        refine_prep = (precision == "mixed"
                        and state.time.dtype == jnp.float64)
         impl_flow = self._refine_impl if refine_prep else p.kernel_impl
         prep_plan = None if refine_prep else ewald_plan
@@ -696,7 +714,7 @@ class System:
             raise ValueError("state has no implicit components to solve")
         rhs = jnp.concatenate(rhs_parts)
 
-        if p.solver_precision == "mixed":
+        if self._precision_for(state) == "mixed":
             # f64 state/assembly/refinement residuals; the Krylov loop's
             # expensive interior (kernel flows, shell/body dense ops, LU
             # preconditioners) evaluates through f32 copies via the lo seam

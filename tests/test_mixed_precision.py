@@ -67,6 +67,39 @@ def test_mixed_coupled_solve_hits_reference_tol():
     assert float(info.residual_true) <= 1e-10
 
 
+def test_auto_precision_falls_back_to_full_on_cpu(monkeypatch):
+    """solver_precision="auto" resolves to "full" on the CPU backend (where
+    mixed is measured 2-3.5x slower): the preconditioner factors stay f64
+    and the plain-GMRES path runs. On an accelerator backend the same
+    config resolves to "mixed" for f64 states and "full" for f32 states
+    (`System._precision_for`) — pinned here by faking the backend name,
+    since CI has no accelerator."""
+    dtype = jnp.float64
+    shell, shape, bodies = make_coupled_parts(192, 96, dtype)
+    params = Params(eta=1.0, dt_initial=0.1, t_final=1.0, gmres_tol=1e-10,
+                    solver_precision="auto", adaptive_timestep_flag=False)
+    system = System(params, shell_shape=shape)
+    state = system.make_state(shell=shell, bodies=bodies)
+    assert system._precision_for(state) == "full"
+    _, _, body_caches, _, _ = system._prep(state)
+    assert body_caches[0].lu.dtype == jnp.float64
+
+    def cast32(tree):
+        return jax.tree.map(
+            lambda x: x.astype(jnp.float32)
+            if hasattr(x, "dtype") and x.dtype == jnp.float64 else x, tree)
+
+    state32 = system.make_state(shell=cast32(shell), bodies=cast32(bodies))
+    assert system._precision_for(state32) == "full"
+
+    # accelerator branch: f64 -> mixed, f32 -> still full (the dtype guard)
+    from skellysim_tpu.system import system as system_mod
+
+    monkeypatch.setattr(system_mod.jax, "default_backend", lambda: "tpu")
+    assert system._precision_for(state) == "mixed"
+    assert system._precision_for(state32) == "full"
+
+
 def test_mixed_matches_full_solution():
     """Mixed and full f64 modes agree to well below the fiber dynamics scale."""
     dtype = jnp.float64
