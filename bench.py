@@ -630,6 +630,11 @@ def _group_kernels(extra, ck, on_acc):
     try:
         rate32 = _kernel_rate(jnp.float32, n32)
         extra["stokeslet_f32"] = {"n": n32, "gpairs_per_s": round(rate32 / 1e9, 4)}
+        if not on_acc:
+            # mark like the other groups: a CPU rate at the 8x-smaller n
+            # must never pass for a chip number, even if a later re-probe
+            # promotes the rest of the run (the headline inherits this flag)
+            _mark_downscaled(extra["stokeslet_f32"], _CPU_FALLBACK)
     except Exception as e:
         extra["stokeslet_f32"] = {"error": _short_err(e)}
     try:

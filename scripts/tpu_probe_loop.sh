@@ -7,7 +7,7 @@ MARKER="${1:-/tmp/tpu_up.marker}"
 LOG="${2:-/tmp/tpu_probe.log}"
 while true; do
   ts=$(date -u +%FT%TZ)
-  raw=$(timeout 300 python -c "
+  raw=$(timeout -k 10 300 python -c "
 import jax, numpy as np, jax.numpy as jnp
 d = jax.devices()
 y = np.asarray(jnp.ones((128,128)) @ jnp.ones((128,128)))

@@ -16,6 +16,7 @@ from __future__ import annotations
 import os
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .bodies import bodies as bd
@@ -218,7 +219,14 @@ def build_simulation(config, config_dir: str = ".", dtype=jnp.float64,
                       "given to build_simulation; using the direct evaluator")
     shell, shape = (None, None)
     if getattr(config, "periphery", None) is not None:
-        pdt = jnp.float32 if params.solver_precision == "mixed" else None
+        # "auto" resolves like System._precision_for: mixed (=> f32 M_inv,
+        # halving the shell preconditioner's HBM) for f64 states on an
+        # accelerator backend, full elsewhere
+        mixed = (params.solver_precision == "mixed"
+                 or (params.solver_precision == "auto"
+                     and dtype == jnp.float64
+                     and jax.default_backend() != "cpu"))
+        pdt = jnp.float32 if mixed else None
         shell, shape = build_periphery(config.periphery, config_dir, dtype,
                                        precond_dtype=pdt)
 

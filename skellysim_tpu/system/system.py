@@ -654,10 +654,17 @@ class System:
             r_all = self._node_positions(state, body_caches)
             r_fibbody = jnp.concatenate(
                 [r_all[:nf_nodes], r_all[nf_nodes + ns_nodes:]], axis=0)
+            # the flow runs entirely in the shell's own float dtype (the
+            # actual operand dtype — NOT state.time, which can be f64 on
+            # f32 states, see the lo_dtype note in _apply_matvec): in
+            # mixed mode `state` is the f32 lo copy, and a mixed
+            # f64-density/f32-state eval would change dtypes mid-ring-carry;
+            # a preconditioner only approximates, so f32 flow is plenty
             v_corr = self._shell_flow(state, r_fibbody,
-                                      y_shell.astype(x_flat.dtype),
+                                      y_shell.astype(state.shell.nodes.dtype),
                                       ewald_plan=ewald_plan,
-                                      ewald_anchors=ewald_anchors)
+                                      ewald_anchors=ewald_anchors
+                                      ).astype(x_flat.dtype)
 
         res = []
         off = 0
