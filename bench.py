@@ -509,6 +509,7 @@ def _bench_fiber_shell(kind, n_fibers, fiber_nodes, shell_n, dtype, tol,
 def _bench_640k_matvec(n_fibers, n_nodes, dtype, trials=2):
     """BASELINE #4: dense Stokeslet mobility matvec at the 10k-fiber scale
     (640k source=target nodes) — the measurement behind the FMM go/no-go."""
+    import jax
     import jax.numpy as jnp
 
     from skellysim_tpu.ops import kernels
@@ -539,6 +540,16 @@ def _bench_640k_matvec(n_fibers, n_nodes, dtype, trials=2):
         rate = max(rate, rate_mxu)
     except Exception as e:
         out["mxu_error"] = _short_err(e)
+    if dtype != np.float64 and jax.default_backend() != "cpu":
+        try:
+            # fused VMEM Pallas tile (round 5: ~3.4x the XLA path on v5e)
+            rate_p = _rate(lambda: kernels.stokeslet_direct(r, r, f, 1.0,
+                                                            impl="pallas"),
+                           n * n, trials=trials)
+            out["gpairs_per_s_pallas"] = round(rate_p / 1e9, 3)
+            rate = max(rate, rate_p)
+        except Exception as e:
+            out["pallas_error"] = _short_err(e)
     wall = n * n / rate
     out.update({"wall_s_per_matvec": round(wall, 3),
                 "projected_v5p8_wall_s": round(wall / 8, 3),
@@ -688,6 +699,18 @@ def _group_kernels(extra, ck, on_acc):
             rate32 = max(rate32, prate)
         except Exception as e:
             extra["stokeslet_f32_pallas"] = {"error": _short_err(e)}
+        try:
+            from skellysim_tpu.ops.pallas_kernels import stresslet_pallas
+
+            rng = np.random.default_rng(2)
+            r = jnp.asarray(rng.uniform(-5, 5, (n32, 3)), dtype=jnp.float32)
+            s = jnp.asarray(rng.standard_normal((n32, 3, 3)),
+                            dtype=jnp.float32)
+            srate = _rate(lambda: stresslet_pallas(r, r, s, 1.0), n32 * n32)
+            extra["stresslet_f32_pallas"] = {
+                "gpairs_per_s": round(srate / 1e9, 4)}
+        except Exception as e:
+            extra["stresslet_f32_pallas"] = {"error": _short_err(e)}
         ck()
 
     # MFU estimate against the chip's dense peak (bf16 for TPUs)

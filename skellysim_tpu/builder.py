@@ -219,13 +219,12 @@ def build_simulation(config, config_dir: str = ".", dtype=jnp.float64,
                       "given to build_simulation; using the direct evaluator")
     shell, shape = (None, None)
     if getattr(config, "periphery", None) is not None:
-        # "auto" resolves like System._precision_for: mixed (=> f32 M_inv,
-        # halving the shell preconditioner's HBM) for f64 states on an
-        # accelerator backend, full elsewhere
-        mixed = (params.solver_precision == "mixed"
-                 or (params.solver_precision == "auto"
-                     and dtype == jnp.float64
-                     and jax.default_backend() != "cpu"))
+        # mixed mode gets an f32 M_inv, halving the shell preconditioner's
+        # HBM; one policy shared with System._precision_for
+        from .params import resolve_precision
+
+        mixed = resolve_precision(params.solver_precision,
+                                  dtype == jnp.float64) == "mixed"
         pdt = jnp.float32 if mixed else None
         shell, shape = build_periphery(config.periphery, config_dir, dtype,
                                        precond_dtype=pdt)

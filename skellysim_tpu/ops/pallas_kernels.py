@@ -26,6 +26,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -34,8 +35,15 @@ from jax.experimental.pallas import tpu as pltpu
 # exactly 0 in f32, small enough that r^2 stays finite
 _PAD_SENTINEL = 1e18
 
+# Tile shapes swept on a v5 lite chip (round 5): stokeslet peaks at
+# (256, 1024) ~53 Gpairs/s, stresslet at (128, 2048) ~48 Gpairs/s — the
+# stresslet's 9-row source tile wants a wider lane dim at a shorter target
+# tile to fit VMEM. Larger source tiles (512x2048+) exceed VMEM and fail to
+# compile.
 DEFAULT_TILE_T = 256
-DEFAULT_TILE_S = 512
+DEFAULT_TILE_S = 1024
+STRESSLET_TILE_T = 128
+STRESSLET_TILE_S = 2048
 
 
 def _pad_to(a, n, axis, value=0.0):
@@ -99,19 +107,23 @@ def stokeslet_pallas(r_src, r_trg, f_src, eta, *, tile_t: int = DEFAULT_TILE_T,
     f_T = _pad_to(f_src.T, ns, axis=1)
 
     grid = (nt // tile_t, ns // tile_s)
+    # index-map zeros must be np.int32: under jax_enable_x64 a literal 0
+    # traces as i64 while grid indices stay i32, and Mosaic rejects the
+    # mixed-type index map (remote-compile HTTP 500 on this backend)
+    z = np.int32(0)
     u_T = pl.pallas_call(
         _stokeslet_kernel,
         out_shape=jax.ShapeDtypeStruct((3, nt), dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((3, tile_t), lambda i, j: (0, i),
+            pl.BlockSpec((3, tile_t), lambda i, j: (z, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, tile_s), lambda i, j: (0, j),
+            pl.BlockSpec((3, tile_s), lambda i, j: (z, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, tile_s), lambda i, j: (0, j),
+            pl.BlockSpec((3, tile_s), lambda i, j: (z, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((3, tile_t), lambda i, j: (0, i),
+        out_specs=pl.BlockSpec((3, tile_t), lambda i, j: (z, i),
                                memory_space=pltpu.VMEM),
         cost_estimate=pl.CostEstimate(
             flops=22 * nt * ns, bytes_accessed=4 * 3 * (nt + 2 * ns + nt),
@@ -157,8 +169,8 @@ def _stresslet_kernel(trg_ref, src_ref, s_ref, out_ref):
 
 
 @partial(jax.jit, static_argnames=("tile_t", "tile_s", "interpret"))
-def stresslet_pallas(r_dl, r_trg, f_dl, eta, *, tile_t: int = DEFAULT_TILE_T,
-                     tile_s: int = DEFAULT_TILE_S, interpret: bool = False):
+def stresslet_pallas(r_dl, r_trg, f_dl, eta, *, tile_t: int = STRESSLET_TILE_T,
+                     tile_s: int = STRESSLET_TILE_S, interpret: bool = False):
     """Singular stresslet sum as a fused Pallas kernel.
 
     Same contract as `ops.kernels.stresslet_direct`: ``f_dl`` is [n_src, 3, 3].
@@ -176,19 +188,20 @@ def stresslet_pallas(r_dl, r_trg, f_dl, eta, *, tile_t: int = DEFAULT_TILE_T,
     s_T = _pad_to(f_dl.reshape(n_src, 9).T, ns, axis=1)
 
     grid = (nt // tile_t, ns // tile_s)
+    z = np.int32(0)  # see stokeslet_pallas: i64/i32 index-map mix breaks Mosaic
     u_T = pl.pallas_call(
         _stresslet_kernel,
         out_shape=jax.ShapeDtypeStruct((3, nt), dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((3, tile_t), lambda i, j: (0, i),
+            pl.BlockSpec((3, tile_t), lambda i, j: (z, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, tile_s), lambda i, j: (0, j),
+            pl.BlockSpec((3, tile_s), lambda i, j: (z, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((9, tile_s), lambda i, j: (0, j),
+            pl.BlockSpec((9, tile_s), lambda i, j: (z, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((3, tile_t), lambda i, j: (0, i),
+        out_specs=pl.BlockSpec((3, tile_t), lambda i, j: (z, i),
                                memory_space=pltpu.VMEM),
         cost_estimate=pl.CostEstimate(
             flops=40 * nt * ns, bytes_accessed=4 * (3 * nt + 12 * ns + 3 * nt),

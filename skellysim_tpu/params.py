@@ -137,3 +137,22 @@ class Params:
     periphery_binding: PeripheryBinding = field(default_factory=PeripheryBinding)
     fiber_periphery_interaction: FiberPeripheryInteraction = field(
         default_factory=FiberPeripheryInteraction)
+
+
+def resolve_precision(solver_precision: str, is_f64: bool) -> str:
+    """Resolve Params.solver_precision to a concrete "full"/"mixed".
+
+    "auto" picks "mixed" only where the tier pays: f64 states on an
+    accelerator backend, where native-f64 flows hit the emulation cliff and
+    LU is f32-only; on CPU measured mixed/full ratios are 2-3.5x SLOWER, so
+    "auto" stays "full" there. Shared by `System._precision_for` (per-state)
+    and `builder.build_simulation` (choosing the shell preconditioner dtype
+    before any state exists) so the policy cannot drift between them.
+    """
+    if solver_precision != "auto":
+        return solver_precision
+    if not is_f64:
+        return "full"
+    import jax
+
+    return "mixed" if jax.default_backend() != "cpu" else "full"

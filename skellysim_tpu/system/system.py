@@ -165,19 +165,13 @@ class System:
     def _precision_for(self, state) -> str:
         """Resolve Params.solver_precision for one state ("full"/"mixed").
 
-        "auto" picks "mixed" only where the tier pays: f64 states on an
-        accelerator backend, where native-f64 flows hit the emulation
-        cliff and LU is f32-only. On CPU, measured mixed/full ratios are
-        2-3.5x SLOWER (refinement sweeps repeat the solve; f32 buys no
-        CPU flops), so "auto" falls back to "full" there. Host-side
-        static dispatch: dtype and backend are trace-time constants, so
-        each resolution compiles its own program."""
-        p = self.params.solver_precision
-        if p != "auto":
-            return p
-        if state.time.dtype != jnp.float64:
-            return "full"
-        return "mixed" if jax.default_backend() != "cpu" else "full"
+        Policy lives in `params.resolve_precision`. Host-side static
+        dispatch: dtype and backend are trace-time constants, so each
+        resolution compiles its own program."""
+        from ..params import resolve_precision
+
+        return resolve_precision(self.params.solver_precision,
+                                 state.time.dtype == jnp.float64)
 
     def _ring_active(self) -> bool:
         ring = self.params.pair_evaluator == "ring"

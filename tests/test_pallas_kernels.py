@@ -92,3 +92,19 @@ def test_pallas_reachable_through_kernel_seam():
 
     with pytest.raises(ValueError):
         System(Params(kernel_impl="palas", adaptive_timestep_flag=False))
+
+
+def test_pallas_seam_f64_falls_back_to_exact():
+    """The pallas tier is f32-only by contract: f64 inputs through the
+    dispatch take the exact XLA path bit-for-bit (Mosaic has no f64 on
+    TPU; the accuracy tiers are "exact"/"df")."""
+    rng = np.random.default_rng(7)
+    r = jnp.asarray(rng.uniform(-2, 2, (64, 3)), dtype=jnp.float64)
+    f = jnp.asarray(rng.standard_normal((64, 3)), dtype=jnp.float64)
+    u = np.asarray(kernels.stokeslet_direct(r, r, f, 1.1, impl="pallas"))
+    ref = np.asarray(kernels.stokeslet_direct(r, r, f, 1.1))
+    np.testing.assert_array_equal(u, ref)
+    S = jnp.asarray(rng.standard_normal((64, 3, 3)), dtype=jnp.float64)
+    uS = np.asarray(kernels.stresslet_direct(r, r, S, 1.1, impl="pallas"))
+    refS = np.asarray(kernels.stresslet_direct(r, r, S, 1.1))
+    np.testing.assert_array_equal(uS, refS)
