@@ -225,6 +225,22 @@ def oseen_block(trg, src, density, eta, reg, epsilon_distance):
     return jnp.einsum("ts,sk->tk", fr, density) + jnp.einsum("ts,tsk->tk", gr * df, d)
 
 
+def pallas_impl_for(impl: str, *arrays) -> str:
+    """Resolve ``impl="pallas"`` against the pallas tier's dtype contract.
+
+    The pallas tier is f32-only: any f64 operand (full-precision solves,
+    mixed-mode refinement flows that resolve to a concrete impl name)
+    downgrades to the exact XLA path, mirroring how the f64 accuracy tier
+    stays off the MXU tiles. One predicate shared by the direct seam here
+    and the ring evaluator (`parallel.ring`) so the contract cannot drift
+    between them. Non-pallas names pass through untouched.
+    """
+    if impl == "pallas" and any(jnp.asarray(a).dtype == jnp.float64
+                                for a in arrays):
+        return "exact"
+    return impl
+
+
 @partial(jax.jit, static_argnames=("block_size", "source_block", "impl"))
 def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096,
                      source_block: int | None = None, impl: str = "exact"):
@@ -248,21 +264,15 @@ def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096,
         return stokeslet_direct_df(
             r_src, r_trg, f_src, eta, block_size=min(block_size, 1024),
             source_block=source_block or 4096)
+    impl = pallas_impl_for(impl, r_trg, r_src, f_src)
     if impl == "pallas":
         # fused VMEM-tile kernel (`ops.pallas_kernels`); Mosaic lowering on
         # real TPUs (measured ~53 Gpairs/s vs ~15 for the XLA path on v5e),
-        # interpret mode on CPU (tests / fallback). The pallas tier is
-        # f32-only by contract — f64 callers (full-precision solves,
-        # mixed-mode refinement flows that resolve to a concrete impl name)
-        # get the exact XLA path, mirroring how the f64 accuracy tier stays
-        # off the MXU tiles.
-        if not any(jnp.asarray(a).dtype == jnp.float64
-                   for a in (r_trg, r_src, f_src)):
-            from .pallas_kernels import stokeslet_pallas
+        # interpret mode on CPU (tests / fallback).
+        from .pallas_kernels import stokeslet_pallas
 
-            return stokeslet_pallas(r_src, r_trg, f_src, eta,
-                                    interpret=jax.default_backend() == "cpu")
-        impl = "exact"
+        return stokeslet_pallas(r_src, r_trg, f_src, eta,
+                                interpret=jax.default_backend() == "cpu")
     factor = 1.0 / (8.0 * math.pi)
     if impl == "mxu":
         u = _pair_sum(stokeslet_block_mxu, r_trg, (r_src, f_src),
@@ -291,16 +301,13 @@ def stresslet_direct(r_dl, r_trg, f_dl, eta, *, block_size: int = 4096,
         return stresslet_direct_df(
             r_dl, r_trg, f_dl, eta, block_size=min(block_size, 1024),
             source_block=source_block or 4096)
+    impl = pallas_impl_for(impl, r_trg, r_dl, f_dl)
     if impl == "pallas":
-        # see `stokeslet_direct`'s pallas branch: f32-only tier, f64 falls
-        # back to the exact XLA path
-        if not any(jnp.asarray(a).dtype == jnp.float64
-                   for a in (r_trg, r_dl, f_dl)):
-            from .pallas_kernels import stresslet_pallas
+        # see `stokeslet_direct`'s pallas branch
+        from .pallas_kernels import stresslet_pallas
 
-            return stresslet_pallas(r_dl, r_trg, f_dl, eta,
-                                    interpret=jax.default_backend() == "cpu")
-        impl = "exact"
+        return stresslet_pallas(r_dl, r_trg, f_dl, eta,
+                                interpret=jax.default_backend() == "cpu")
     factor = 1.0 / (8.0 * math.pi)
     if impl == "mxu":
         u = _pair_sum(stresslet_block_mxu, r_trg, (r_dl, f_dl),

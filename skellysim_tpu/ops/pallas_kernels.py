@@ -46,6 +46,16 @@ STRESSLET_TILE_T = 128
 STRESSLET_TILE_S = 2048
 
 
+def _vma(*arrays):
+    """Union of the operands' varying-mesh-axes: pallas_call under shard_map
+    must declare which mesh axes its output varies over (jax >= 0.9
+    check_vma); outside shard_map every vma is empty and this is a no-op."""
+    out = frozenset()
+    for a in arrays:
+        out |= getattr(jax.typeof(a), "vma", frozenset())
+    return out
+
+
 def _pad_to(a, n, axis, value=0.0):
     pad = n - a.shape[axis]
     if pad == 0:
@@ -113,7 +123,10 @@ def stokeslet_pallas(r_src, r_trg, f_src, eta, *, tile_t: int = DEFAULT_TILE_T,
     z = np.int32(0)
     u_T = pl.pallas_call(
         _stokeslet_kernel,
-        out_shape=jax.ShapeDtypeStruct((3, nt), dtype),
+        # vma: inside shard_map (the ring evaluator's tile) the output varies
+        # over whatever mesh axes the operands do; outside it's frozenset()
+        out_shape=jax.ShapeDtypeStruct((3, nt), dtype, vma=_vma(trg_T, src_T,
+                                                               f_T)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((3, tile_t), lambda i, j: (z, i),
@@ -191,7 +204,8 @@ def stresslet_pallas(r_dl, r_trg, f_dl, eta, *, tile_t: int = STRESSLET_TILE_T,
     z = np.int32(0)  # see stokeslet_pallas: i64/i32 index-map mix breaks Mosaic
     u_T = pl.pallas_call(
         _stresslet_kernel,
-        out_shape=jax.ShapeDtypeStruct((3, nt), dtype),
+        out_shape=jax.ShapeDtypeStruct((3, nt), dtype,
+                                       vma=_vma(trg_T, src_T, s_T)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((3, tile_t), lambda i, j: (z, i),
@@ -211,3 +225,24 @@ def stresslet_pallas(r_dl, r_trg, f_dl, eta, *, tile_t: int = STRESSLET_TILE_T,
 
     factor = 1.0 / (8.0 * math.pi)
     return u_T.T[:n_trg] * (factor / eta)
+
+
+# eta chosen so stokeslet_pallas's trailing (1/(8 pi))/eta scale is exactly
+# 1.0: these block entry points return the UNSCALED pair sum, matching the
+# `ops.kernels.stokeslet_block` contract (the caller — the ring evaluator —
+# applies 1/(8 pi eta) once at the end).
+_UNIT_ETA = 1.0 / (8.0 * math.pi)
+
+
+def stokeslet_pallas_block(r_trg, r_src, f_src, *, interpret: bool = False):
+    """Unscaled Stokeslet interaction block — the ring evaluator's Pallas
+    tile (`parallel.ring.ring_stokeslet(impl="pallas")`). Same signature
+    order as `ops.kernels.stokeslet_block` (targets first)."""
+    return stokeslet_pallas(r_src, r_trg, f_src, _UNIT_ETA,
+                            interpret=interpret)
+
+
+def stresslet_pallas_block(r_trg, r_dl, f_dl, *, interpret: bool = False):
+    """Unscaled stresslet interaction block for the ring evaluator."""
+    return stresslet_pallas(r_dl, r_trg, f_dl, _UNIT_ETA,
+                            interpret=interpret)

@@ -30,18 +30,23 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.kernels import (DEFAULT_EPS, DEFAULT_REG, oseen_block,
-                           stokeslet_block, stokeslet_block_mxu,
-                           stresslet_block, stresslet_block_mxu)
+                           pallas_impl_for, stokeslet_block,
+                           stokeslet_block_mxu, stresslet_block,
+                           stresslet_block_mxu)
 from .mesh import FIBER_AXIS
 
 
-def _ring_accumulate(block_fn, axis_name: str, n_dev: int, u0, *rotating):
+def _ring_accumulate(block_fn, axis_name: str, n_dev: int, u0, *rotating,
+                     unroll: bool = False):
     """Accumulate ``block_fn(*rotating)`` over all ring positions.
 
     Each iteration launches the permute of the *next* blocks before computing
     on the current ones — the two are data-independent, so the ICI hop
     overlaps with the local block computation. The final position is consumed
     outside the loop: n_dev-1 hops total, no wasted trailing transfer.
+    ``unroll`` replaces the fori_loop with a Python loop (same graph,
+    statically unrolled) — required for tiles whose lowering cannot nest in a
+    loop body (interpret-mode pallas_call trips a lowering-cache KeyError).
     """
     if n_dev == 1:
         return u0 + block_fn(*rotating)
@@ -54,36 +59,68 @@ def _ring_accumulate(block_fn, axis_name: str, n_dev: int, u0, *rotating):
         u = u + block_fn(*rot)
         return u, nxt
 
-    u, rot = lax.fori_loop(0, n_dev - 1, step, (u0, tuple(rotating)))
+    carry = (u0, tuple(rotating))
+    if unroll:
+        for i in range(n_dev - 1):
+            carry = step(i, carry)
+        u, rot = carry
+    else:
+        u, rot = lax.fori_loop(0, n_dev - 1, step, carry)
     return u + block_fn(*rot)
 
 
-def _ring_block(impl: str, exact_block, mxu_block):
+def _pallas_interpret(impl: str) -> bool:
+    """True when the pallas tile will run in interpret mode (CPU test
+    meshes). Interpret mode needs two workarounds in `_ring_eval` — static
+    unrolling (interpret pallas_call in a fori_loop body trips a
+    lowering-cache KeyError) and check_vma=False (its grid emulation's
+    dynamic_slice mixes varying/non-varying operands) — that the compiled
+    Mosaic path must NOT pay: unrolling a v5p-256 ring would duplicate 255
+    kernel launches, and vma checking should stay on where it works."""
+    return impl == "pallas" and jax.default_backend() == "cpu"
+
+
+def _ring_block(impl: str, exact_block, mxu_block, pallas_block_name=None):
     """Tile dispatch for the ring evaluator. Names the ring does NOT serve
-    ("df" has its own ring entry points; "pallas" has no ring tile) raise
-    instead of silently running the exact tile — a user probing a specific
-    tile on a mesh must not get exact-tile results misattributed to it."""
+    ("df" has its own ring entry points) raise instead of silently running
+    the exact tile — a user probing a specific tile on a mesh must not get
+    exact-tile results misattributed to it."""
     if impl == "exact":
         return exact_block
     if impl == "mxu":
         return mxu_block
+    if impl == "pallas" and pallas_block_name is not None:
+        # the fused VMEM tile composes with shard_map: each chip runs the
+        # Mosaic kernel on its resident target shard x the rotating source
+        # shard. Import lazily so exact/mxu ring users never pay the
+        # jax.experimental.pallas import.
+        from ..ops import pallas_kernels
+
+        return partial(getattr(pallas_kernels, pallas_block_name),
+                       interpret=jax.default_backend() == "cpu")
     raise ValueError(
-        f"ring evaluator has no {impl!r} tile; use 'exact' or 'mxu' "
-        "(double-float rides ring_stokeslet_df / ring_stresslet_df)")
+        f"ring evaluator has no {impl!r} tile; use 'exact', 'mxu', or "
+        "'pallas' (double-float rides ring_stokeslet_df / ring_stresslet_df)")
 
 
-def _ring_eval(block_fn, mesh: Mesh, axis_name: str, specs, scale, *operands):
+def _ring_eval(block_fn, mesh: Mesh, axis_name: str, specs, scale, *operands,
+               unroll: bool = False):
     """shard_map a ring accumulation: operands[0] = targets (stay resident),
     operands[1:] rotate."""
     n_dev = mesh.shape[axis_name]
 
     def local(trg_l, *rot_l):
         u = _ring_accumulate(lambda *r: block_fn(trg_l, *r), axis_name, n_dev,
-                             jnp.zeros_like(trg_l), *rot_l)
+                             jnp.zeros_like(trg_l), *rot_l, unroll=unroll)
         return u * scale
 
+    # check_vma off on the interpret-mode pallas path only (see
+    # _pallas_interpret): its grid emulation's dynamic_slice mixes
+    # varying/non-varying operands, which the vma checker rejects — the jax
+    # error message itself prescribes check_vma=False as the workaround
     return jax.shard_map(local, mesh=mesh, in_specs=specs,
-                         out_specs=P(axis_name))(*operands)
+                         out_specs=P(axis_name),
+                         check_vma=not unroll)(*operands)
 
 
 @partial(jax.jit, static_argnames=("mesh", "axis_name", "impl"))
@@ -98,9 +135,12 @@ def ring_stokeslet(r_src, r_trg, f_src, eta, *, mesh: Mesh,
     shard's spatial extent.
     """
     spec = P(axis_name)
-    block = _ring_block(impl, stokeslet_block, stokeslet_block_mxu)
+    impl = pallas_impl_for(impl, r_trg, r_src, f_src)
+    block = _ring_block(impl, stokeslet_block, stokeslet_block_mxu,
+                        "stokeslet_pallas_block")
     return _ring_eval(block, mesh, axis_name, (spec, spec, spec),
-                      1.0 / (8.0 * math.pi * eta), r_trg, r_src, f_src)
+                      1.0 / (8.0 * math.pi * eta), r_trg, r_src, f_src,
+                      unroll=_pallas_interpret(impl))
 
 
 @partial(jax.jit, static_argnames=("mesh", "axis_name", "impl"))
@@ -109,10 +149,13 @@ def ring_stresslet(r_dl, r_trg, f_dl, eta, *, mesh: Mesh,
     """Ring-parallel stresslet (double-layer) sum
     (`ops.kernels.stresslet_direct`); ``f_dl`` is [n_src, 3, 3]."""
     spec = P(axis_name)
-    block = _ring_block(impl, stresslet_block, stresslet_block_mxu)
+    impl = pallas_impl_for(impl, r_trg, r_dl, f_dl)
+    block = _ring_block(impl, stresslet_block, stresslet_block_mxu,
+                        "stresslet_pallas_block")
     return _ring_eval(block, mesh, axis_name,
                       (spec, spec, P(axis_name, None, None)),
-                      1.0 / (8.0 * math.pi * eta), r_trg, r_dl, f_dl)
+                      1.0 / (8.0 * math.pi * eta), r_trg, r_dl, f_dl,
+                      unroll=_pallas_interpret(impl))
 
 
 def _ring_df(block_fn, mesh: Mesh, axis_name: str, r_src, r_trg, payload, eta):
