@@ -1,0 +1,78 @@
+"""Profile the walkthrough-scale coupled solve on the live backend.
+
+Round-5 question: the mixed-precision solve at the reference walkthrough
+scale (1 fiber + 400-node body + spherical shell) measures ~0.5 s/solve on
+one TPU chip against the reference's 0.328 s on a workstation — at this
+size the kernels are microseconds, so the wall is overheads (while_loop
+step latency, refinement sweeps, small-op dispatch). This script reports
+the bench-comparable wall (`bench._solve_rate`, the same measurement
+boundary as the 0.328 s comparison) and optionally captures an XLA
+profiler trace of one solve for the op-level attribution.
+
+Usage:
+    python scripts/profile_solve.py [--shell-n 2000] [--trace /tmp/xprof]
+
+Open the trace with TensorBoard (`tensorboard --logdir /tmp/xprof`) or
+xprof.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shell-n", type=int, default=2000)
+    ap.add_argument("--body-n", type=int, default=400)
+    ap.add_argument("--tol", type=float, default=1e-10)
+    ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--trace", type=str, default=None,
+                    help="directory for a jax.profiler trace (optional)")
+    ap.add_argument("--kernel-impl", type=str, default="exact")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    import bench
+
+    t0 = time.perf_counter()
+    system, state = bench._walkthrough_state(
+        args.shell_n, args.body_n, jax.numpy.float64, args.tol, mixed=True,
+        kernel_impl=args.kernel_impl)
+    setup_s = time.perf_counter() - t0
+
+    # same measurement boundary as the bench's 0.328 s comparison
+    t0 = time.perf_counter()
+    out = bench._solve_rate(system, state, trials=max(args.trials, 1))
+    total_s = time.perf_counter() - t0
+    compile_s = total_s - out["wall_s"] * max(args.trials, 1)
+
+    if args.trace:
+        step = jax.jit(system._solve_impl)
+        with jax.profiler.trace(args.trace):
+            _, sol, _ = step(state)
+            np.asarray(sol)
+
+    print(json.dumps({
+        "backend": jax.default_backend(),
+        "kernel_impl": args.kernel_impl,
+        "shell_n": args.shell_n,
+        "setup_s": round(setup_s, 2),
+        "compile_s": round(compile_s, 2),
+        **out,
+        "trace_dir": args.trace,
+    }))
+
+
+if __name__ == "__main__":
+    main()

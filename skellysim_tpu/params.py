@@ -75,8 +75,9 @@ class Params:
     # O(N^2*3) contractions ride the MXU; see kernels.stokeslet_block_mxu's
     # near-field cancellation caveat — for well-separated fiber clouds),
     # "df" (double-float f32, the f64-grade accuracy tier), or "pallas"
-    # (fused VMEM-tile kernels, `ops.pallas_kernels` — interpret mode off-TPU;
-    # opt-in while the deployment's Mosaic compiler support is probed)
+    # (fused VMEM-tile kernels, `ops.pallas_kernels` — the f32 throughput
+    # tier at scale: 53/48 Gpairs/s stokeslet/stresslet on v5e, 3.4x/8x the
+    # XLA path; f64 operands fall back to "exact"; interpret mode off-TPU)
     kernel_impl: str = "exact"
     # solver precision strategy (no reference analogue — the reference is
     # f64-everywhere on CPU; TPU XLA's LuDecomposition is f32-only and the
