@@ -5,9 +5,9 @@ scale (1 fiber + 400-node body + spherical shell) measures ~0.5 s/solve on
 one TPU chip against the reference's 0.328 s on a workstation — at this
 size the kernels are microseconds, so the wall is overheads (while_loop
 step latency, refinement sweeps, small-op dispatch). This script reports
-the bench-comparable wall (`bench._solve_rate`, the same measurement
-boundary as the 0.328 s comparison) and optionally captures an XLA
-profiler trace of one solve for the op-level attribution.
+`bench._bench_coupled` (the exact measurement boundary behind the 0.328 s
+comparison, vs_ref included) and optionally captures an XLA profiler trace
+of one steady-state solve for the op-level attribution.
 
 Usage:
     python scripts/profile_solve.py [--shell-n 2000] [--trace /tmp/xprof]
@@ -22,7 +22,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -41,34 +40,29 @@ def main():
     import jax
 
     jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
     import numpy as np
 
     import bench
 
-    t0 = time.perf_counter()
-    system, state = bench._walkthrough_state(
-        args.shell_n, args.body_n, jax.numpy.float64, args.tol, mixed=True,
-        kernel_impl=args.kernel_impl)
-    setup_s = time.perf_counter() - t0
-
-    # same measurement boundary as the bench's 0.328 s comparison
-    t0 = time.perf_counter()
-    out = bench._solve_rate(system, state, trials=max(args.trials, 1))
-    total_s = time.perf_counter() - t0
-    compile_s = total_s - out["wall_s"] * max(args.trials, 1)
+    out = bench._bench_coupled(args.shell_n, args.body_n, jnp.float64,
+                               args.tol, trials=max(args.trials, 1),
+                               mixed=True, kernel_impl=args.kernel_impl)
 
     if args.trace:
+        # rebuild the scene and warm OUTSIDE the trace so the capture holds
+        # one steady-state solve, not tracing + XLA compilation
+        system, state = bench._walkthrough_state(
+            args.shell_n, args.body_n, jnp.float64, args.tol, mixed=True,
+            kernel_impl=args.kernel_impl)
         step = jax.jit(system._solve_impl)
+        np.asarray(step(state)[1])  # compile + warm + drain
         with jax.profiler.trace(args.trace):
-            _, sol, _ = step(state)
-            np.asarray(sol)
+            np.asarray(step(state)[1])
 
     print(json.dumps({
         "backend": jax.default_backend(),
         "kernel_impl": args.kernel_impl,
-        "shell_n": args.shell_n,
-        "setup_s": round(setup_s, 2),
-        "compile_s": round(compile_s, 2),
         **out,
         "trace_dir": args.trace,
     }))

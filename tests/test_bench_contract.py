@@ -1,0 +1,62 @@
+"""The driver-facing bench contract: `python bench.py` prints exactly ONE
+line on stdout and it parses as the {metric, value, unit, vs_baseline}
+JSON the round driver records (BENCH_r{N}.json). A bench.py edit that
+breaks the contract fails the round artifact silently — this smoke test
+runs the real entry point (CPU-forced, tiny budget, probe skipped) in a
+subprocess and pins the contract.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_JSON = os.path.join(REPO, "BENCH.json")
+
+
+@pytest.mark.slow
+def test_bench_prints_one_parseable_json_line(tmp_path):
+    saved = None
+    if os.path.exists(BENCH_JSON):
+        saved = tmp_path / "BENCH.json.saved"
+        shutil.copy(BENCH_JSON, saved)
+    env = dict(os.environ)
+    env.update({"BENCH_FORCE_CPU": "1", "BENCH_BUDGET_S": "120",
+                "BENCH_PROBE_S": "1"})
+    env.pop("JAX_PLATFORMS", None)
+    # scrub the conftest's 8-virtual-device pin too: a real `python bench.py`
+    # run sees the host's devices, not cores split 8 ways (which slows every
+    # section and can flake the budget)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    if flags:
+        env["XLA_FLAGS"] = " ".join(flags)
+    else:
+        env.pop("XLA_FLAGS", None)
+    try:
+        p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                           capture_output=True, text=True, timeout=540,
+                           env=env, cwd=REPO)
+        assert p.returncode == 0, p.stderr[-2000:]
+        lines = [ln for ln in p.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, f"stdout must be ONE json line, got: {lines!r}"
+        doc = json.loads(lines[0])
+        for key in ("metric", "value", "unit", "vs_baseline", "backend",
+                    "extra"):
+            assert key in doc, f"missing {key!r}"
+        assert doc["metric"] != "bench_failed", doc
+        assert isinstance(doc["value"], (int, float))
+        # CPU-forced run must be flagged, never silently downscaled
+        assert doc["extra"].get("downscaled") is True
+        # the mirror artifact parses identically
+        with open(BENCH_JSON) as fh:
+            assert json.load(fh)["metric"] == doc["metric"]
+    finally:
+        if saved is not None:
+            shutil.copy(saved, BENCH_JSON)
+        elif os.path.exists(BENCH_JSON):
+            os.unlink(BENCH_JSON)

@@ -324,3 +324,37 @@ def test_mixed_bodies_trajectory_roundtrip():
                                [[1.0, 0.0, 0.0]])
     np.testing.assert_allclose(np.asarray(rebuilt.bodies[1].position),
                                [[-1.0, 0.0, 0.0]])
+
+
+def test_mixed_resolution_solve_through_pallas_seam():
+    """kernel_impl="pallas" serves the multi-bucket union evaluator pass
+    (`fc.flow_multi`) — interpret mode on CPU. f32 state so the f64
+    fallback guard doesn't bypass the tile; agreement with the exact path
+    is f32-rounding-grade. Exercises the padded-source invariant: inactive
+    pad nodes ride the union pass with zero quadrature-weighted densities
+    and must contribute exactly zero through the pallas tile."""
+    rng = np.random.default_rng(17)
+    xa = _straight_fibers(3, 16, rng.uniform(-2, 2, (3, 3)), seed=6)
+    xb = _straight_fibers(2, 24, rng.uniform(-2, 2, (2, 3)), seed=7)
+    bg = BackgroundFlow.make(uniform=(1.0, 0.0, 0.0), dtype=jnp.float32)
+
+    def solve(impl):
+        ga = fc.make_group(xa, lengths=1.0, bending_rigidity=0.01,
+                           radius=0.0125, config_rank=np.arange(3),
+                           dtype=jnp.float32)
+        gb = fc.make_group(xb, lengths=1.0, bending_rigidity=0.01,
+                           radius=0.0125, config_rank=np.arange(3, 5),
+                           dtype=jnp.float32)
+        params = Params(eta=1.0, dt_initial=1e-3, t_final=1e-2,
+                        gmres_tol=1e-5, kernel_impl=impl,
+                        adaptive_timestep_flag=False)
+        system = System(params)
+        st = system.make_state(fibers=(ga, gb), background=bg)
+        _, sol, info = system.step(st)
+        assert bool(info.converged), impl
+        return np.asarray(sol)
+
+    sol_p = solve("pallas")
+    sol_x = solve("exact")
+    err = np.linalg.norm(sol_p - sol_x) / np.linalg.norm(sol_x)
+    assert err < 1e-3, err
