@@ -389,11 +389,14 @@ def _walkthrough_state(shell_n, body_n, dtype, tol, mixed, kernel_impl="exact"):
 
 
 def _bench_coupled(shell_n, body_n, dtype, tol, trials=3, mixed=False,
-                   kernel_impl="exact"):
+                   kernel_impl="exact", return_scene=False):
     """Walkthrough-scale coupled solve; ``mixed=True`` benches the
     f64-accuracy TPU path (f32 Krylov flows + LU preconditioners, f64
     iterative refinement to ``tol``) — the apples-to-apples comparison
-    against the reference's 0.328 s/solve at tol 4.6e-11."""
+    against the reference's 0.328 s/solve at tol 4.6e-11.
+    ``return_scene`` additionally hands back (system, state) so callers
+    (scripts/profile_solve.py's trace capture) can reuse the built scene
+    instead of paying the dense shell inverse a second time."""
     t_setup = time.perf_counter()
     system, state = _walkthrough_state(shell_n, body_n, dtype, tol, mixed,
                                        kernel_impl)
@@ -403,6 +406,8 @@ def _bench_coupled(shell_n, body_n, dtype, tol, trials=3, mixed=False,
                 "setup_s": round(setup_s, 2),
                 "ref_wall_s": REF_SOLVE_WALL_S, "ref_iters": REF_SOLVE_ITERS,
                 "vs_ref": round(REF_SOLVE_WALL_S / out["wall_s"], 2)})
+    if return_scene:
+        return out, system, state
     return out
 
 

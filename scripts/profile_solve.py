@@ -45,18 +45,16 @@ def main():
 
     import bench
 
-    out = bench._bench_coupled(args.shell_n, args.body_n, jnp.float64,
-                               args.tol, trials=max(args.trials, 1),
-                               mixed=True, kernel_impl=args.kernel_impl)
+    out, system, state = bench._bench_coupled(
+        args.shell_n, args.body_n, jnp.float64, args.tol,
+        trials=max(args.trials, 1), mixed=True,
+        kernel_impl=args.kernel_impl, return_scene=True)
 
     if args.trace:
-        # rebuild the scene and warm OUTSIDE the trace so the capture holds
-        # one steady-state solve, not tracing + XLA compilation
-        system, state = bench._walkthrough_state(
-            args.shell_n, args.body_n, jnp.float64, args.tol, mixed=True,
-            kernel_impl=args.kernel_impl)
+        # reuse the scene _bench_coupled built; warm OUTSIDE the trace so
+        # the capture holds one steady-state solve, not tracing/compilation
         step = jax.jit(system._solve_impl)
-        np.asarray(step(state)[1])  # compile + warm + drain
+        np.asarray(step(state)[1])  # warm + drain (compile is process-cached)
         with jax.profiler.trace(args.trace):
             np.asarray(step(state)[1])
 
