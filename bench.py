@@ -285,64 +285,24 @@ def _bench_single_fiber(dtype, tol, trials=3, mixed=False):
 
 
 def _block_inv(M, max_direct: int = 12000):
-    """Dense inverse via recursive 2x2 Schur-complement blocking.
+    """Device Schur-complement blocked inverse — the production implementation
+    lives in `skellysim_tpu.periphery.periphery.block_inv` (promoted there in
+    round 5 for the `--device-operator` precompute path)."""
+    from skellysim_tpu.periphery.periphery import block_inv
 
-    TPU LuDecomposition keeps an [n, 128] panel in scoped VMEM; at n = 18000
-    (a 6000-node shell) that panel is 17.7 MB against a 16 MB limit and the
-    compile fails. Halving until blocks fit turns the inverse into two
-    smaller LUs plus MXU matmuls. Accuracy is preconditioner-grade, which is
-    all the callers need.
-    """
-    import jax.numpy as jnp
-
-    n = M.shape[0]
-    if n <= max_direct:
-        return jnp.linalg.inv(M)
-    h = n // 2
-    A, B = M[:h, :h], M[:h, h:]
-    C, D = M[h:, :h], M[h:, h:]
-    Ai = _block_inv(A, max_direct)
-    AiB = Ai @ B
-    Si = _block_inv(D - C @ AiB, max_direct)
-    CAi = C @ Ai
-    top = jnp.concatenate([Ai + AiB @ (Si @ CAi), -AiB @ Si], axis=1)
-    bot = jnp.concatenate([-Si @ CAi, Si], axis=1)
-    return jnp.concatenate([top, bot], axis=0)
+    return block_inv(M, max_direct)
 
 
 def _device_shell_operator(nodes, normals, weights, dtype, precond_dtype=None):
-    """Dense second-kind shell operator + inverse, assembled on-device.
+    """Dense second-kind shell operator + inverse on-device — delegates to
+    the production `periphery.build_shell_operator_device` (promoted there in
+    round 5 as the `--device-operator` precompute path; returns device
+    arrays, so no extra tunnel round trip here)."""
+    from skellysim_tpu.periphery.periphery import build_shell_operator_device
 
-    Same math as `periphery.build_shell_operator` (stresslet x normal blocks,
-    singularity subtraction, -1/w diagonal, n (x) n complementary term) with
-    the O(N^2) assembly row-blocked (`kernels.stresslet_times_normal_blocked`)
-    and the O(N^3) inverse on the accelerator instead of host LAPACK.
-    ``precond_dtype`` computes the inverse (a preconditioner — accuracy does
-    not matter) in a lower precision: TPU LuDecomposition is f32-only, so an
-    f64 operator still needs an f32 inverse on device.
-    """
-    import jax.numpy as jnp
-
-    from skellysim_tpu.ops import kernels
-
-    N = len(nodes)
-    nodes_d = jnp.asarray(nodes, dtype=dtype)
-    normals_d = jnp.asarray(normals, dtype=dtype)
-    w_d = jnp.asarray(weights, dtype=dtype)
-
-    M = kernels.stresslet_times_normal_blocked(nodes_d, normals_d, 1.0)
-
-    def sv(k):
-        e = jnp.zeros((N, 3), dtype=dtype).at[:, k].set(w_d)
-        return kernels.stresslet_times_normal_times_density(
-            nodes_d, normals_d, e, 1.0)
-
-    M = kernels.subtract_singularity_columns(M, (sv(0), sv(1), sv(2)), w_d)
-    d = jnp.arange(3 * N)
-    M = M.at[d, d].add(-jnp.repeat(1.0 / w_d, 3))
-    M = M + jnp.outer(normals_d.reshape(-1), normals_d.reshape(-1))
-    M_inv = _block_inv(M.astype(precond_dtype) if precond_dtype else M)
-    return M, M_inv
+    return build_shell_operator_device(nodes, normals, weights, eta=1.0,
+                                       op_dtype=dtype,
+                                       inv_dtype=precond_dtype or dtype)
 
 
 def _walkthrough_state(shell_n, body_n, dtype, tol, mixed, kernel_impl="exact"):
@@ -511,9 +471,12 @@ def _bench_fiber_shell(kind, n_fibers, fiber_nodes, shell_n, dtype, tol,
     return out
 
 
-def _bench_640k_matvec(n_fibers, n_nodes, dtype, trials=2):
+def _bench_640k_matvec(n_fibers, n_nodes, dtype, trials=2, ck=None):
     """BASELINE #4: dense Stokeslet mobility matvec at the 10k-fiber scale
-    (640k source=target nodes) — the measurement behind the FMM go/no-go."""
+    (640k source=target nodes) — the measurement behind the FMM go/no-go.
+
+    ``ck(out)`` checkpoints after each sub-measurement (XLA / MXU / Pallas)
+    so a remote-compile hang in a later path keeps the earlier numbers."""
     import jax
     import jax.numpy as jnp
 
@@ -534,6 +497,8 @@ def _bench_640k_matvec(n_fibers, n_nodes, dtype, trials=2):
     rate = _rate(lambda: kernels.stokeslet_direct(r, r, f, 1.0), n * n,
                  trials=trials)
     out = {"n_nodes": n, "gpairs_per_s": round(rate / 1e9, 3)}
+    if ck is not None:
+        ck(out)
     try:
         # matmul-form tile: O(N^2*3) contractions on the MXU (see
         # kernels.stokeslet_block_mxu numerics caveat — valid for this
@@ -545,6 +510,8 @@ def _bench_640k_matvec(n_fibers, n_nodes, dtype, trials=2):
         rate = max(rate, rate_mxu)
     except Exception as e:
         out["mxu_error"] = _short_err(e)
+    if ck is not None:
+        ck(out)
     if dtype != np.float64 and jax.default_backend() != "cpu":
         try:
             # fused VMEM Pallas tile (round 5: ~3.4x the XLA path on v5e)
@@ -563,9 +530,13 @@ def _bench_640k_matvec(n_fibers, n_nodes, dtype, trials=2):
     return out
 
 
-def _bench_ewald_crossover(on_acc, dtype):
+def _bench_ewald_crossover(on_acc, dtype, ck=None):
     """VERDICT r3 #2: Ewald vs dense at a ladder of node counts — the
-    measured crossover table replacing the round-3 projection."""
+    measured crossover table replacing the round-3 projection.
+
+    ``ck(table)`` checkpoints after every size: a remote-compile hang at one
+    rung costs that rung, not the whole table (round 5: a starved child lost
+    all rungs to the 640k section's budget)."""
     import jax.numpy as jnp
 
     from skellysim_tpu.ops import ewald as ew
@@ -579,6 +550,8 @@ def _bench_ewald_crossover(on_acc, dtype):
     rng = np.random.default_rng(100)
     table = {}
     for n in sizes:
+        if ck is not None:
+            ck(table)
         if _remaining() < 75:
             table[f"n{n}"] = {"skipped_budget": int(_remaining())}
             continue
@@ -643,6 +616,15 @@ def _group_kernels(extra, ck, on_acc):
     # size that reliably completes
     n64 = 4096
     rate32 = None
+    # numpy baseline first: pure-host, no compile risk — bank it before the
+    # first remote compile can eat the child's budget (round 5: a starved
+    # child timed out inside the 65536 compile with an empty checkpoint)
+    try:
+        extra["numpy_baseline_gpairs_per_s"] = round(
+            _numpy_pairs_per_s() / 1e9, 5)
+    except Exception:
+        pass
+    ck()
     try:
         rate32 = _kernel_rate(jnp.float32, n32)
         extra["stokeslet_f32"] = {"n": n32, "gpairs_per_s": round(rate32 / 1e9, 4)}
@@ -653,11 +635,6 @@ def _group_kernels(extra, ck, on_acc):
             _mark_downscaled(extra["stokeslet_f32"], _CPU_FALLBACK)
     except Exception as e:
         extra["stokeslet_f32"] = {"error": _short_err(e)}
-    try:
-        extra["numpy_baseline_gpairs_per_s"] = round(
-            _numpy_pairs_per_s() / 1e9, 5)
-    except Exception:
-        pass
     ck()
     if _remaining() > 60:
         try:
@@ -733,11 +710,19 @@ def _group_scale(extra, ck, on_acc):
     """BASELINE #4 (640k dense matvec) + the Ewald crossover ladder."""
     import jax.numpy as jnp
 
+    def ck_section(key):
+        """Store ``key``'s partial dict (downscale-marked on fallback) + ck."""
+        def store(partial):
+            extra[key] = dict(partial)
+            if not on_acc:
+                _mark_downscaled(extra[key], _CPU_FALLBACK)
+            ck()
+        return store
+
+    ck_640k = ck_section("dense_matvec_10k_fibers")
     try:
-        out = _bench_640k_matvec(10000 if on_acc else 100, 64, jnp.float32)
-        if not on_acc:
-            _mark_downscaled(out, _CPU_FALLBACK)
-        extra["dense_matvec_10k_fibers"] = out
+        ck_640k(_bench_640k_matvec(10000 if on_acc else 100, 64, jnp.float32,
+                                   ck=ck_640k))
     except Exception as e:
         extra["dense_matvec_10k_fibers"] = {"error": _short_err(e)}
     ck()
@@ -757,10 +742,9 @@ def _group_scale(extra, ck, on_acc):
         }
         ck()
 
+    ck_table = ck_section("ewald_crossover")
     try:
-        extra["ewald_crossover"] = _bench_ewald_crossover(on_acc, jnp.float32)
-        if not on_acc:
-            _mark_downscaled(extra["ewald_crossover"], _CPU_FALLBACK)
+        ck_table(_bench_ewald_crossover(on_acc, jnp.float32, ck=ck_table))
     except Exception as e:
         extra["ewald_crossover"] = {"error": _short_err(e)}
     ck()

@@ -13,7 +13,7 @@ from __future__ import annotations
 import numpy as np
 from scipy.spatial import ConvexHull
 
-from .periphery import build_shell_operator
+from .periphery import build_shell_operator, build_shell_operator_device
 from .quadrature import surface_quadrature_weights
 from .shapes import ShapeSpec, ellipsoid_shape, sphere_shape, surface_of_revolution_shape
 
@@ -36,10 +36,25 @@ def _shape_for_periphery(shape: str, n_nodes: int, **kw) -> ShapeSpec:
     raise ValueError(f"unknown periphery shape: {shape}")
 
 
-def precompute_periphery(shape: str, n_nodes: int = 0, eta: float = 1.0, **kw) -> dict:
+def precompute_periphery(shape: str, n_nodes: int = 0, eta: float = 1.0,
+                         operator_backend: str = "host", **kw) -> dict:
     """Full periphery precompute. Returns dict with the reference npz keys:
     nodes, normals (inward), quadrature_weights, stresslet_plus_complementary,
-    M_inv (+ envelope fit state for surfaces of revolution)."""
+    M_inv (+ envelope fit state for surfaces of revolution).
+
+    ``operator_backend="device"`` assembles the dense operator and computes
+    the inverse on the accelerator (`periphery.build_shell_operator_device`):
+    the reference's host-LAPACK inverse (`precompute.py:133`) is the O(N^3)
+    pole of the whole precompute (~5 min at 6000 nodes on one core; seconds
+    on a TPU chip). The device inverse is float32 (preconditioner-grade —
+    TPU LuDecomposition is f32-only); the operator stays float64. Quadrature
+    (hull + RBF weights) remains on host either way.
+    """
+    if operator_backend not in ("host", "device"):
+        # validate before the hull + RBF quadrature (minutes at 6k nodes)
+        raise ValueError(
+            f"unknown operator_backend {operator_backend!r} "
+            "(expected 'host' or 'device')")
     spec = _shape_for_periphery(shape, n_nodes, **kw)
     nodes = spec.nodes
     normals = -spec.node_normals  # periphery normals point inward (`precompute.py:82`)
@@ -47,7 +62,12 @@ def precompute_periphery(shape: str, n_nodes: int = 0, eta: float = 1.0, **kw) -
     tris = ConvexHull(nodes).simplices
     weights = surface_quadrature_weights(nodes, tris, spec.gradh)
 
-    operator, M_inv = build_shell_operator(nodes, normals, weights, eta=eta)
+    if operator_backend == "device":
+        operator, M_inv = build_shell_operator_device(nodes, normals, weights,
+                                                      eta=eta)
+        operator, M_inv = np.asarray(operator), np.asarray(M_inv)
+    else:
+        operator, M_inv = build_shell_operator(nodes, normals, weights, eta=eta)
 
     out = {
         "nodes": nodes,

@@ -156,6 +156,11 @@ def test_precompute_spherical_periphery_pipeline(tmp_path):
     cfg.save(cfg_path)
 
     precompute.precompute_from_config(cfg_path, verbose=False)
+    # the stored operator must be genuine float64: the assembly runs through
+    # the JAX kernels, and a missing x64 enable silently degraded it to
+    # f32-grade values (~2.7e-8 relative — found by round-5 verify)
+    peri_npz = np.load(str(tmp_path / "periphery.npz"))
+    assert peri_npz["stresslet_plus_complementary"].dtype == np.float64
     system, state, rng = builder.build_simulation(cfg_path)
     new_state, solution, info = system.step(state)
     assert bool(info.converged)

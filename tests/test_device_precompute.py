@@ -1,0 +1,84 @@
+"""On-device shell-operator precompute: parity with the host/scipy path.
+
+The reference precomputes the dense second-kind shell operator on the host
+and inverts it with LAPACK (`/root/reference/src/skelly_sim/precompute.py:113-133`
+— the O(N^3) pole of the whole precompute). `periphery.build_shell_operator_device`
+moves assembly + inverse onto the accelerator; these tests pin that the device
+path produces the SAME operator (same math, same kernels, different execution
+placement) and a preconditioner-grade inverse, including through the recursive
+Schur-complement blocking that replaces the single big LU on TPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from skellysim_tpu.periphery import precompute as pc
+from skellysim_tpu.periphery.periphery import (
+    block_inv,
+    build_shell_operator,
+    build_shell_operator_device,
+)
+from skellysim_tpu.periphery.shapes import sphere_shape
+
+
+@pytest.fixture(scope="module")
+def small_shell():
+    spec = sphere_shape(120, radius=2.0)
+    nodes = spec.nodes
+    normals = -spec.node_normals
+    weights = np.full(len(nodes), 4 * np.pi * 2.0**2 / len(nodes))
+    return nodes, normals, weights
+
+
+def test_device_operator_matches_host(small_shell):
+    nodes, normals, weights = small_shell
+    M_host, _ = build_shell_operator(nodes, normals, weights)
+    M_dev, M_inv = build_shell_operator_device(nodes, normals, weights,
+                                               op_dtype=jnp.float64,
+                                               inv_dtype=jnp.float64)
+    assert np.linalg.norm(M_dev - M_host) / np.linalg.norm(M_host) < 1e-12
+    resid = np.linalg.norm(M_dev @ M_inv - np.eye(M_dev.shape[0]), ord="fro")
+    assert resid < 1e-8
+
+
+def test_block_inv_recursion_matches_direct(small_shell):
+    nodes, normals, weights = small_shell
+    M, _ = build_shell_operator_device(nodes, normals, weights,
+                                       op_dtype=jnp.float64,
+                                       inv_dtype=jnp.float64)
+    M = jnp.asarray(M)
+    # force two levels of Schur recursion (360 rows > 100 > 50)
+    blocked = np.asarray(block_inv(M, max_direct=100))
+    direct = np.asarray(jnp.linalg.inv(M))
+    # preconditioner-grade agreement: identical math up to blocked roundoff
+    assert np.linalg.norm(blocked - direct) / np.linalg.norm(direct) < 1e-9
+
+
+def test_f32_inverse_is_preconditioner_grade(small_shell):
+    nodes, normals, weights = small_shell
+    M, M_inv = build_shell_operator_device(nodes, normals, weights,
+                                           op_dtype=jnp.float64,
+                                           inv_dtype=jnp.float32)
+    assert M_inv.dtype == np.float32
+    n = M.shape[0]
+    resid = np.linalg.norm(M @ M_inv.astype(np.float64) - np.eye(n),
+                           ord="fro") / np.sqrt(n)
+    # f32 inverse: rows apply to ~f32 eps — plenty for a right preconditioner
+    assert resid < 1e-4
+
+
+def test_precompute_periphery_device_backend(small_shell):
+    out_host = pc.precompute_periphery("sphere", 120, radius=2.0,
+                                       operator_backend="host")
+    out_dev = pc.precompute_periphery("sphere", 120, radius=2.0,
+                                      operator_backend="device")
+    assert set(out_dev) == set(out_host)
+    np.testing.assert_allclose(out_dev["nodes"], out_host["nodes"])
+    d = np.linalg.norm(out_dev["stresslet_plus_complementary"]
+                       - out_host["stresslet_plus_complementary"])
+    assert d / np.linalg.norm(out_host["stresslet_plus_complementary"]) < 1e-12
+    with pytest.raises(ValueError):
+        pc.precompute_periphery("sphere", 120, radius=2.0,
+                                operator_backend="gpu")
