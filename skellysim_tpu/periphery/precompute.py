@@ -55,6 +55,19 @@ def precompute_periphery(shape: str, n_nodes: int = 0, eta: float = 1.0,
         raise ValueError(
             f"unknown operator_backend {operator_backend!r} "
             "(expected 'host' or 'device')")
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        # BOTH backends assemble through the JAX kernels; without x64 the
+        # stored float64 operator silently degrades to f32-grade values
+        # (~2.7e-8 relative, found by round-5 verify). Check here — before
+        # the expensive quadrature — so direct library callers fail fast
+        # instead of only the CLI (which enables x64 itself).
+        raise RuntimeError(
+            "precompute_periphery needs jax_enable_x64 (the dense operator "
+            "assembles through JAX kernels; without x64 it silently "
+            "degrades to float32 accuracy). Enable it or use the "
+            "`python -m skellysim_tpu.precompute` CLI, which does.")
     spec = _shape_for_periphery(shape, n_nodes, **kw)
     nodes = spec.nodes
     normals = -spec.node_normals  # periphery normals point inward (`precompute.py:82`)
