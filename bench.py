@@ -305,31 +305,37 @@ def _device_shell_operator(nodes, normals, weights, dtype, precond_dtype=None):
                                        inv_dtype=precond_dtype or dtype)
 
 
-#: per-shell_n cache of the walkthrough scene's dense operator: (nodes,
-#: normals, weights, M float64 device array, M_inv float32 device array).
-#: The coupled group benches four (dtype, solver) combinations of the SAME
-#: geometry — assembling + inverting an 18000^2 operator once and casting
-#: per scene (exactly how production consumes a precompute npz) saves ~3
-#: repeat setups of the group's most expensive stage.
+#: per-(shell_n, radius, dtypes) cache of the walkthrough scene's dense
+#: operator (device arrays). The coupled group benches several (dtype,
+#: solver) combinations of the SAME geometry — reusing the assembled +
+#: inverted 18000^2 operator across same-dtype scenes (f32 solve, then the
+#: mxu-kernel repeat) skips repeat runs of the group's most expensive
+#: setup stage. Entries for a different dtype of the same geometry are
+#: EVICTED before building (not kept side by side): pinning the f64
+#: operator (2.6 GB) through the f32 ladder rung would shrink HBM headroom
+#: in exactly the OOM-sensitive solve the ladder exists to protect.
 _WALKTHROUGH_SHELL_CACHE: dict = {}
 
 #: walkthrough scene shell radius (the reference walkthrough's geometry)
 _WALKTHROUGH_RADIUS = 6.0
 
 
-def _walkthrough_shell(shell_n, radius):
+def _walkthrough_shell(shell_n, radius, dtype, precond_dtype):
     import jax.numpy as jnp
 
     from skellysim_tpu.periphery.shapes import sphere_shape
 
-    key = (shell_n, radius)
+    key = (shell_n, radius, jnp.dtype(dtype).name,
+           jnp.dtype(precond_dtype).name if precond_dtype else None)
     if key not in _WALKTHROUGH_SHELL_CACHE:
+        for other in [k for k in _WALKTHROUGH_SHELL_CACHE
+                      if k[:2] == (shell_n, radius)]:
+            del _WALKTHROUGH_SHELL_CACHE[other]
         spec = sphere_shape(shell_n, radius=radius * 1.04)
         normals = -spec.node_normals  # shell normals point inward
         weights = np.full(shell_n, 4 * np.pi * (radius * 1.04) ** 2 / shell_n)
         op, M_inv = _device_shell_operator(spec.nodes, normals, weights,
-                                           jnp.float64,
-                                           precond_dtype=jnp.float32)
+                                           dtype, precond_dtype=precond_dtype)
         _WALKTHROUGH_SHELL_CACHE[key] = (spec.nodes, normals, weights,
                                          op, M_inv)
     return _WALKTHROUGH_SHELL_CACHE[key]
@@ -346,11 +352,13 @@ def _walkthrough_state(shell_n, body_n, dtype, tol, mixed, kernel_impl="exact"):
     from skellysim_tpu.periphery.precompute import precompute_body
     from skellysim_tpu.system import System
 
-    # the preconditioner is f32 in every benched configuration (it is only
-    # preconditioner-grade by construction; TPU LU is f32-only anyway)
-    pdt = jnp.float32
+    # mixed mode stores the preconditioner in f32 (preconditioner-grade;
+    # TPU LU is f32-only); full-precision scenes keep the state dtype,
+    # matching the pre-cache bench numerics
+    pdt = jnp.float32 if mixed else None
     radius = _WALKTHROUGH_RADIUS
-    nodes, normals, weights, op, M_inv = _walkthrough_shell(shell_n, radius)
+    nodes, normals, weights, op, M_inv = _walkthrough_shell(shell_n, radius,
+                                                            dtype, pdt)
     shell = peri.make_state(nodes, normals, weights, op, M_inv,
                             dtype=dtype, precond_dtype=pdt)
 
@@ -416,7 +424,9 @@ def _bench_coupled_ladder(scales, body_n, dtype, tol, mixed):
             # evict this rung's cached device operator (~4 GB at 6000):
             # keeping it pinned would shrink HBM headroom exactly while the
             # ladder retries smaller scales to recover from an OOM
-            _WALKTHROUGH_SHELL_CACHE.pop((shell_n, _WALKTHROUGH_RADIUS), None)
+            for k in [k for k in _WALKTHROUGH_SHELL_CACHE
+                      if k[:2] == (shell_n, _WALKTHROUGH_RADIUS)]:
+                del _WALKTHROUGH_SHELL_CACHE[k]
     return {"error": errors or "no scale attempted"}
 
 

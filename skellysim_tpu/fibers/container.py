@@ -246,7 +246,9 @@ def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
                           for g, f in zip(buckets, forces_list)], axis=0)
     n_fib_nodes = pos.shape[0]
     if evaluator == "ring" and mesh is not None:
-        if impl == "df":
+        if impl in ("df", "pallas_df"):
+            # one ring DF entry point serves both spellings: the multi-chip
+            # double-float tile is its own implementation, not a tiling knob
             from ..parallel.ring import ring_stokeslet_df
 
             vel = ring_stokeslet_df(pos, r_trg, wf, eta, mesh=mesh)

@@ -256,8 +256,15 @@ def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096,
     and per-source-block recentering. ``impl="df"`` evaluates in double-float
     f32 arithmetic (`df_kernels.stokeslet_direct_df`, ~1e-14 relative, f64
     output) — the accuracy tier for refinement residuals on hardware whose
-    native f64 is emulated.
+    native f64 is emulated. ``impl="pallas_df"`` is the same arithmetic as a
+    fused Pallas VMEM tile (`pallas_df.stokeslet_pallas_df`) — Mosaic on
+    real TPUs, interpret mode on CPU.
     """
+    if impl == "pallas_df":
+        from .pallas_df import stokeslet_pallas_df
+
+        return stokeslet_pallas_df(r_src, r_trg, f_src, eta,
+                                   interpret=jax.default_backend() == "cpu")
     if impl == "df":
         from .df_kernels import stokeslet_direct_df
 
@@ -293,8 +300,14 @@ def stresslet_direct(r_dl, r_trg, f_dl, eta, *, block_size: int = 4096,
     ``impl="mxu"`` selects the matmul-form tile (`stresslet_block_mxu`,
     recentered per source block on its first point — see
     `stokeslet_block_mxu`'s caveat). ``impl="df"`` evaluates in double-float
-    f32 arithmetic (`df_kernels.stresslet_direct_df`, f64 output).
+    f32 arithmetic (`df_kernels.stresslet_direct_df`, f64 output);
+    ``impl="pallas_df"`` is the fused Pallas tile of the same arithmetic.
     """
+    if impl == "pallas_df":
+        from .pallas_df import stresslet_pallas_df
+
+        return stresslet_pallas_df(r_dl, r_trg, f_dl, eta,
+                                   interpret=jax.default_backend() == "cpu")
     if impl == "df":
         from .df_kernels import stresslet_direct_df
 

@@ -74,10 +74,12 @@ class Params:
     # the reference's semantics bit-for-bit), "mxu" (matmul form — the
     # O(N^2*3) contractions ride the MXU; see kernels.stokeslet_block_mxu's
     # near-field cancellation caveat — for well-separated fiber clouds),
-    # "df" (double-float f32, the f64-grade accuracy tier), or "pallas"
+    # "df" (double-float f32, the f64-grade accuracy tier), "pallas"
     # (fused VMEM-tile kernels, `ops.pallas_kernels` — the f32 throughput
     # tier at scale: 53/48 Gpairs/s stokeslet/stresslet on v5e, 3.4x/8x the
-    # XLA path; f64 operands fall back to "exact"; interpret mode off-TPU)
+    # XLA path; f64 operands fall back to "exact"; interpret mode off-TPU),
+    # or "pallas_df" (the DF arithmetic fused into Pallas tiles,
+    # `ops.pallas_df` — f64-grade accuracy at VMEM-tile throughput)
     kernel_impl: str = "exact"
     # solver precision strategy (no reference analogue — the reference is
     # f64-everywhere on CPU; TPU XLA's LuDecomposition is f32-only and the
@@ -105,8 +107,10 @@ class Params:
     # in "mixed" mode: "exact" = native f64 (fast on CPU, ~100x slower than
     # f32 on TPUs, whose f64 is software-emulated), "df" = double-float f32
     # (`ops.df_kernels`, ~1e-14 relative — far beyond gmres_tol needs),
-    # "auto" = "df" on accelerators, "exact" on CPU. The ring evaluator
-    # serves "df" with its own double-float tiles
+    # "pallas_df" = the same double-float arithmetic fused into Pallas VMEM
+    # tiles (`ops.pallas_df` — removes the XLA path's HBM-staged fusion
+    # round trips), "auto" = "df" on accelerators, "exact" on CPU. The ring
+    # evaluator serves both DF spellings with its own double-float tiles
     # (`parallel.ring.ring_stokeslet_df` / `ring_stresslet_df`)
     refine_pair_impl: str = "auto"
     # max refinement sweeps in "mixed" mode

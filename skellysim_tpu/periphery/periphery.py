@@ -239,7 +239,8 @@ def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct
                 [src, jnp.full((pad, 3), 1e7, dtype=src.dtype)], axis=0)
             f_dl = jnp.concatenate(
                 [f_dl, jnp.zeros((pad, 3, 3), dtype=f_dl.dtype)], axis=0)
-        if impl == "df":
+        if impl in ("df", "pallas_df"):
+            # see fibers.container.flow_multi: one ring DF tile, both names
             from ..parallel.ring import ring_stresslet_df
 
             return ring_stresslet_df(src, r_trg, f_dl, eta, mesh=mesh)

@@ -126,21 +126,22 @@ class System:
             raise ValueError(
                 f"unknown solver_precision {params.solver_precision!r}; "
                 "use 'full', 'mixed', or 'auto'")
-        if params.kernel_impl not in ("exact", "mxu", "df", "pallas"):
+        if params.kernel_impl not in ("exact", "mxu", "df", "pallas",
+                                      "pallas_df"):
             # the kernel seam's else-branch would silently run "exact" for a
             # typo'd name — reject at construction like the other knobs
             raise ValueError(
                 f"unknown kernel_impl {params.kernel_impl!r}; "
-                "use 'exact', 'mxu', 'df', or 'pallas'")
+                "use 'exact', 'mxu', 'df', 'pallas', or 'pallas_df'")
         self.params = params
         self.shell_shape = shell_shape
         # device mesh for the ring pair evaluator (params.pair_evaluator="ring");
         # GSPMD sharding via parallel.shard_state needs no mesh here
         self.mesh = mesh
-        if params.refine_pair_impl not in ("auto", "exact", "df"):
+        if params.refine_pair_impl not in ("auto", "exact", "df", "pallas_df"):
             raise ValueError(
                 f"unknown refine_pair_impl {params.refine_pair_impl!r}; "
-                "use 'auto', 'exact', or 'df'")
+                "use 'auto', 'exact', 'df', or 'pallas_df'")
         if params.precond not in ("gs", "jacobi"):
             raise ValueError(
                 f"unknown precond {params.precond!r}; use 'gs' or 'jacobi'")
