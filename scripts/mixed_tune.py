@@ -32,6 +32,9 @@ def main():
     ap.add_argument("--tol", type=float, default=1e-10)
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--tols", type=str, default="1e-3,1e-4,1e-5,3e-6")
+    ap.add_argument("--refine-impls", type=str, default="",
+                    help="comma list to sweep refine_pair_impl at the best "
+                         "inner_tol, e.g. 'df,pallas_df,exact'")
     args = ap.parse_args()
 
     import jax
@@ -57,6 +60,7 @@ def main():
                       "setup_s": round(time.perf_counter() - t0, 1)}),
           flush=True)
 
+    best = (None, float("inf"))
     for tol_s in args.tols.split(","):
         inner = float(tol_s)
         system.params = dataclasses.replace(system.params, inner_tol=inner)
@@ -64,6 +68,21 @@ def main():
         # wrapper so the new inner_tol is baked into a fresh program
         out = bench._solve_rate(system, state, trials=args.trials)
         print(json.dumps({"inner_tol": inner, **out}), flush=True)
+        if out["residual_true"] <= args.tol and out["wall_s"] < best[1]:
+            best = (inner, out["wall_s"])
+
+    impls = [s for s in args.refine_impls.split(",") if s]
+    bad = set(impls) - {"exact", "df", "pallas_df"}
+    if bad:
+        # dataclasses.replace skips System.__init__'s validation; a typo'd
+        # name would silently bench the exact tile under the wrong label
+        raise SystemExit(f"unknown refine impls: {sorted(bad)}")
+    for impl in impls:
+        system.params = dataclasses.replace(
+            system.params, inner_tol=best[0] or 1e-4, refine_pair_impl=impl)
+        out = bench._solve_rate(system, state, trials=args.trials)
+        print(json.dumps({"refine_pair_impl": impl,
+                          "inner_tol": best[0] or 1e-4, **out}), flush=True)
 
 
 if __name__ == "__main__":

@@ -247,11 +247,11 @@ def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
     n_fib_nodes = pos.shape[0]
     if evaluator == "ring" and mesh is not None:
         if impl in ("df", "pallas_df"):
-            # one ring DF entry point serves both spellings: the multi-chip
-            # double-float tile is its own implementation, not a tiling knob
+            # the DF ring entry point serves both spellings: "df" runs the
+            # XLA blocks, "pallas_df" the fused Pallas DF tile per chip
             from ..parallel.ring import ring_stokeslet_df
 
-            vel = ring_stokeslet_df(pos, r_trg, wf, eta, mesh=mesh)
+            vel = ring_stokeslet_df(pos, r_trg, wf, eta, mesh=mesh, impl=impl)
         else:
             from ..parallel.ring import ring_stokeslet
 

@@ -46,7 +46,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_kernels import _pad_to, _vma
 
-__all__ = ["stokeslet_pallas_df", "stresslet_pallas_df"]
+__all__ = ["stokeslet_pallas_df", "stresslet_pallas_df",
+           "stokeslet_pallas_df_block", "stresslet_pallas_df_block"]
 
 # DF tiles hold ~3x the live [tile_t, tile_s] temporaries of the exact
 # kernels; smaller defaults keep the working set inside VMEM
@@ -239,13 +240,11 @@ def _stresslet_df_kernel(trg_ref, src_ref, s_ref, out_ref):
 
 
 def _df_split_T(a):
-    """[n, c] f64/f32 array -> [2c, n] transposed (hi rows, then lo rows)."""
-    aT = a.reshape(a.shape[0], -1).T
-    if aT.dtype == jnp.float32:
-        return jnp.concatenate([aT, jnp.zeros_like(aT)], axis=0)
-    hi = aT.astype(jnp.float32)
-    lo = (aT - hi.astype(jnp.float64)).astype(jnp.float32)
-    return jnp.concatenate([hi, lo], axis=0)
+    """[n, c...] f64/f32 array -> [2c, n] rows (hi, then lo) via the shared
+    `df_kernels._df_split` (one split implementation for both DF tiers)."""
+    from .df_kernels import _df_split
+
+    return _hl_to_rows(_df_split(a))
 
 
 def _pallas_df_call(kernel, trg_hl, src_hl, payload_hl, n_trg, tile_t, tile_s,
@@ -306,6 +305,39 @@ def _require_x64(what):
         raise RuntimeError(
             f"{what} needs jax_enable_x64 for its float64 output "
             "(the pair arithmetic itself is f32)")
+
+
+def _hl_to_rows(hl):
+    """((hi, lo)) pair of [n, c...] arrays -> [2c, n] rows (hi, then lo)."""
+    hi, lo = hl
+    return jnp.concatenate([hi.reshape(hi.shape[0], -1).T,
+                            lo.reshape(lo.shape[0], -1).T], axis=0)
+
+
+def stokeslet_pallas_df_block(trg_hl, src_hl, f_hl, *, interpret: bool = False):
+    """Unscaled DF Stokeslet partial sum for the ring evaluator.
+
+    Same contract as `df_kernels._stokeslet_block_df`: operands are (hi, lo)
+    f32 pairs of [n, 3] arrays (the `parallel.ring._ring_df` split), result
+    is the UNSCALED [t, 3] float64 partial — the ring driver applies
+    1/(8 pi eta) once at the end.
+    """
+    n_trg = trg_hl[0].shape[0]
+    return _pallas_df_call(_stokeslet_df_kernel, _hl_to_rows(trg_hl),
+                           _hl_to_rows(src_hl), _hl_to_rows(f_hl), n_trg,
+                           DF_TILE_T, DF_TILE_S, interpret,
+                           flops_per_pair=320)
+
+
+def stresslet_pallas_df_block(trg_hl, src_hl, s_hl, *, interpret: bool = False):
+    """Unscaled DF stresslet partial (includes the kernel's -3, like
+    `df_kernels._stresslet_block_df`); ``s_hl`` is the (hi, lo) pair of the
+    [n, 3, 3] double-layer source."""
+    n_trg = trg_hl[0].shape[0]
+    u = _pallas_df_call(_stresslet_df_kernel, _hl_to_rows(trg_hl),
+                        _hl_to_rows(src_hl), _hl_to_rows(s_hl), n_trg,
+                        DF_TILE_T, DF_TILE_S, interpret, flops_per_pair=420)
+    return -3.0 * u
 
 
 @partial(jax.jit, static_argnames=("tile_t", "tile_s", "interpret"))

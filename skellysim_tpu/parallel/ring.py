@@ -158,7 +158,23 @@ def ring_stresslet(r_dl, r_trg, f_dl, eta, *, mesh: Mesh,
                       unroll=_pallas_interpret(impl))
 
 
-def _ring_df(block_fn, mesh: Mesh, axis_name: str, r_src, r_trg, payload, eta):
+def _df_ring_block(impl: str, xla_block, pallas_block_name: str):
+    """DF tile dispatch: "df" = the XLA blocks, "pallas_df" = the fused
+    Pallas DF tiles (`ops.pallas_df`), interpret-mode on CPU like the exact
+    pallas ring path. Returns (block_fn, interpret)."""
+    if impl == "df":
+        return xla_block, False
+    if impl == "pallas_df":
+        from ..ops import pallas_df
+
+        interpret = jax.default_backend() == "cpu"
+        return partial(getattr(pallas_df, pallas_block_name),
+                       interpret=interpret), interpret
+    raise ValueError(f"DF ring tiles serve 'df' or 'pallas_df', got {impl!r}")
+
+
+def _ring_df(block_fn, mesh: Mesh, axis_name: str, r_src, r_trg, payload, eta,
+             unroll: bool = False):
     """Shared driver for the double-float ring tiles.
 
     The (hi, lo) f32 split happens OUTSIDE the shard_map so the word pairs
@@ -166,7 +182,8 @@ def _ring_df(block_fn, mesh: Mesh, axis_name: str, r_src, r_trg, payload, eta):
     block in f64 (one exact hi+lo conversion per partial sum, never per
     pair). This is the refinement tile the mixed-precision solver needs on
     a mesh — without it ring+mixed fell back to emulated f64 (~100x f32 on
-    TPU; round-3 verdict weak #6)."""
+    TPU; round-3 verdict weak #6). ``unroll`` is the interpret-mode pallas
+    workaround (see `_pallas_interpret`)."""
     import jax.numpy as _jnp
 
     from ..ops.df_kernels import _df_split
@@ -188,33 +205,39 @@ def _ring_df(block_fn, mesh: Mesh, axis_name: str, r_src, r_trg, payload, eta):
         u = _ring_accumulate(
             lambda sh_r, sl_r, ph_r, pl_r: block_fn(
                 (th_l, tl_l), (sh_r, sl_r), (ph_r, pl_r)),
-            axis_name, n_dev, u0, sh_l, sl_l, ph_l, pl_l)
+            axis_name, n_dev, u0, sh_l, sl_l, ph_l, pl_l, unroll=unroll)
         return u / (8.0 * math.pi) / _jnp.asarray(eta, dtype=jnp.float64)
 
     return jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 6,
-                         out_specs=spec)(th, tl, sh, sl, ph, pl)
+                         out_specs=spec,
+                         check_vma=not unroll)(th, tl, sh, sl, ph, pl)
 
 
-@partial(jax.jit, static_argnames=("mesh", "axis_name"))
+@partial(jax.jit, static_argnames=("mesh", "axis_name", "impl"))
 def ring_stokeslet_df(r_src, r_trg, f_src, eta, *, mesh: Mesh,
-                      axis_name: str = FIBER_AXIS):
+                      axis_name: str = FIBER_AXIS, impl: str = "df"):
     """Ring-parallel double-float Stokeslet (`ops.df_kernels`): ~1e-14-class
     pair accuracy from f32 VPU ops, sharded like `ring_stokeslet`. Returns
-    float64 targets."""
+    float64 targets. ``impl="pallas_df"`` runs the fused Pallas DF tile on
+    each chip (`ops.pallas_df.stokeslet_pallas_df_block`)."""
     from ..ops.df_kernels import _stokeslet_block_df
 
-    return _ring_df(_stokeslet_block_df, mesh, axis_name, r_src, r_trg,
-                    f_src, eta)
+    block, interp = _df_ring_block(impl, _stokeslet_block_df,
+                                   "stokeslet_pallas_df_block")
+    return _ring_df(block, mesh, axis_name, r_src, r_trg, f_src, eta,
+                    unroll=interp)
 
 
-@partial(jax.jit, static_argnames=("mesh", "axis_name"))
+@partial(jax.jit, static_argnames=("mesh", "axis_name", "impl"))
 def ring_stresslet_df(r_dl, r_trg, f_dl, eta, *, mesh: Mesh,
-                      axis_name: str = FIBER_AXIS):
+                      axis_name: str = FIBER_AXIS, impl: str = "df"):
     """Ring-parallel double-float stresslet; ``f_dl`` is [n_src, 3, 3]."""
     from ..ops.df_kernels import _stresslet_block_df
 
-    return _ring_df(_stresslet_block_df, mesh, axis_name, r_dl, r_trg,
-                    f_dl, eta)
+    block, interp = _df_ring_block(impl, _stresslet_block_df,
+                                   "stresslet_pallas_df_block")
+    return _ring_df(block, mesh, axis_name, r_dl, r_trg, f_dl, eta,
+                    unroll=interp)
 
 
 @partial(jax.jit, static_argnames=("mesh", "axis_name"))

@@ -240,10 +240,12 @@ def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct
             f_dl = jnp.concatenate(
                 [f_dl, jnp.zeros((pad, 3, 3), dtype=f_dl.dtype)], axis=0)
         if impl in ("df", "pallas_df"):
-            # see fibers.container.flow_multi: one ring DF tile, both names
+            # see fibers.container.flow_multi: "df" = XLA blocks,
+            # "pallas_df" = fused Pallas DF tile per chip
             from ..parallel.ring import ring_stresslet_df
 
-            return ring_stresslet_df(src, r_trg, f_dl, eta, mesh=mesh)
+            return ring_stresslet_df(src, r_trg, f_dl, eta, mesh=mesh,
+                                     impl=impl)
         from ..parallel.ring import ring_stresslet
 
         return ring_stresslet(src, r_trg, f_dl, eta, mesh=mesh, impl=impl)

@@ -133,6 +133,17 @@ def test_ring_df_tiles_match_f64_direct():
            / np.linalg.norm(np.asarray(ref_s)))
     assert err < 1e-12, err
 
+    # the fused Pallas DF tiles ride the same ring (interpret mode here):
+    # same DF-class agreement against the native-f64 dense kernels
+    out_p = ring_stokeslet_df(r, r, f, 1.2, mesh=mesh, impl="pallas_df")
+    err = np.linalg.norm(np.asarray(out_p - ref)) / np.linalg.norm(
+        np.asarray(ref))
+    assert err < 1e-12, err
+    out_ps = ring_stresslet_df(r, r, S, 1.2, mesh=mesh, impl="pallas_df")
+    err = (np.linalg.norm(np.asarray(out_ps - ref_s))
+           / np.linalg.norm(np.asarray(ref_s)))
+    assert err < 1e-12, err
+
 
 def test_ring_pallas_impl_matches_single_program():
     """Ring evaluation with the Pallas VMEM tiles (interpret mode on the CPU
