@@ -688,6 +688,7 @@ def _group_kernels(extra, ck, on_acc):
 
     # double-float f32 kernel: f64-class accuracy without emulated f64
     # (ops/df_kernels.py) — rate + achieved error vs the exact path
+    ref_df = None
     if _remaining() > 60:
         try:
             from skellysim_tpu.ops import kernels as _k
@@ -697,16 +698,35 @@ def _group_kernels(extra, ck, on_acc):
             r, f = _kernel_inputs(jnp.float32, n_df)
             rate_df = _rate(lambda: stokeslet_direct_df(r, r, f, 1.0),
                             n_df * n_df)
-            ref = np.asarray(_k.stokeslet_direct(
+            ref_df = np.asarray(_k.stokeslet_direct(
                 r.astype(jnp.float64), r.astype(jnp.float64),
                 f.astype(jnp.float64), 1.0))
             got = np.asarray(stokeslet_direct_df(r, r, f, 1.0))
             extra["stokeslet_df"] = {
                 "n": n_df, "gpairs_per_s": round(rate_df / 1e9, 4),
-                "rel_err_vs_f64": float(np.linalg.norm(got - ref)
-                                        / np.linalg.norm(ref))}
+                "rel_err_vs_f64": float(np.linalg.norm(got - ref_df)
+                                        / np.linalg.norm(ref_df))}
         except Exception as e:
             extra["stokeslet_df"] = {"error": _short_err(e)}
+        ck()
+
+    # fused Pallas DF tile (round 5, accelerator only): same f64-grade
+    # accuracy class with the whole chain in VMEM — the rate here plus the
+    # rel_err on real Mosaic is the promotion gate for refine_pair_impl
+    # "auto" -> "pallas_df"
+    if on_acc and ref_df is not None and _remaining() > 60:
+        try:
+            from skellysim_tpu.ops.pallas_df import stokeslet_pallas_df
+
+            rate_p = _rate(lambda: stokeslet_pallas_df(r, r, f, 1.0),
+                           n_df * n_df)
+            got = np.asarray(stokeslet_pallas_df(r, r, f, 1.0))
+            extra["stokeslet_pallas_df"] = {
+                "n": n_df, "gpairs_per_s": round(rate_p / 1e9, 4),
+                "rel_err_vs_f64": float(np.linalg.norm(got - ref_df)
+                                        / np.linalg.norm(ref_df))}
+        except Exception as e:
+            extra["stokeslet_pallas_df"] = {"error": _short_err(e)}
         ck()
 
     # Pallas fused tiles (accelerator only): report whichever path wins

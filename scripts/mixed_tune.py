@@ -36,6 +36,13 @@ def main():
                     help="comma list to sweep refine_pair_impl at the best "
                          "inner_tol, e.g. 'df,pallas_df,exact'")
     args = ap.parse_args()
+    impls = [s for s in args.refine_impls.split(",") if s]
+    bad = set(impls) - {"exact", "df", "pallas_df"}
+    if bad:
+        # dataclasses.replace skips System.__init__'s validation; a typo'd
+        # name would silently bench the exact tile under the wrong label —
+        # and must fail HERE, not after the minutes-long inner_tol sweep
+        raise SystemExit(f"unknown refine impls: {sorted(bad)}")
 
     import jax
 
@@ -71,18 +78,19 @@ def main():
         if out["residual_true"] <= args.tol and out["wall_s"] < best[1]:
             best = (inner, out["wall_s"])
 
-    impls = [s for s in args.refine_impls.split(",") if s]
-    bad = set(impls) - {"exact", "df", "pallas_df"}
-    if bad:
-        # dataclasses.replace skips System.__init__'s validation; a typo'd
-        # name would silently bench the exact tile under the wrong label
-        raise SystemExit(f"unknown refine impls: {sorted(bad)}")
+    if impls and best[0] is None:
+        # no swept inner_tol validated against --tol: benching impls at an
+        # arbitrary tolerance would misread as a validated winner
+        print(json.dumps({"refine_impl_sweep": "skipped",
+                          "reason": f"no inner_tol reached {args.tol}"}),
+              flush=True)
+        impls = []
     for impl in impls:
         system.params = dataclasses.replace(
-            system.params, inner_tol=best[0] or 1e-4, refine_pair_impl=impl)
+            system.params, inner_tol=best[0], refine_pair_impl=impl)
         out = bench._solve_rate(system, state, trials=args.trials)
         print(json.dumps({"refine_pair_impl": impl,
-                          "inner_tol": best[0] or 1e-4, **out}), flush=True)
+                          "inner_tol": best[0], **out}), flush=True)
 
 
 if __name__ == "__main__":
