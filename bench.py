@@ -258,6 +258,7 @@ def _solve_rate(system, state, trials=3):
     resid = float(info.residual)  # host fetch: the real completion barrier
     wall = (time.perf_counter() - t0) / trials
     return {"wall_s": round(wall, 4), "iters": int(info.iters),
+            "refines": int(info.refines),
             "residual": resid, "residual_true": float(info.residual_true),
             "solves_per_s": round(1.0 / wall, 2)}
 
@@ -714,19 +715,26 @@ def _group_kernels(extra, ck, on_acc):
     # accuracy class with the whole chain in VMEM — the rate here plus the
     # rel_err on real Mosaic is the promotion gate for refine_pair_impl
     # "auto" -> "pallas_df"
-    if on_acc and ref_df is not None and _remaining() > 60:
-        try:
-            from skellysim_tpu.ops.pallas_df import stokeslet_pallas_df
-
-            rate_p = _rate(lambda: stokeslet_pallas_df(r, r, f, 1.0),
-                           n_df * n_df)
-            got = np.asarray(stokeslet_pallas_df(r, r, f, 1.0))
+    if on_acc and _remaining() > 60:
+        if ref_df is None:
+            # distinguish "no reference available" (the stokeslet_df step
+            # failed or was itself budget-skipped) from "never ran"
             extra["stokeslet_pallas_df"] = {
-                "n": n_df, "gpairs_per_s": round(rate_p / 1e9, 4),
-                "rel_err_vs_f64": float(np.linalg.norm(got - ref_df)
-                                        / np.linalg.norm(ref_df))}
-        except Exception as e:
-            extra["stokeslet_pallas_df"] = {"error": _short_err(e)}
+                "skipped": "no f64 reference (stokeslet_df step failed or "
+                           "was skipped)"}
+        else:
+            try:
+                from skellysim_tpu.ops.pallas_df import stokeslet_pallas_df
+
+                rate_p = _rate(lambda: stokeslet_pallas_df(r, r, f, 1.0),
+                               n_df * n_df)
+                got = np.asarray(stokeslet_pallas_df(r, r, f, 1.0))
+                extra["stokeslet_pallas_df"] = {
+                    "n": n_df, "gpairs_per_s": round(rate_p / 1e9, 4),
+                    "rel_err_vs_f64": float(np.linalg.norm(got - ref_df)
+                                            / np.linalg.norm(ref_df))}
+            except Exception as e:
+                extra["stokeslet_pallas_df"] = {"error": _short_err(e)}
         ck()
 
     # Pallas fused tiles (accelerator only): report whichever path wins

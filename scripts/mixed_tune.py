@@ -36,8 +36,10 @@ def main():
                     help="comma list to sweep refine_pair_impl at the best "
                          "inner_tol, e.g. 'df,pallas_df,exact'")
     args = ap.parse_args()
+    from skellysim_tpu.params import REFINE_PAIR_IMPLS
+
     impls = [s for s in args.refine_impls.split(",") if s]
-    bad = set(impls) - {"exact", "df", "pallas_df"}
+    bad = set(impls) - set(REFINE_PAIR_IMPLS)
     if bad:
         # dataclasses.replace skips System.__init__'s validation; a typo'd
         # name would silently bench the exact tile under the wrong label —

@@ -16,7 +16,6 @@ import itertools
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -40,6 +39,9 @@ def main():
         from skellysim_tpu.utils.bootstrap import force_cpu_devices
 
         force_cpu_devices()
+        # interpret mode evaluates grid cells at Python speed: the TPU
+        # default (16384) would run for hours; clamp to smoke scale
+        args.n = min(args.n, 512)
     import jax
 
     jax.config.update("jax_enable_x64", True)
@@ -57,30 +59,26 @@ def main():
     S = jnp.asarray(rng.standard_normal((n, 3, 3)), dtype=jnp.float64)
     print(json.dumps({"backend": jax.default_backend(), "n": n}), flush=True)
 
-    # accuracy oracle on a subsample (full f64 dense is slow on TPU)
+    import bench  # shared timing helper (host-fetch barrier, see bench._rate)
+
+    # accuracy oracle on a subsample (full f64 dense is slow on TPU);
+    # compute only the selected kernels' references — emulated-f64 work for
+    # a deselected kernel is pure waste on the chip
     sub = np.random.default_rng(0).choice(n, size=min(n, 256), replace=False)
-    ref_sto = np.asarray(kernels.stokeslet_direct(r, r[sub], f, 1.0))
-    ref_str = np.asarray(kernels.stresslet_direct(r, r[sub], S, 1.0))
+    cases = []
+    if args.kernel in ("stokeslet", "both"):
+        cases.append(("stokeslet", stokeslet_pallas_df, f,
+                      np.asarray(kernels.stokeslet_direct(r, r[sub], f, 1.0))))
+    if args.kernel in ("stresslet", "both"):
+        cases.append(("stresslet", stresslet_pallas_df, S,
+                      np.asarray(kernels.stresslet_direct(r, r[sub], S, 1.0))))
 
-    def rate(fn):
-        np.asarray(fn())  # compile + drain
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(args.trials):
-            out = fn()
-        np.asarray(out)  # host fetch barrier (see bench._rate)
-        return n * n * args.trials / (time.perf_counter() - t0)
-
-    cases = [c for c in
-             (("stokeslet", stokeslet_pallas_df, f, ref_sto),
-              ("stresslet", stresslet_pallas_df, S, ref_str))
-             if args.kernel in (c[0], "both")]
     for tt, ts in itertools.product(TILES_T, TILES_S):
         for name, fn, payload, ref in cases:
             try:
                 call = lambda: fn(r, r, payload, 1.0, tile_t=tt, tile_s=ts,
                                   interpret=args.interpret)
-                rr = rate(call)
+                rr = bench._rate(call, n * n, trials=args.trials)
                 err = (np.linalg.norm(np.asarray(call())[sub] - ref)
                        / np.linalg.norm(ref))
                 print(json.dumps({"kernel": name, "tile": [tt, ts],

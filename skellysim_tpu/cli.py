@@ -91,6 +91,18 @@ def main(argv=None) -> None:
                         format="[%(asctime)s] [%(levelname)s] %(message)s",
                         stream=sys.stderr)
 
+    # the builder's default f64 state is only real under x64: without this,
+    # every array silently canonicalizes to f32 and a gmres_tol of 1e-10
+    # floors at ~1e-5 while steps are still "accepted" (found by round-5
+    # verify — the same silent-degradation class as the precompute CLI).
+    # On TPU, f64 states route through the mixed-precision solver because
+    # solver_precision DEFAULTS to "auto" (params.py/schema.py — "mixed" on
+    # accelerators, "full" on CPU), so x64 does not put the hot loop on the
+    # f32-only-LU / emulated-f64 cliff.
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
     # multi-host bring-up (no-op single-process; the analogue of the
     # reference's MPI_Init, `skelly_sim.cpp:14`) — must run before any JAX
     # backend init so every host joins the same runtime

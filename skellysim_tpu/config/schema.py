@@ -184,7 +184,7 @@ class Params:
     # solver precision tier ("full"/"mixed"/"auto" — auto = mixed on
     # accelerators for f64 states, full elsewhere), Ewald evaluator
     # tolerance, pairwise tile, and the mixed solver's refinement tile
-    solver_precision: str = "full"
+    solver_precision: str = "auto"
     ewald_tol: float = 1e-6
     kernel_impl: str = "exact"
     refine_pair_impl: str = "auto"

@@ -11,6 +11,10 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 
+#: valid Params.refine_pair_impl names — the single source for
+#: System.__init__'s validation and the tuning scripts' argument checks
+REFINE_PAIR_IMPLS = ("auto", "exact", "df", "pallas_df")
+
 
 @dataclass(frozen=True)
 class DynamicInstability:
@@ -95,14 +99,20 @@ class Params:
     #             and LU is f32-only); "full" otherwise. On CPU, measured
     #             mixed/full ratios are 2-3.5x SLOWER (f32 buys no CPU
     #             flops but refinement sweeps still repeat the solve), so
-    #             the fallback is automatic rather than documented-only
-    solver_precision: str = "full"
+    #             the fallback is automatic rather than documented-only.
+    # "auto" is the DEFAULT (round 5): the CLI builds f64 states, and a
+    # "full" default would land default-config TPU runs on the f32-only LU
+    # / emulated-f64 cliff the tier exists to avoid; on CPU "auto"
+    # resolves to "full", i.e. exactly the old behavior
+    solver_precision: str = "auto"
     # inner (f32) GMRES tolerance per refinement sweep in "mixed" mode;
-    # each sweep contracts the error by about this factor. A loose inner
-    # tolerance wins: measured at walkthrough scale, 1e-4 converges to
-    # 1e-10 in ~12 total inner iterations vs ~19 at 1e-6 (more sweeps,
-    # but each sweep's Krylov solve is much shorter)
-    inner_tol: float = 1e-4
+    # each sweep contracts the error by about this factor. The trade is
+    # sweeps (one expensive high-precision residual matvec each) against
+    # inner iterations (cheap f32). Measured on the walkthrough scene
+    # (scripts/mixed_tune.py, r5): 1e-5 reaches 1e-10 in ~9 total inner
+    # iterations at ~2 sweeps vs 1e-4's ~12 iterations at 3 sweeps — fewer
+    # of BOTH costs; 1e-6 flips back to ~13 iterations. Hence 1e-5.
+    inner_tol: float = 1e-5
     # pairwise-kernel tile for the f64 refinement residual (and prep flows)
     # in "mixed" mode: "exact" = native f64 (fast on CPU, ~100x slower than
     # f32 on TPUs, whose f64 is software-emulated), "df" = double-float f32
@@ -112,7 +122,7 @@ class Params:
     # round trips), "auto" = "df" on accelerators, "exact" on CPU. The ring
     # evaluator serves both DF spellings with its own double-float tiles
     # (`parallel.ring.ring_stokeslet_df` / `ring_stresslet_df`)
-    refine_pair_impl: str = "auto"
+    refine_pair_impl: str = "auto"  # one of REFINE_PAIR_IMPLS
     # max refinement sweeps in "mixed" mode
     max_refine: int = 8
     # coupled-solve preconditioner structure. The reference preconditions

@@ -39,6 +39,13 @@ class GmresResult(NamedTuple):
     #: implicit residual can drift from the true one; compare the two to
     #: detect loss of accuracy.
     residual_true: jnp.ndarray
+    #: refinement sweeps taken (`gmres_ir` only; 0 for plain `gmres`).
+    #: Each sweep costs one HIGH-precision residual matvec — the dominant
+    #: per-sweep cost at scale on TPU, so tuning `inner_tol` is about this
+    #: count as much as about total inner iterations. Plain int default (a
+    #: jnp scalar here would initialize the JAX backend at import time —
+    #: a hang when the TPU tunnel is wedged).
+    refines: int | jnp.ndarray = 0
 
 
 def _icgs(V, w, k, n_restart):
@@ -239,4 +246,5 @@ def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
     x, _, r_rel, outers, iters = lax.while_loop(
         cond, body, (x0, b, init_rel, jnp.int32(0), jnp.int32(0)))
     return GmresResult(x=x, iters=iters, residual=r_rel,
-                       converged=r_rel <= tol, residual_true=r_rel)
+                       converged=r_rel <= tol, residual_true=r_rel,
+                       refines=outers)

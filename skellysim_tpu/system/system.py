@@ -25,7 +25,7 @@ logger = logging.getLogger("skellysim_tpu")
 
 from ..bodies import bodies as bd
 from ..fibers import container as fc
-from ..params import Params
+from ..params import Params, REFINE_PAIR_IMPLS
 from ..periphery import periphery as peri
 from ..periphery.periphery import PeripheryShape, PeripheryState
 from ..solver import gmres, gmres_ir
@@ -90,6 +90,8 @@ class StepInfo(NamedTuple):
     #: converged by the implicit residual but the explicit one disagrees by
     #: >10x tol — Belos' loss-of-accuracy analogue (`solver_hydro.cpp:85-92`)
     loss_of_accuracy: jnp.ndarray = False
+    #: mixed-mode refinement sweeps (`solver.gmres_ir`); 0 for full precision
+    refines: int | jnp.ndarray = 0
 
 
 def solution_from_state(state: SimState):
@@ -138,10 +140,10 @@ class System:
         # device mesh for the ring pair evaluator (params.pair_evaluator="ring");
         # GSPMD sharding via parallel.shard_state needs no mesh here
         self.mesh = mesh
-        if params.refine_pair_impl not in ("auto", "exact", "df", "pallas_df"):
+        if params.refine_pair_impl not in REFINE_PAIR_IMPLS:
             raise ValueError(
                 f"unknown refine_pair_impl {params.refine_pair_impl!r}; "
-                "use 'auto', 'exact', 'df', or 'pallas_df'")
+                f"use one of {REFINE_PAIR_IMPLS}")
         if params.precond not in ("gs", "jacobi"):
             raise ValueError(
                 f"unknown precond {params.precond!r}; use 'gs' or 'jacobi'")
@@ -805,7 +807,8 @@ class System:
                         residual_true=result.residual_true,
                         loss_of_accuracy=(result.converged
                                           & (result.residual_true
-                                             > 10.0 * p.gmres_tol)))
+                                             > 10.0 * p.gmres_tol)),
+                        refines=result.refines)
         return new_state, result.x, info
 
     # -------------------------------------------------------- velocity field
@@ -1091,6 +1094,16 @@ class System:
                 int(info.iters), residual,
                 float(info.residual_true), fiber_error,
                 "accepted" if accept else "rejected", wall_s)
+            if not converged and accept:
+                # without adaptive timestepping a non-converged solve is
+                # still accepted (the reference's loop likewise only rejects
+                # under the adaptive gate) — but never silently: the
+                # round-5 x64 CLI bug surfaced as exactly this, a 1e-10
+                # request quietly flooring at f32 noise
+                logger.warning(
+                    "GMRES did not converge: residual %.3e (true %.3e) vs "
+                    "tol %.1e; step accepted (adaptive timestep off)",
+                    residual, float(info.residual_true), p.gmres_tol)
             if bool(info.loss_of_accuracy):
                 # `solver_hydro.cpp:85-92`: implicit convergence with a
                 # drifted explicit residual means the answer is worse than

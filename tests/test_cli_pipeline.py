@@ -33,6 +33,51 @@ def _free_fiber_config(tmp_path, n_nodes=16):
     return path
 
 
+@pytest.mark.slow
+def test_cli_subprocess_enables_x64(tmp_path):
+    """`python -m skellysim_tpu` must converge a 1e-10 mixed solve: without
+    the CLI's x64 enable the builder's "f64" state silently canonicalizes to
+    f32 and the residual floors at ~1e-5 while steps are still accepted
+    (found by round-5 verify — the same class as the precompute CLI bug)."""
+    import subprocess
+    import sys
+
+    cfg = Config()
+    cfg.params.eta = 1.0
+    cfg.params.dt_initial = 0.005
+    cfg.params.dt_write = 0.005
+    cfg.params.t_final = 0.02
+    cfg.params.gmres_tol = 1e-10
+    # mixed precision exercises the refinement ladder the bug starved
+    cfg.params.solver_precision = "mixed"
+    cfg.params.adaptive_timestep_flag = False
+    fib = Fiber(n_nodes=16, length=1.0, bending_rigidity=0.01)
+    fib.fill_node_positions(np.zeros(3), np.array([0.0, 0.0, 1.0]))
+    cfg.fibers = [fib]
+    cfg.background = BackgroundSource(uniform=[1.0, 0.0, 0.0])
+    cfg_path = str(tmp_path / "skelly_config.toml")
+    cfg.save(cfg_path)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # subprocess skips conftest's CPU pin
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # PYTHONPATH = repo ONLY: inheriting the session's .axon_site
+    # sitecustomize can hang the subprocess at plugin init when the TPU
+    # tunnel is wedged, regardless of JAX_PLATFORMS
+    env["PYTHONPATH"] = repo
+    p = subprocess.run([sys.executable, "-m", "skellysim_tpu",
+                       f"--config-file={cfg_path}", "--overwrite"],
+                      capture_output=True, text=True, timeout=420, env=env,
+                      cwd=str(tmp_path))
+    assert p.returncode == 0, p.stderr[-2000:]
+    steps = [ln for ln in p.stderr.splitlines() if "step t=" in ln]
+    assert steps, p.stderr[-1000:]
+    for ln in steps:
+        residual = float(ln.split("residual=")[1].split(" ")[0])
+        assert residual <= 1e-10, ln
+    assert "did not converge" not in p.stderr
+
+
 def test_cli_metrics_file(tmp_path):
     """--metrics-file appends one JSON step record per trial step
     (structured metrics, SURVEY.md §5.1/§5.5)."""
