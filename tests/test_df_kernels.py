@@ -11,6 +11,7 @@ inputs via hi/lo splitting.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from skellysim_tpu.ops import kernels
 from skellysim_tpu.ops.df_kernels import (_df_rsqrt, _two_prod, _two_sum,
@@ -41,6 +42,7 @@ def test_df_rsqrt_full_precision_under_jit():
     assert rel.max() < 1e-13, rel.max()
 
 
+@pytest.mark.slow
 def test_stokeslet_df_beats_reference_gate_f32_inputs():
     rng = np.random.default_rng(5)
     n = 1500
@@ -104,6 +106,7 @@ def test_stokeslet_df_near_pairs_f64():
         assert err < gate, (sep, err)
 
 
+@pytest.mark.slow
 def test_stresslet_df_beats_reference_gate():
     """DF stresslet vs the native-f64 kernel at both f32 and f64 inputs."""
     from skellysim_tpu.ops.df_kernels import stresslet_direct_df
@@ -135,6 +138,22 @@ def test_stresslet_df_beats_reference_gate():
     np.testing.assert_allclose(a, b, rtol=0, atol=1e-13)
 
 
+def test_df_dispatch_smoke():
+    """Fast-tier guard for the `impl="df"` dispatch (the accelerator-default
+    refinement path): tiny n so the per-commit tier keeps covering the
+    seam while the thorough block-shape/accuracy tests are slow-marked."""
+    rng = np.random.default_rng(13)
+    r = jnp.asarray(rng.uniform(-3, 3, (48, 3)))
+    f = jnp.asarray(rng.standard_normal((48, 3)))
+    via_seam = np.asarray(kernels.stokeslet_direct(r, r, f, 1.0, impl="df"))
+    assert via_seam.dtype == np.float64
+    ref = np.asarray(kernels.stokeslet_direct(
+        r.astype(jnp.float64), r.astype(jnp.float64),
+        f.astype(jnp.float64), 1.0))
+    assert np.linalg.norm(via_seam - ref) / np.linalg.norm(ref) < 1e-12
+
+
+@pytest.mark.slow
 def test_df_impl_through_kernel_seam():
     """`impl="df"` on the public kernels dispatches to the DF tiles."""
     rng = np.random.default_rng(13)

@@ -8,6 +8,7 @@ closed form or the dense `kernels.stokeslet_direct` sum.
 import math
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -312,6 +313,7 @@ def test_block_sparse_near_field_on_fiber_cloud():
     assert agree < 1e-5, agree
 
 
+@pytest.mark.slow
 def test_blocks_plan_probe_targets_fall_back_to_cells():
     """Disjoint probe targets on a blocks-mode plan must not lose near-field
     pairs to partition misalignment (reviewer-reproduced failure: a probe
@@ -416,6 +418,7 @@ def _coupled_ewald_scene(dtype, n_fib=6, n_nodes=16):
     return fibers, shell, shape, bodies
 
 
+@pytest.mark.slow
 def test_coupled_solve_shell_body_through_ewald():
     """The full one-evaluator-serves-all seam (`include/kernels.hpp:56-134`,
     `periphery.cpp:337-352`, `body_container.cpp:552-573`): with
@@ -447,6 +450,7 @@ def test_coupled_solve_shell_body_through_ewald():
     assert err < 1e-5, err
 
 
+@pytest.mark.slow
 def test_mixed_precision_with_ewald_reaches_gmres_tol():
     """mixed + ewald: the f64 refinement residual and prep flows stay DENSE
     (role-gated plan withholding), so a deliberately coarse ewald_tol=1e-4

@@ -13,6 +13,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from skellysim_tpu.bodies import bodies as bd
 from skellysim_tpu.fibers import container as fc
@@ -186,6 +187,7 @@ def test_f32_solution_quality_vs_f64():
     assert err < 5e-3, err
 
 
+@pytest.mark.slow
 def test_mixed_df_refinement_matches_exact_refinement():
     """refine_pair_impl="df" (the accelerator default: double-float f32
     residual/prep flows) reaches gmres_tol and agrees with native-f64

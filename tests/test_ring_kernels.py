@@ -106,6 +106,7 @@ def test_ring_mxu_impl_matches_single_program():
     assert err < 1e-9, err
 
 
+@pytest.mark.slow
 def test_ring_df_tiles_match_f64_direct():
     """Double-float ring tiles (the mixed solver's refinement matvec on a
     mesh) reach DF-class agreement with native-f64 dense kernels — f32
