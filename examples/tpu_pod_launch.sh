@@ -1,0 +1,42 @@
+#!/bin/bash
+# Multi-host launch examples for a TPU pod slice (or any multi-process run).
+#
+# Parity slot: the reference ships a SLURM submission example that mpiruns
+# the binary across nodes (`/root/reference/examples/skelly_sim_slurm_sbatch.sh`);
+# the TPU-native equivalent launches ONE PYTHON PROCESS PER HOST, and
+# `jax.distributed` + GSPMD do what mpirun + MPI collectives did — ICI
+# collectives within a slice, DCN across slices
+# (`skellysim_tpu/parallel/multihost.py`).
+#
+# ----------------------------------------------------------------- Cloud TPU
+# On a Cloud TPU pod slice, jax.distributed.initialize() autodiscovers the
+# topology from the metadata server — run the SAME command on every host:
+#
+#   gcloud compute tpus tpu-vm ssh "$TPU_NAME" --worker=all --command='
+#     cd ~/skellysim_tpu &&
+#     python -m skellysim_tpu --config-file=skelly_config.toml'
+#
+# --------------------------------------------------------------------- SLURM
+# On a SLURM cluster fronting TPU/accelerator hosts (the reference's cluster
+# shape), submit with one task per host; the coordinator is task 0's host:
+#
+#   #SBATCH --nodes=4
+#   #SBATCH --ntasks-per-node=1
+#
+#   head=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1)
+#   srun bash -c '
+#     SKELLY_COORDINATOR='"$head"':8476 \
+#     SKELLY_NUM_PROCS=$SLURM_NTASKS \
+#     SKELLY_PROC_ID=$SLURM_PROCID \
+#       python -m skellysim_tpu --config-file=skelly_config.toml'
+#
+# Every process writes nothing except process 0 (trajectory funnels there,
+# like the reference's rank 0); resume is rank-count-INDEPENDENT (the RNG
+# streams are not per-rank, unlike the reference's
+# `trajectory_reader.cpp:204-219` restriction).
+#
+# ---------------------------------------------------------------- two-host smoke
+# The in-repo smoke test of this path (two processes on one machine over
+# loopback, CPU devices) is `tests/test_multihost.py` — the analogue of the
+# reference's `mpiexec -n 2` ctest tier.
+echo "This file is documentation — read the comments and adapt to your cluster."
