@@ -106,3 +106,20 @@ def test_run_with_profiler_trace(tmp_path):
     system.run(state, max_steps=1, profile_dir=prof)
     found = [os.path.join(dp, f) for dp, _, fs in os.walk(prof) for f in fs]
     assert found, "no profiler artifacts written"
+
+
+def test_adaptive_rejection_aborts_below_dt_min():
+    """The adaptive loop's hard abort when dt underflows dt_min
+    (`system.cpp:548-551`): an unreachable fiber_error_tol forces every
+    step to be rejected and halved until the RuntimeError fires."""
+    params = Params(eta=0.7, dt_initial=1e-3, dt_min=4e-4, dt_max=1e-3,
+                    beta_down=0.5, t_final=1.0, gmres_tol=1e-10,
+                    fiber_error_tol=1e-30,  # nothing can meet this
+                    adaptive_timestep_flag=True)
+    system = System(params)
+    fibers = fc.make_group(straight_fiber(), lengths=0.75,
+                           bending_rigidity=0.0025, radius=0.0125)
+    background = BackgroundFlow.make(uniform=(1.0, 2.0, 3.0))
+    state = system.make_state(fibers=fibers, background=background)
+    with pytest.raises(RuntimeError, match="dt_min"):
+        system.run(state)
