@@ -248,10 +248,13 @@ def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
     if evaluator == "ring" and mesh is not None:
         if impl in ("df", "pallas_df"):
             # the DF ring entry point serves both spellings: "df" runs the
-            # XLA blocks, "pallas_df" the fused Pallas DF tile per chip
+            # XLA blocks, "pallas_df" the fused Pallas DF tile per chip.
+            # Cast back to the target dtype like the direct seam — the f64
+            # ring output would otherwise promote an f32 solve's pipeline
             from ..parallel.ring import ring_stokeslet_df
 
-            vel = ring_stokeslet_df(pos, r_trg, wf, eta, mesh=mesh, impl=impl)
+            vel = ring_stokeslet_df(pos, r_trg, wf, eta, mesh=mesh,
+                                    impl=impl).astype(r_trg.dtype)
         else:
             from ..parallel.ring import ring_stokeslet
 

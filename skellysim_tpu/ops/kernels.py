@@ -254,23 +254,32 @@ def stokeslet_direct(r_src, r_trg, f_src, eta, *, block_size: int = 4096,
     ``impl="mxu"`` selects the matmul-form tile (`stokeslet_block_mxu`) that
     moves the O(N^2 * 3) contractions onto the MXU — see its numerics caveat
     and per-source-block recentering. ``impl="df"`` evaluates in double-float
-    f32 arithmetic (`df_kernels.stokeslet_direct_df`, ~1e-14 relative, f64
-    output) — the accuracy tier for refinement residuals on hardware whose
+    f32 arithmetic (`df_kernels.stokeslet_direct_df`, ~1e-14 per-pair
+    relative) — the accuracy tier for refinement residuals on hardware whose
     native f64 is emulated. ``impl="pallas_df"`` is the same arithmetic as a
     fused Pallas VMEM tile (`pallas_df.stokeslet_pallas_df`) — Mosaic on
-    real TPUs, interpret mode on CPU.
+    real TPUs, interpret mode on CPU. The DF tiers return ``r_trg.dtype``
+    like every other impl (an f32 solve must not silently promote to f64);
+    callers that want the f64-valued result of f32 inputs use the DF
+    kernels directly.
     """
     if impl == "pallas_df":
         from .pallas_df import stokeslet_pallas_df
 
-        return stokeslet_pallas_df(r_src, r_trg, f_src, eta,
-                                   interpret=jax.default_backend() == "cpu")
+        u = stokeslet_pallas_df(r_src, r_trg, f_src, eta,
+                                interpret=jax.default_backend() == "cpu")
+        # seam contract: preserve the caller's dtype — the DF tiles return
+        # f64 unconditionally, which silently promoted an f32 solve's whole
+        # Krylov pipeline to f64 (callers wanting the f64 output call the
+        # DF kernels directly)
+        return u.astype(r_trg.dtype)
     if impl == "df":
         from .df_kernels import stokeslet_direct_df
 
-        return stokeslet_direct_df(
+        u = stokeslet_direct_df(
             r_src, r_trg, f_src, eta, block_size=min(block_size, 1024),
             source_block=source_block or 4096)
+        return u.astype(r_trg.dtype)  # see the pallas_df branch
     impl = pallas_impl_for(impl, r_trg, r_src, f_src)
     if impl == "pallas":
         # fused VMEM-tile kernel (`ops.pallas_kernels`); Mosaic lowering on
@@ -300,20 +309,23 @@ def stresslet_direct(r_dl, r_trg, f_dl, eta, *, block_size: int = 4096,
     ``impl="mxu"`` selects the matmul-form tile (`stresslet_block_mxu`,
     recentered per source block on its first point — see
     `stokeslet_block_mxu`'s caveat). ``impl="df"`` evaluates in double-float
-    f32 arithmetic (`df_kernels.stresslet_direct_df`, f64 output);
-    ``impl="pallas_df"`` is the fused Pallas tile of the same arithmetic.
+    f32 arithmetic (`df_kernels.stresslet_direct_df`); ``impl="pallas_df"``
+    is the fused Pallas tile of the same arithmetic. Both return
+    ``r_trg.dtype`` (see `stokeslet_direct`).
     """
     if impl == "pallas_df":
         from .pallas_df import stresslet_pallas_df
 
-        return stresslet_pallas_df(r_dl, r_trg, f_dl, eta,
-                                   interpret=jax.default_backend() == "cpu")
+        u = stresslet_pallas_df(r_dl, r_trg, f_dl, eta,
+                                interpret=jax.default_backend() == "cpu")
+        return u.astype(r_trg.dtype)  # see stokeslet_direct's pallas_df branch
     if impl == "df":
         from .df_kernels import stresslet_direct_df
 
-        return stresslet_direct_df(
+        u = stresslet_direct_df(
             r_dl, r_trg, f_dl, eta, block_size=min(block_size, 1024),
             source_block=source_block or 4096)
+        return u.astype(r_trg.dtype)  # see stokeslet_direct's pallas_df branch
     impl = pallas_impl_for(impl, r_trg, r_dl, f_dl)
     if impl == "pallas":
         # see `stokeslet_direct`'s pallas branch

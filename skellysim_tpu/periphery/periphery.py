@@ -241,11 +241,12 @@ def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct
                 [f_dl, jnp.zeros((pad, 3, 3), dtype=f_dl.dtype)], axis=0)
         if impl in ("df", "pallas_df"):
             # see fibers.container.flow_multi: "df" = XLA blocks,
-            # "pallas_df" = fused Pallas DF tile per chip
+            # "pallas_df" = fused Pallas DF tile per chip; cast back to the
+            # target dtype like the direct seam
             from ..parallel.ring import ring_stresslet_df
 
             return ring_stresslet_df(src, r_trg, f_dl, eta, mesh=mesh,
-                                     impl=impl)
+                                     impl=impl).astype(r_trg.dtype)
         from ..parallel.ring import ring_stresslet
 
         return ring_stresslet(src, r_trg, f_dl, eta, mesh=mesh, impl=impl)

@@ -113,3 +113,21 @@ def test_fiberless_f32_state_stays_f32():
     new_state, solution, info = system.step(state)
     assert solution.dtype == jnp.float32
     assert bool(info.converged)
+
+
+def test_df_tier_kernel_impl_preserves_f32_solve_dtype():
+    """The DF tiles return float64 internally; the evaluator seam must cast
+    back so an f32 solve with kernel_impl="df"/"pallas_df" stays f32 end to
+    end (round 5: the unconverted f64 flow promoted the whole Krylov
+    pipeline)."""
+    import dataclasses
+
+    from __graft_entry__ import _make_system
+
+    for impl in ("df", "pallas_df"):
+        system, state = _make_system(n_fibers=2, n_nodes=16,
+                                     dtype=jnp.float32)
+        system.params = dataclasses.replace(system.params, kernel_impl=impl)
+        _, solution, info = jax.jit(system._solve_impl)(state)
+        assert solution.dtype == jnp.float32, impl
+        assert bool(info.converged), impl
