@@ -4,10 +4,12 @@
 # this script is the equivalent in-repo entry point (VERDICT r4 #3).
 #
 # Usage: ci/run_ci.sh [fast|full|nightly]
-#   fast    — per-commit gate: byte-compile lint + the non-slow, non-tpu
-#             suite on the 8-device virtual CPU mesh (~17 min measured on
-#             the 1-core build box; integration tests > 45 s are
-#             slow-marked to keep this tier per-commit-sized)
+#   fast    — per-commit gate: byte-compile lint + the skelly-lint static
+#             analysis gate (dtype/trace/sharding discipline, docs/lint.md)
+#             + the non-slow, non-tpu suite on the 8-device virtual CPU
+#             mesh (~17 min measured on the 1-core build box; integration
+#             tests > 45 s are slow-marked to keep this tier
+#             per-commit-sized)
 #   full    — pre-merge: everything but tpu-marked tests (~35 min on the
 #             1-core box)
 #   nightly — full suite including @pytest.mark.tpu (needs the tunnel up)
@@ -17,6 +19,11 @@ TIER="${1:-fast}"
 
 echo "== lint: byte-compile every source file =="
 python -m compileall -q skellysim_tpu tests scripts ci bench.py __graft_entry__.py
+
+echo "== lint: skelly-lint static analysis (dtype/trace/sharding) =="
+# gating in EVERY tier: a dtype leak or host sync on the hot path is exactly
+# the class of defect value-checking tests miss (commit 46b498b; docs/lint.md)
+JAX_PLATFORMS=cpu python -m skellysim_tpu.lint skellysim_tpu/
 
 echo "== docs: config reference in sync with the schema =="
 JAX_PLATFORMS=cpu python scripts/gen_config_reference.py --check

@@ -203,7 +203,7 @@ def update_cache(group: BodyGroup, eta, precond_dtype=None) -> BodyCaches:
     def k_node(v):
         rotpart = jnp.array([[0.0, v[2], -v[1]],
                              [-v[2], 0.0, v[0]],
-                             [v[1], -v[0], 0.0]])
+                             [v[1], -v[0], 0.0]], dtype=v.dtype)
         return jnp.concatenate([eye3, rotpart], axis=1)    # [3, 6]
 
     K = jax.vmap(jax.vmap(k_node))(vec).reshape(nb, 3 * n, 6)
@@ -427,7 +427,8 @@ def check_collision_pairwise_multi(buckets, threshold):
     if not buckets:
         return jnp.asarray(False)
     flat = BodyGroup(
-        nodes_ref=jnp.zeros((n_total(buckets), 0, 3)),
+        nodes_ref=jnp.zeros((n_total(buckets), 0, 3),
+                            dtype=buckets[0].position.dtype),
         normals_ref=None, weights=None, nucleation_sites_ref=None,
         position=jnp.concatenate([g.position for g in buckets]),
         orientation=None, solution=None, velocity=None, angular_velocity=None,

@@ -1,0 +1,21 @@
+"""skelly-lint: repo-native static analysis for dtype, trace, and sharding
+discipline.
+
+Usage::
+
+    python -m skellysim_tpu.lint [paths] [--list-rules]
+
+or programmatically::
+
+    from skellysim_tpu.lint import lint_paths
+    findings = lint_paths(["skellysim_tpu"])   # [] when green
+
+Rules and the suppression pragma syntax are documented in docs/lint.md.
+This package is pure stdlib (ast) — importing it never initializes a JAX
+backend, so it can run as the first CI gate.
+"""
+
+from .engine import Finding, lint_paths
+from .rules import RULES
+
+__all__ = ["Finding", "lint_paths", "RULES"]

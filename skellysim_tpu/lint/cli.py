@@ -1,0 +1,81 @@
+"""skelly-lint CLI: `python -m skellysim_tpu.lint [paths] [--list-rules]`.
+
+Exit status: 0 when every finding is suppressed (or none exist), 1 when any
+unsuppressed finding remains, 2 on usage errors — so CI can gate on it
+directly (`ci/run_ci.sh` runs it right after the byte-compile stage in every
+tier).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .engine import iter_py_files, lint_paths
+from .rules import RULES
+
+
+def _default_paths():
+    """The skellysim_tpu package directory containing this linter."""
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m skellysim_tpu.lint",
+        description="Repo-native static analysis: dtype, trace, and "
+                    "sharding discipline (see docs/lint.md).")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to lint (default: the "
+                             "skellysim_tpu package)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print every rule id with its one-line summary "
+                             "and exit")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="RULE-ID",
+                        help="run only this rule (repeatable)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(r.id) for r in RULES)
+        for r in RULES:
+            print(f"{r.id:<{width}}  {r.summary}")
+        return 0
+
+    paths = args.paths or _default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"skelly-lint: no such path: {p}", file=sys.stderr)
+            return 2
+        if not os.path.isdir(p) and not p.endswith(".py"):
+            print(f"skelly-lint: not a Python file or directory: {p}",
+                  file=sys.stderr)
+            return 2
+    if not iter_py_files(paths):
+        # a gating invocation that lints zero files must not report success
+        print("skelly-lint: no .py files found under the given paths",
+              file=sys.stderr)
+        return 2
+    if args.rule:
+        known = {r.id for r in RULES}
+        unknown = [r for r in args.rule if r not in known]
+        if unknown:
+            print(f"skelly-lint: unknown rule id(s): {', '.join(unknown)} "
+                  f"(try --list-rules)", file=sys.stderr)
+            return 2
+
+    findings = lint_paths(paths, rules=args.rule)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"skelly-lint: {len(findings)} finding(s). Fix them or "
+              "suppress per line with "
+              "`# skelly-lint: ignore[rule-id] — reason` (docs/lint.md).",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,337 @@
+"""skelly-lint rule registry.
+
+Three rule families, each motivated by a failure mode this codebase has
+already hit or is structurally exposed to (docs/lint.md has the full
+write-ups and the pragma syntax):
+
+* ``dtype-discipline`` — the weak-type / f64-promotion leak family behind
+  commit 46b498b (a silent f64 flow promoting the whole Krylov pipeline)
+  and the round-2 FibMats leak (f64 constants promoting f32 states until
+  TPU's f32-only LU fell off the device).
+* ``trace-hygiene`` — host syncs and concretizations inside jit-traced
+  code: ``float()``/``int()``/``bool()``/``.item()``/``np.*`` on traced
+  values abort tracing or silently bake run-time values into the compiled
+  program; ``block_until_ready``/``device_get`` in hot-path modules stall
+  the device pipeline mid-solve.
+* ``sharding-annotation`` — ``shard_map`` without explicit
+  ``in_specs``/``out_specs`` (or ``device_put`` in ``parallel/`` without an
+  explicit sharding) silently replicates operands: the expected O(N/D)
+  per-chip footprint becomes D full copies, an OOM found only in a
+  profiler.
+
+Every check is syntactic and conservative: when the AST cannot prove the
+pattern (unknown receiver, dynamic dispatch), it stays silent. Deliberate
+violations carry a per-line pragma with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .engine import (DTYPE_SEAM_FILES, Finding, ModuleInfo, RepoContext)
+
+#: jnp constructors whose result dtype defaults to the x64-dependent float
+#: (f64 under jax_enable_x64, f32 otherwise) when ``dtype`` is omitted.
+FLOAT_DEFAULT_CREATORS = ("zeros", "ones", "empty")
+#: constructors that inherit dtype from their payload: flagged only when the
+#: payload contains a Python float literal (weak-typed, width follows x64).
+PAYLOAD_CREATORS = ("array", "asarray", "full", "linspace")
+#: positional index of the dtype argument per constructor:
+#: zeros/ones/empty(shape, dtype), full(shape, fill, dtype),
+#: array/asarray(obj, dtype), arange(start, stop, step, dtype),
+#: linspace(start, stop, num, endpoint, retstep, dtype), eye(N, M, k, dtype).
+DTYPE_ARG_POS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2, "array": 1,
+                 "asarray": 1, "arange": 3, "linspace": 5, "eye": 3}
+
+#: np.* attributes that are safe inside traced code (host-side constants and
+#: dtype/metadata queries, not array ops on traced values).
+NP_TRACE_SAFE = {
+    "pi", "e", "inf", "nan", "newaxis", "euler_gamma",
+    "float16", "float32", "float64", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_", "complex64",
+    "complex128", "dtype", "finfo", "iinfo", "ndarray", "integer",
+    "floating", "issubdtype",
+}
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    check: object  # callable(mod, ctx) -> list[Finding]
+
+
+# ------------------------------------------------------------------ helpers
+
+def _jnp_creator(node: ast.Call, mod: ModuleInfo):
+    """Name of the jnp constructor a call invokes, or None."""
+    fn = node.func
+    if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+            and fn.value.id in mod.jnp_aliases):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        tgt = mod.from_imports.get(fn.id)
+        if tgt is not None and tgt[0].endswith("numpy") and tgt[0] != "numpy":
+            return tgt[1]
+    return None
+
+
+def _has_dtype(node: ast.Call, creator: str) -> bool:
+    if any(kw.arg == "dtype" for kw in node.keywords):
+        return True
+    pos = DTYPE_ARG_POS.get(creator)
+    return pos is not None and len(node.args) > pos
+
+
+def _contains_float_literal(nodes) -> bool:
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, float):
+                return True
+    return False
+
+
+def _is_hard_dtype(node, mod: ModuleInfo) -> str | None:
+    """'float64'/'float32' when ``node`` is a bare jnp/np f64/f32 dtype
+    reference (not nested in a wider expression)."""
+    if (isinstance(node, ast.Attribute)
+            and node.attr in ("float64", "float32")
+            and isinstance(node.value, ast.Name)
+            and (node.value.id in mod.jnp_aliases
+                 or node.value.id in mod.np_aliases)):
+        return node.attr
+    return None
+
+
+def _in_signature_defaults(func_nodes, target) -> bool:
+    """True when ``target`` sits in a def's default-argument list — API
+    defaults like ``def make_group(..., dtype=jnp.float64)`` are the
+    caller-visible contract, not a leak."""
+    for fn in func_nodes:
+        defaults = list(fn.args.defaults) + [d for d in fn.args.kw_defaults
+                                             if d is not None]
+        for d in defaults:
+            for sub in ast.walk(d):
+                if sub is target:
+                    return True
+    return False
+
+
+# ------------------------------------------------- rule: dtype-discipline
+
+def check_dtype_discipline(mod: ModuleInfo, ctx: RepoContext):
+    out = []
+    rid = "dtype-discipline"
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        creator = _jnp_creator(node, mod)
+        if creator is None:
+            continue
+        if creator in FLOAT_DEFAULT_CREATORS and not _has_dtype(node, creator):
+            out.append(Finding(
+                mod.path, node.lineno, node.col_offset, rid,
+                f"jnp.{creator}(...) without an explicit dtype defaults to "
+                "the x64-dependent float width (the 46b498b f64-leak "
+                "family); pass dtype=... derived from the state"))
+        elif creator == "arange" and not _has_dtype(node, creator):
+            out.append(Finding(
+                mod.path, node.lineno, node.col_offset, rid,
+                "jnp.arange(...) without an explicit dtype follows the x64 "
+                "flag (int64/f64 under x64, int32/f32 without); index "
+                "arrays should pin dtype=jnp.int32"))
+        elif (creator in PAYLOAD_CREATORS and not _has_dtype(node, creator)
+              and _contains_float_literal(node.args)):
+            out.append(Finding(
+                mod.path, node.lineno, node.col_offset, rid,
+                f"jnp.{creator}(...) of a Python float literal without an "
+                "explicit dtype is weak-typed: its width follows "
+                "jax_enable_x64, not the state"))
+
+    # hardcoded f64/f32 casts in jit-reachable hot-path code, outside the
+    # declared double-float seam files. Host-side assembly (shell operator
+    # build, quadrature precompute, Ewald planning) legitimately pins f64;
+    # the leak family is a pinned width on the TRACED data path, where the
+    # state's dtype must rule.
+    if mod.in_hot_path() and mod.relpath not in DTYPE_SEAM_FILES:
+        func_nodes = [fi.node for fi in mod.functions.values()]
+        reachable_nodes = [fi.node for q, fi in mod.functions.items()
+                           if ctx.is_reachable(mod, q)]
+        hard_sites = []
+        for root in reachable_nodes:
+            hard_sites.extend(_hard_dtype_sites(root, mod))
+        for call, target, which in hard_sites:
+            if _in_signature_defaults(func_nodes, target):
+                continue
+            # anchor at the CALL line (not the dtype expression's own line):
+            # a `dtype=` on a 79-column continuation line must still be
+            # suppressible by a pragma on the statement line, like the
+            # missing-dtype sub-checks
+            out.append(Finding(
+                mod.path, call.lineno, call.col_offset, rid,
+                f"hardcoded {which} on the jit-traced data path pins a "
+                "precision the state does not carry; derive the dtype from "
+                "an operand (declared mixed-precision seams live in "
+                f"{' / '.join(DTYPE_SEAM_FILES)})"))
+    return out
+
+
+def _hard_dtype_sites(root, mod: ModuleInfo):
+    sites = []
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        # (a) dtype=jnp.float64 keyword on any call
+        for kw in node.keywords:
+            which = kw.arg == "dtype" and _is_hard_dtype(kw.value, mod)
+            if which:
+                sites.append((node, kw.value, which))
+        # (b) .astype(jnp.float64)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype" and node.args):
+            which = _is_hard_dtype(node.args[0], mod)
+            if which:
+                sites.append((node, node.args[0], which))
+        # (c) positional dtype slot of a jnp constructor
+        creator = _jnp_creator(node, mod)
+        pos = DTYPE_ARG_POS.get(creator)
+        if pos is not None and len(node.args) > pos:
+            which = _is_hard_dtype(node.args[pos], mod)
+            if which:
+                sites.append((node, node.args[pos], which))
+    return sites
+
+
+# --------------------------------------------------- rule: trace-hygiene
+
+def _shape_like(node) -> bool:
+    """Expressions that are Python ints at trace time: x.shape[i], x.ndim,
+    x.size, len(...), and arithmetic over those."""
+    if isinstance(node, ast.BinOp):
+        return _shape_like(node.left) and _shape_like(node.right)
+    if isinstance(node, ast.Constant):
+        # any literal: float("inf") / int("0x10", 16) are host conversions
+        return True
+    if isinstance(node, ast.Subscript):
+        return (isinstance(node.value, ast.Attribute)
+                and node.value.attr == "shape")
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("ndim", "size", "n_nodes", "n_fibers",
+                             "n_bodies", "solution_size")
+    if isinstance(node, ast.Call):
+        return isinstance(node.func, ast.Name) and node.func.id == "len"
+    return False
+
+
+def check_trace_hygiene(mod: ModuleInfo, ctx: RepoContext):
+    out = []
+    rid = "trace-hygiene"
+    np_names = mod.np_aliases
+
+    shadowed = set(mod.from_imports) | set(mod.import_aliases)
+
+    def scan_body(fn_node, qualname):
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (isinstance(fn, ast.Name) and fn.id in ("float", "int", "bool")
+                    and fn.id not in shadowed and node.args
+                    and not _shape_like(node.args[0])):
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, rid,
+                    f"{fn.id}() inside jit-reachable `{qualname}` "
+                    "concretizes its operand: a traced value here aborts "
+                    "tracing (or silently bakes in a host constant)"))
+            elif isinstance(fn, ast.Attribute) and fn.attr == "item":
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, rid,
+                    f".item() inside jit-reachable `{qualname}` forces a "
+                    "device->host sync per call"))
+            elif (isinstance(fn, ast.Attribute)
+                  and isinstance(fn.value, ast.Name)
+                  and fn.value.id in np_names
+                  and fn.attr not in NP_TRACE_SAFE):
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, rid,
+                    f"np.{fn.attr}() inside jit-reachable `{qualname}` "
+                    "evaluates on host: traced operands abort tracing, "
+                    "constant operands silently freeze into the program "
+                    "(use jnp, or hoist to setup code)"))
+
+    for qual, fi in mod.functions.items():
+        if ctx.is_reachable(mod, qual):
+            scan_body(fi.node, qual)
+
+    # blanket host-sync check: these stall the pipeline wherever they appear
+    # in hot-path modules, host-side driver code included
+    if mod.in_hot_path():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name in ("block_until_ready", "device_get"):
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, rid,
+                    f"{name} in a hot-path module stalls the device "
+                    "pipeline; fetch results once per step at the loop "
+                    "boundary instead"))
+    return out
+
+
+# ----------------------------------------------- rule: sharding-annotation
+
+def check_sharding_annotation(mod: ModuleInfo, ctx: RepoContext):
+    out = []
+    rid = "sharding-annotation"
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None)
+        if name == "shard_map":
+            kws = {kw.arg for kw in node.keywords}
+            missing = [k for k in ("in_specs", "out_specs") if k not in kws]
+            if missing:
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, rid,
+                    f"shard_map without explicit {'/'.join(missing)}: "
+                    "implicit specs replicate operands (D full copies "
+                    "instead of O(N/D) per chip) — annotate every operand"))
+        elif (name == "device_put"
+              and mod.relpath.startswith("parallel/")):
+            has_sharding = (len(node.args) >= 2
+                            or any(kw.arg in ("device", "sharding", None)
+                                   for kw in node.keywords))
+            if not has_sharding:
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, rid,
+                    "device_put in parallel/ without an explicit sharding "
+                    "places on the default device (silent replication / "
+                    "wrong placement on a mesh); pass a NamedSharding"))
+    return out
+
+
+RULES = (
+    Rule("dtype-discipline",
+         "array creation without explicit dtype / hardcoded f64-f32 casts "
+         "in hot-path code (the 46b498b weak-type leak family)",
+         check_dtype_discipline),
+    Rule("trace-hygiene",
+         "float()/int()/bool()/.item()/np.* inside jit-reachable functions; "
+         "block_until_ready/device_get in hot-path modules",
+         check_trace_hygiene),
+    Rule("sharding-annotation",
+         "shard_map without explicit in_specs/out_specs; device_put in "
+         "parallel/ without an explicit sharding",
+         check_sharding_annotation),
+    Rule("lint-pragma",
+         "malformed, unknown-rule, reason-less, or unused suppression "
+         "pragmas (engine-enforced; keeps every pragma load-bearing)",
+         lambda mod, ctx: []),
+)

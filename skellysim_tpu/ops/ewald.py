@@ -618,7 +618,8 @@ def _window_1d(plan: EwaldPlan, x, dtype):
     P = plan.P
     u = x / h
     i0 = jnp.floor(u - (P - 1) / 2.0).astype(jnp.int32)
-    grid_pos = (i0[:, None] + jnp.arange(P)[None, :]).astype(dtype) * h
+    grid_pos = (i0[:, None]
+                + jnp.arange(P, dtype=jnp.int32)[None, :]).astype(dtype) * h
     d = x[:, None] - grid_pos
     return i0, jnp.exp(-d * d / (4.0 * plan.tau))
 
@@ -633,9 +634,10 @@ def _window_indices(plan: EwaldPlan, pts_local, dtype):
     iz, wz = _window_1d(plan, pts_local[:, 2], dtype)
     # periodic wrap is EXACT for the FFT convolution; the plan's box margin
     # keeps wrapped kernel images outside every pair distance
-    gx = (ix[:, None] + jnp.arange(P)[None, :]) % M
-    gy = (iy[:, None] + jnp.arange(P)[None, :]) % M
-    gz = (iz[:, None] + jnp.arange(P)[None, :]) % M
+    p_idx = jnp.arange(P, dtype=jnp.int32)
+    gx = (ix[:, None] + p_idx[None, :]) % M
+    gy = (iy[:, None] + p_idx[None, :]) % M
+    gz = (iz[:, None] + p_idx[None, :]) % M
     flat = ((gx[:, :, None, None] * M + gy[:, None, :, None]) * M
             + gz[:, None, None, :])
     w3 = (wx[:, :, None, None] * wy[:, None, :, None]

@@ -468,8 +468,9 @@ def stresslet_times_normal_blocked(r, normals, eta, reg=DEFAULT_REG,
     nb = _block_iter(n, block_size)
     pad = nb * block_size - n
     r_pad = jnp.pad(r, ((0, pad), (0, 0)))
-    row_idx = jnp.arange(nb * block_size).reshape(nb, block_size)
-    col_idx = jnp.arange(n)
+    row_idx = jnp.arange(nb * block_size, dtype=jnp.int32).reshape(nb,
+                                                                   block_size)
+    col_idx = jnp.arange(n, dtype=jnp.int32)
 
     def rows(args):
         trg, idx = args
@@ -500,8 +501,8 @@ def subtract_singularity_columns(M, sing_vecs, weights):
     (XLA tile-pads a trailing dim of 3 to 128: 42x HBM).
     """
     n = weights.shape[0]
-    idx = jnp.arange(n)
-    rows = 3 * idx[:, None] + jnp.arange(3)[None, :]  # [n, 3]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rows = 3 * idx[:, None] + jnp.arange(3, dtype=jnp.int32)[None, :]  # [n, 3]
     for k, e in enumerate(sing_vecs):
         M = M.at[rows, (3 * idx + k)[:, None]].add(-e / weights[:, None])
     return M

@@ -201,12 +201,12 @@ def _ring_df(block_fn, mesh: Mesh, axis_name: str, r_src, r_trg, payload, eta,
         # derive the accumulator from the sharded operand so it carries the
         # mesh-varying axis (a fresh jnp.zeros is unvarying and shard_map's
         # scan rejects the carry mismatch)
-        u0 = jnp.zeros_like(th_l, dtype=jnp.float64)
+        u0 = jnp.zeros_like(th_l, dtype=jnp.float64)  # skelly-lint: ignore[dtype-discipline] — DF ring tile: the f64 accumulator IS the contract (callers get float64 targets; `flow_multi` casts back at the seam)
         u = _ring_accumulate(
             lambda sh_r, sl_r, ph_r, pl_r: block_fn(
                 (th_l, tl_l), (sh_r, sl_r), (ph_r, pl_r)),
             axis_name, n_dev, u0, sh_l, sl_l, ph_l, pl_l, unroll=unroll)
-        return u / (8.0 * math.pi) / _jnp.asarray(eta, dtype=jnp.float64)
+        return u / (8.0 * math.pi) / _jnp.asarray(eta, dtype=jnp.float64)  # skelly-lint: ignore[dtype-discipline] — eta scales the f64 DF accumulator; a weak-typed eta would demote it
 
     return jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 6,
                          out_specs=spec,

@@ -160,7 +160,7 @@ def build_shell_operator_device(nodes, normals, weights, eta: float = 1.0, *,
             nodes_d, normals_d, e, eta)
 
     M = kernels.subtract_singularity_columns(M, (sv(0), sv(1), sv(2)), w_d)
-    d = jnp.arange(3 * N)
+    d = jnp.arange(3 * N, dtype=jnp.int32)
     M = M.at[d, d].add(-jnp.repeat(1.0 / w_d, 3))
     M = M + jnp.outer(normals_d.reshape(-1), normals_d.reshape(-1))
     M_inv = block_inv(M.astype(inv_dtype))
@@ -289,7 +289,7 @@ def fiber_steric_force(shape: PeripheryShape, points, f_0, l_0, skip_first):
     generic: zero (stub parity). ``skip_first`` masks the clamped minus-end node.
     """
     n = points.shape[0]
-    mask = jnp.arange(n) >= jnp.where(skip_first, 1, 0)
+    mask = jnp.arange(n, dtype=jnp.int32) >= jnp.where(skip_first, 1, 0)
     if shape.kind == "sphere":
         r_mag = jnp.linalg.norm(points, axis=-1)
         safe_r = jnp.where(r_mag > 0, r_mag, 1.0)

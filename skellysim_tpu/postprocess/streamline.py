@@ -65,7 +65,7 @@ def _integrate_batch(field_fn: Callable, speed_fn: Callable | None, x0, dt_init,
     K = x0.shape[0]
     S = max_steps
     dtype = x0.dtype
-    ks = jnp.arange(K)
+    ks = jnp.arange(K, dtype=jnp.int32)
 
     buf_x = jnp.zeros((K, S, 3), dtype=dtype).at[:, 0].set(x0)
     buf_t = jnp.zeros((K, S), dtype=dtype)

@@ -53,7 +53,7 @@ def _icgs(V, w, k, n_restart):
 
     Uses a mask over the fixed-size basis so the loop stays shape-static.
     """
-    mask = (jnp.arange(n_restart + 1) <= k).astype(w.dtype)
+    mask = (jnp.arange(n_restart + 1, dtype=jnp.int32) <= k).astype(w.dtype)
     h = jnp.zeros(n_restart + 1, dtype=w.dtype)
     for _ in range(2):
         proj = mask * (V @ w)            # [m+1] masked dots  <v_i, w>
@@ -137,7 +137,7 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
             cond, body, (jnp.int32(0), V0, H0, cs0, sn0, g0, beta <= tol_abs))
 
         # solve the k x k triangular system via masked back-substitution
-        idx = jnp.arange(m)
+        idx = jnp.arange(m, dtype=jnp.int32)
         active = idx < k
 
         def back_sub(i, y):

@@ -332,6 +332,7 @@ class System:
                      else bd.place(g)[0])
             parts.append(nodes.reshape(-1, 3))
         if not parts:
+            # skelly-lint: ignore[dtype-discipline] — empty-target fallback; a solvable state always has ≥1 component (make_state enforces it), so no state dtype exists here
             return jnp.zeros((0, 3), dtype=jnp.float64)
         return jnp.concatenate(parts, axis=0)
 
@@ -918,7 +919,8 @@ class System:
                 jnp.broadcast_to(vel6[None, :, 3:], dx.shape), dx)
             idx = jnp.argmax(inside, axis=1)
             v = jnp.where(inside.any(axis=1)[:, None],
-                          u_rigid[jnp.arange(r_trg.shape[0]), idx], v)
+                          u_rigid[jnp.arange(r_trg.shape[0],
+                                             dtype=jnp.int32), idx], v)
         return v
 
     def velocity_at_targets(self, state: SimState, solution, r_trg):
@@ -945,7 +947,8 @@ class System:
 
         def one(x, mc):
             # clamped fibers exclude their anchored first node
-            pts = jnp.where((jnp.arange(x.shape[0]) >= jnp.where(mc, 1, 0))[:, None],
+            pts = jnp.where((jnp.arange(x.shape[0], dtype=jnp.int32)
+                             >= jnp.where(mc, 1, 0))[:, None],
                             x, x[-1])
             return peri.check_collision(shape, pts, 0.0)
 

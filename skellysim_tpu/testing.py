@@ -12,7 +12,52 @@ identical solver structure and flop profile.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
+
+
+class TraceCountingJit:
+    """`jax.jit` wrapper that counts how many times the function is TRACED.
+
+    The runtime companion to skelly-lint's static pass (docs/lint.md): a
+    retrace means some argument changed its static signature — a Python
+    scalar where a jnp scalar belongs, a dtype flip, a shape change — and
+    every retrace pays full compilation on the hot path. Tests pin the
+    expected count (`tests/test_retrace.py`: the top-level system step must
+    trace exactly once across same-shape calls).
+
+    >>> step = trace_counting_jit(system._solve_impl,
+    ...                           static_argnames=("ewald_plan",))
+    >>> step(state); step(state2)       # same shapes/dtypes
+    >>> assert step.trace_count == 1
+    """
+
+    def __init__(self, fn, **jit_kwargs):
+        import jax
+
+        self._count = 0
+
+        @functools.wraps(fn)
+        def counting(*args, **kwargs):
+            self._count += 1
+            return fn(*args, **kwargs)
+
+        self._jitted = jax.jit(counting, **jit_kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return self._jitted(*args, **kwargs)
+
+    @property
+    def trace_count(self) -> int:
+        return self._count
+
+
+def trace_counting_jit(fn, **jit_kwargs) -> TraceCountingJit:
+    """Wrap ``fn`` in `jax.jit` (kwargs pass through) counting traces via
+    ``.trace_count``. Imports jax lazily so importing `skellysim_tpu.testing`
+    never initializes a backend."""
+    return TraceCountingJit(fn, **jit_kwargs)
 
 
 def make_coupled_parts(shell_n: int, body_n: int, dtype, *, radius: float = 6.0,

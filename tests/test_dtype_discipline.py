@@ -115,6 +115,28 @@ def test_fiberless_f32_state_stays_f32():
     assert bool(info.converged)
 
 
+def _lint_dtype(relpath):
+    import os
+
+    from skellysim_tpu.lint import lint_paths
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return lint_paths([os.path.join(root, relpath)],
+                      rules=["dtype-discipline"])
+
+
+def test_gmres_dtype_lint_clean():
+    """Pins the skelly-lint dtype audit of the solver: the `_icgs` mask and
+    back-substitution index aranges are int32 (not x64-following int64)."""
+    assert _lint_dtype("skellysim_tpu/solver/gmres.py") == []
+
+
+def test_container_dtype_lint_clean():
+    """Pins the skelly-lint dtype audit of the fiber container (every array
+    constructor derives its dtype from the state — the FibMats-leak file)."""
+    assert _lint_dtype("skellysim_tpu/fibers/container.py") == []
+
+
 def test_df_tier_kernel_impl_preserves_f32_solve_dtype():
     """The DF tiles return float64 internally; the evaluator seam must cast
     back so an f32 solve with kernel_impl="df"/"pallas_df" stays f32 end to

@@ -1,0 +1,331 @@
+"""Rule-engine tests for skelly-lint (`skellysim_tpu.lint`).
+
+Each rule gets three fixtures: one snippet that must flag, one that must
+pass, and one suppressed-with-pragma case. Fixture files are written under a
+fake `skellysim_tpu/...` tree in tmp_path so the path-scoped checks
+(hot-path dirs, parallel/, seam files) see package-realistic locations.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from skellysim_tpu.lint import RULES, lint_paths
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, rel, src):
+    path = tmp_path / "skellysim_tpu" / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(src)
+    return str(path)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------ dtype rule
+
+def test_dtype_flags_zeros_without_dtype(tmp_path):
+    p = _write(tmp_path, "ops/mod.py", (
+        "import jax.numpy as jnp\n"
+        "def f(n):\n"
+        "    return jnp.zeros((n, 3))\n"))
+    assert _rules(lint_paths([p])) == ["dtype-discipline"]
+
+
+def test_dtype_flags_arange_and_float_literal_payload(tmp_path):
+    p = _write(tmp_path, "ops/mod.py", (
+        "import jax.numpy as jnp\n"
+        "def f(n):\n"
+        "    idx = jnp.arange(n)\n"
+        "    w = jnp.asarray([1.0, 2.0])\n"
+        "    return idx, w\n"))
+    assert _rules(lint_paths([p])) == ["dtype-discipline"] * 2
+
+
+def test_dtype_passes_with_explicit_dtype(tmp_path):
+    p = _write(tmp_path, "ops/mod.py", (
+        "import jax.numpy as jnp\n"
+        "def f(n, x):\n"
+        "    a = jnp.zeros((n, 3), dtype=x.dtype)\n"
+        "    b = jnp.arange(n, dtype=jnp.int32)\n"
+        "    c = jnp.asarray([1.0, 2.0], dtype=x.dtype)\n"
+        "    d = jnp.zeros_like(x)\n"
+        "    return a, b, c, d\n"))
+    assert lint_paths([p]) == []
+
+
+def test_dtype_suppressed_with_pragma(tmp_path):
+    p = _write(tmp_path, "ops/mod.py", (
+        "import jax.numpy as jnp\n"
+        "def f(n):\n"
+        "    return jnp.zeros((n, 3))"
+        "  # skelly-lint: ignore[dtype-discipline] -- fixture reason\n"))
+    assert lint_paths([p]) == []
+
+
+def test_dtype_recognizes_positional_dtype_slots(tmp_path):
+    """arange's dtype is positional arg 3 and eye's is arg 3 — a correctly
+    pinned positional dtype must pass, and a positional hardcoded f64 on the
+    jit path must flag (review finding: the slot table was off by one)."""
+    p = _write(tmp_path, "ops/mod.py", (
+        "import jax.numpy as jnp\n"
+        "def f(n, x):\n"
+        "    a = jnp.arange(0, n, 1, jnp.int32)\n"
+        "    b = jnp.eye(n, n, 0, x.dtype)\n"
+        "    return a, b\n"))
+    assert lint_paths([p]) == []
+    q = _write(tmp_path, "ops/mod2.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def traced(n):\n"
+        "    return jnp.arange(0, n, 1, jnp.float64)\n"))
+    assert _rules(lint_paths([q])) == ["dtype-discipline"]
+
+
+def test_dtype_flags_hardcoded_f64_only_on_jit_path(tmp_path):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    return x.astype(jnp.float64)\n"
+        "def host_setup(op):\n"
+        "    return op.astype(jnp.float64)\n")
+    hot = _write(tmp_path, "ops/mod.py", src)
+    f = lint_paths([hot])
+    assert _rules(f) == ["dtype-discipline"] and f[0].line == 5
+    # same code in a declared mixed-precision seam file: exempt
+    seam = _write(tmp_path, "ops/df_kernels.py", src)
+    assert lint_paths([seam]) == []
+
+
+# ------------------------------------------------------- trace-hygiene
+
+def test_trace_flags_float_and_np_in_jit_reachable(tmp_path):
+    p = _write(tmp_path, "solver/mod.py", (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    return helper(x)\n"
+        "def helper(x):\n"
+        "    return np.sum(x) + float(x[0])\n"))
+    assert sorted(_rules(lint_paths([p]))) == ["trace-hygiene"] * 2
+
+
+def test_trace_passes_host_side_and_lru_cached(tmp_path):
+    p = _write(tmp_path, "solver/mod.py", (
+        "import functools\n"
+        "import jax\n"
+        "import numpy as np\n"
+        "@functools.lru_cache(maxsize=None)\n"
+        "def cached_mats(n):\n"
+        "    return np.linspace(0.0, 1.0, n)\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    return x * cached_mats(x.shape[0])\n"
+        "def host_writer(state):\n"
+        "    return float(state.time), np.asarray(state.x)\n"))
+    assert lint_paths([p]) == []
+
+
+def test_trace_suppressed_with_function_pragma(tmp_path):
+    p = _write(tmp_path, "solver/mod.py", (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    return helper(x)\n"
+        "def helper(n):  # skelly-lint: ignore-function[trace-hygiene] -- fixture reason\n"
+        "    return np.ones(3) + np.zeros(3)\n"))
+    assert lint_paths([p]) == []
+
+
+def test_trace_flags_block_until_ready_anywhere_in_hot_path(tmp_path):
+    src = ("def host_loop(x):\n"
+           "    return x.block_until_ready()\n")
+    hot = _write(tmp_path, "parallel/mod.py", src)
+    assert _rules(lint_paths([hot])) == ["trace-hygiene"]
+    cold = _write(tmp_path, "io/mod.py", src)
+    assert lint_paths([cold]) == []
+
+
+# -------------------------------------------------- sharding-annotation
+
+def test_sharding_flags_shard_map_without_specs(tmp_path):
+    p = _write(tmp_path, "parallel/mod.py", (
+        "import jax\n"
+        "def f(fn, mesh, x):\n"
+        "    return jax.shard_map(fn, mesh=mesh)(x)\n"))
+    assert _rules(lint_paths([p])) == ["sharding-annotation"]
+
+
+def test_sharding_passes_with_specs_and_elsewhere(tmp_path):
+    p = _write(tmp_path, "parallel/mod.py", (
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "def f(fn, mesh, x, sh):\n"
+        "    y = jax.shard_map(fn, mesh=mesh, in_specs=P('i'),\n"
+        "                      out_specs=P('i'))(x)\n"
+        "    return jax.device_put(y, sh)\n"))
+    assert lint_paths([p]) == []
+
+
+def test_sharding_flags_bare_device_put_in_parallel(tmp_path):
+    p = _write(tmp_path, "parallel/mod.py", (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.device_put(x)\n"))
+    assert _rules(lint_paths([p])) == ["sharding-annotation"]
+
+
+def test_sharding_suppressed_with_pragma(tmp_path):
+    p = _write(tmp_path, "parallel/mod.py", (
+        "import jax\n"
+        "def f(fn, mesh, x):\n"
+        "    # skelly-lint: ignore[sharding-annotation] -- fixture reason\n"
+        "    return jax.shard_map(fn, mesh=mesh)(x)\n"))
+    assert lint_paths([p]) == []
+
+
+def test_trace_allows_float_of_literal(tmp_path):
+    p = _write(tmp_path, "solver/mod.py", (
+        "import jax\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    lim = float('inf')\n"
+        "    n = int(x.shape[0])\n"
+        "    return x * lim + n\n"))
+    assert lint_paths([p]) == []
+
+
+def test_unknown_rule_filter_raises():
+    with pytest.raises(ValueError, match="unknown rule id"):
+        lint_paths(["skellysim_tpu"], rules=["dtype-disciplin"])
+
+
+def test_function_pragma_above_decorated_def(tmp_path):
+    """'Directly above the def' must work when the def is decorated (the
+    pragma then sits above the decorator, not the `def` keyword line)."""
+    p = _write(tmp_path, "solver/mod.py", (
+        "import jax\n"
+        "import numpy as np\n"
+        "# skelly-lint: ignore-function[trace-hygiene] -- fixture reason\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    return np.sum(x)\n"))
+    assert lint_paths([p]) == []
+
+
+def test_hardcoded_dtype_on_continuation_line_suppressible(tmp_path):
+    """The finding anchors at the call/statement line even when `dtype=`
+    sits on a 79-column continuation line, so the statement-line pragma
+    works like the missing-dtype sub-checks."""
+    p = _write(tmp_path, "ops/mod.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def traced(n):\n"
+        "    return jnp.zeros((n, 3),  # skelly-lint: ignore[dtype-discipline] -- fixture reason\n"
+        "                     dtype=jnp.float64)\n"))
+    assert lint_paths([p]) == []
+
+
+# ------------------------------------------------------- pragma hygiene
+
+def test_unused_pragma_is_a_finding(tmp_path):
+    p = _write(tmp_path, "ops/mod.py", (
+        "import jax.numpy as jnp\n"
+        "def f(n):\n"
+        "    return jnp.ones(n, dtype=jnp.float32)"
+        "  # skelly-lint: ignore[dtype-discipline] -- suppresses nothing\n"))
+    f = lint_paths([p])
+    assert _rules(f) == ["lint-pragma"]
+    assert "unused suppression" in f[0].message
+
+
+def test_pragma_without_reason_is_a_finding(tmp_path):
+    p = _write(tmp_path, "ops/mod.py", (
+        "import jax.numpy as jnp\n"
+        "def f(n):\n"
+        "    return jnp.zeros(n)  # skelly-lint: ignore[dtype-discipline]\n"))
+    msgs = [f.message for f in lint_paths([p])]
+    assert any("missing its reason" in m for m in msgs)
+
+
+def test_pragma_with_unknown_rule_is_a_finding(tmp_path):
+    p = _write(tmp_path, "ops/mod.py", (
+        "x = 1  # skelly-lint: ignore[no-such-rule] -- why\n"))
+    msgs = [f.message for f in lint_paths([p])]
+    assert any("unknown rule id" in m for m in msgs)
+
+
+def test_pragma_inside_string_is_inert(tmp_path):
+    p = _write(tmp_path, "ops/mod.py", (
+        'DOC = "# skelly-lint: ignore[dtype-discipline] -- not a comment"\n'))
+    assert lint_paths([p]) == []
+
+
+def test_removing_a_pragma_reexposes_the_finding(tmp_path):
+    src = ("import jax.numpy as jnp\n"
+           "def f(n):\n"
+           "    return jnp.zeros(n)"
+           "  # skelly-lint: ignore[dtype-discipline] -- fixture reason\n")
+    p = _write(tmp_path, "ops/mod.py", src)
+    assert lint_paths([p]) == []
+    (tmp_path / "skellysim_tpu" / "ops" / "mod.py").write_text(
+        src.replace("  # skelly-lint: ignore[dtype-discipline] "
+                    "-- fixture reason", ""))
+    assert _rules(lint_paths([p])) == ["dtype-discipline"]
+
+
+# ----------------------------------------------------------------- CLI
+
+def test_cli_list_rules_and_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "skellysim_tpu.lint", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+    assert out.returncode == 0
+    for rule in RULES:
+        assert rule.id in out.stdout
+
+    bad = _write(tmp_path, "ops/mod.py", (
+        "import jax.numpy as jnp\n"
+        "def f(n):\n"
+        "    return jnp.zeros(n)\n"))
+    run = subprocess.run(
+        [sys.executable, "-m", "skellysim_tpu.lint", bad],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+    assert run.returncode == 1
+    assert "dtype-discipline" in run.stdout
+
+
+def test_cli_refuses_paths_that_lint_nothing(tmp_path):
+    """A gating invocation that would check zero files must exit 2, not
+    report success (review finding: a mistyped-but-existing CI path gated
+    nothing while passing)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    empty = tmp_path / "no_python_here"
+    empty.mkdir()
+    notpy = tmp_path / "engine.pyc"
+    notpy.write_bytes(b"")
+    for bad in (str(empty), str(notpy)):
+        run = subprocess.run(
+            [sys.executable, "-m", "skellysim_tpu.lint", bad],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+        assert run.returncode == 2, (bad, run.stdout, run.stderr)
+
+
+def test_repo_tree_is_lint_clean():
+    """The acceptance gate: the shipped tree has zero unsuppressed findings
+    (CI runs the CLI equivalent in every tier)."""
+    findings = lint_paths([os.path.join(REPO_ROOT, "skellysim_tpu")])
+    assert findings == [], "\n".join(f.render() for f in findings)

@@ -1,0 +1,43 @@
+"""Retrace-count regression: the runtime companion of skelly-lint.
+
+The static pass (`skellysim_tpu.lint`) catches dtype/trace/sharding drift at
+review time; `testing.trace_counting_jit` catches the symptom at run time —
+a retrace means an argument's static signature changed between calls
+(Python scalar vs jnp scalar, dtype flip, shape change), and every retrace
+pays full XLA compilation inside the time loop.
+"""
+
+import jax.numpy as jnp
+
+from skellysim_tpu.testing import trace_counting_jit
+
+
+def test_trace_counting_jit_counts():
+    calls = trace_counting_jit(lambda x: x * 2.0)
+    a = jnp.ones(4, dtype=jnp.float32)
+    calls(a)
+    calls(a + 1.0)
+    assert calls.trace_count == 1, "same signature must not retrace"
+    calls(jnp.ones(5, dtype=jnp.float32))
+    assert calls.trace_count == 2, "new shape must retrace"
+
+
+def test_system_step_traces_once_across_same_shape_calls():
+    """The top-level implicit step compiles exactly once for a fixed state
+    signature: stepping the stepped state (same shapes/dtypes, new values)
+    must reuse the compiled program. A failure here means something in
+    `_solve_impl`'s closure leaks a trace-time-varying static (the
+    per-step-recompile failure mode the adaptive loop cannot afford)."""
+    from __graft_entry__ import _make_system
+
+    system, state = _make_system(n_fibers=2, n_nodes=16, dtype=jnp.float32)
+    step = trace_counting_jit(system._solve_impl,
+                              static_argnames=("ewald_plan",))
+    new_state, _, info = step(state)
+    assert bool(info.converged)
+    assert step.trace_count == 1
+
+    # same pytree structure, same shapes/dtypes, different values
+    new_state, _, _ = step(new_state)
+    assert step.trace_count == 1, (
+        "top-level system step retraced on a same-shape state")
