@@ -931,6 +931,106 @@ def _group_cells(extra, ck, on_acc):
     ck()
 
 
+def _bench_ensemble_throughput(B, n_fibers, n_nodes, dtype, rounds=6):
+    """steps/s of the vmapped batched trial step at lane count B, plus the
+    B=1 sequential-step baseline the speedup is measured against."""
+    from __graft_entry__ import _make_system
+    from skellysim_tpu.ensemble import EnsembleRunner
+
+    system, base = _make_system(n_fibers=n_fibers, n_nodes=n_nodes,
+                                dtype=dtype)
+    states = [base._replace(fibers=base.fibers._replace(
+        x=base.fibers.x + 0.01 * i)) for i in range(B)]
+    runner = EnsembleRunner(system, batch_impl="vmap")
+    # far-future t_final: every lane live for the whole measurement
+    ens = runner.make_ensemble(states, [1e9] * B)
+
+    def once():
+        nonlocal ens
+        ens, info = runner.step(ens)
+        return info.iters
+
+    np.asarray(once())  # compile + warm + drain
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        out = once()
+    np.asarray(out)  # host fetch: the real completion barrier
+    wall = time.perf_counter() - t0
+    return {"B": B, "steps_per_s": round(B * rounds / wall, 2),
+            "batched_step_wall_s": round(wall / rounds, 4)}
+
+
+def _group_ensemble(extra, ck, on_acc):
+    """Satellite of ISSUE 2: the batching win — members/s and steps/s vs B
+    at fixed small N (the regime where one member leaves the chip idle)."""
+    import jax.numpy as jnp
+
+    dtype = jnp.float32 if on_acc else jnp.float64
+    n_fibers, n_nodes = (8, 32) if on_acc else (2, 16)
+    b_ladder = (1, 8, 32, 128) if on_acc else (1, 4, 8)
+    table = {}
+    base_rate = None
+    for B in b_ladder:
+        if _remaining() < 60:
+            table[f"B{B}"] = {"skipped_budget": int(_remaining())}
+            continue
+        try:
+            row = _bench_ensemble_throughput(B, n_fibers, n_nodes, dtype)
+            if B == 1:
+                # the speedup baseline is the B=1 rung SPECIFICALLY; if it
+                # errored or was budget-skipped, later rungs record rates
+                # only (a surviving rung must never pose as its own baseline)
+                base_rate = row["steps_per_s"]
+            if base_rate is not None:
+                row["speedup_vs_B1"] = round(row["steps_per_s"] / base_rate,
+                                             2)
+            table[f"B{B}"] = row
+        except Exception as e:
+            table[f"B{B}"] = {"error": _short_err(e)}
+        ck()
+    out = {"n_fibers": n_fibers, "n_nodes": n_nodes, "ladder": table}
+
+    # end-to-end members/s through the continuous-batching scheduler
+    # (retire + backfill included): 2B tiny members through B lanes
+    if _remaining() > 60:
+        try:
+            import dataclasses
+
+            from __graft_entry__ import _make_system
+            from skellysim_tpu.ensemble import (EnsembleRunner,
+                                                EnsembleScheduler, MemberSpec)
+
+            B = 32 if on_acc else 4
+            system, base = _make_system(n_fibers=n_fibers, n_nodes=n_nodes,
+                                        dtype=dtype)
+            system.params = dataclasses.replace(system.params,
+                                                adaptive_timestep_flag=False)
+            members = [MemberSpec(
+                member_id=f"m{i}",
+                state=base._replace(fibers=base.fibers._replace(
+                    x=base.fibers.x + 0.01 * i)),
+                t_final=8 * 1e-3) for i in range(2 * B)]
+            runner = EnsembleRunner(system, batch_impl="vmap")
+            # warm the compiled step on a throwaway scheduler round
+            EnsembleScheduler(runner, members[:B], B, max_rounds=1).run()
+            t0 = time.perf_counter()
+            sched = EnsembleScheduler(runner, members, B)
+            retired = sched.run()
+            wall = time.perf_counter() - t0
+            out["scheduler"] = {
+                "B": B, "members": len(members),
+                "members_retired": len(retired),
+                "steps_per_member": 8, "rounds": sched.rounds,
+                "members_per_s": round(len(retired) / wall, 2),
+                "wall_s": round(wall, 2)}
+        except Exception as e:
+            out["scheduler"] = {"error": _short_err(e)}
+    if not on_acc:
+        _mark_downscaled(out, _CPU_FALLBACK)
+    extra["ensemble"] = out
+    ck()
+
+
 #: (name, budget weight) — children run in this order, each in its own
 #: subprocess; weights split the remaining wall budget
 GROUPS = [
@@ -939,6 +1039,7 @@ GROUPS = [
     ("solves", _group_solves, 1.0),
     ("coupled", _group_coupled, 2.6),
     ("cells", _group_cells, 1.8),
+    ("ensemble", _group_ensemble, 0.8),
 ]
 
 

@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""Ensemble sweep example: one advected fiber, rigidity x flow strength.
+
+Generates the BASE run config plus an `ensemble.toml` sweep spec; then:
+
+    python examples/ensemble_sweep/gen_config.py
+    python -m skellysim_tpu.ensemble --sweep-file=ensemble.toml
+
+streams 3 rigidities x 2 flow strengths = 6 members through 8 compiled
+lanes (docs/ensemble.md), writing one reference-format trajectory per member
+(`m00000.out`...) plus `ensemble_metrics.jsonl`. Both swept keys land in
+member STATE (the one-compiled-program rule for sweeps). `replicas` stays 1:
+the batched runner has no stochastic dynamics yet (dynamic instability is
+host-side), so replicas of one sweep point would run identical physics.
+"""
+
+import sys
+
+import numpy as np
+
+from skellysim_tpu.config import BackgroundSource, Config, Fiber
+
+config_file = sys.argv[1] if len(sys.argv) > 1 else "skelly_config.toml"
+
+config = Config()
+config.params.eta = 1.0
+config.params.dt_initial = 0.01
+config.params.dt_write = 0.05
+config.params.t_final = 0.5
+config.params.gmres_tol = 1e-10
+config.params.seed = 100
+
+fib = Fiber(n_nodes=32, length=1.0, bending_rigidity=0.0025)
+fib.fill_node_positions(np.zeros(3), np.array([0.0, 0.0, 1.0]))
+config.fibers = [fib]
+config.background = BackgroundSource(uniform=[0.5, 0.0, 0.0])
+config.save(config_file)
+
+with open("ensemble.toml", "w") as fh:
+    fh.write(f"""\
+[ensemble]
+base_config = "{config_file}"
+replicas = 1
+batch = 8
+
+[[ensemble.sweep]]
+key = "fibers.0.bending_rigidity"
+values = [0.0025, 0.005, 0.01]
+
+[[ensemble.sweep]]
+key = "background.uniform.0"
+values = [0.25, 0.5]
+""")
+print(f"wrote {config_file} + ensemble.toml")

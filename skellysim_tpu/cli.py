@@ -52,6 +52,15 @@ def run(config_file: str, resume: bool = False, overwrite: bool = False,
         if rng_state:
             rng = SimRNG.from_state(rng_state)
         writer = TrajectoryWriter(traj, append=True)
+        if metrics_path and os.path.exists(metrics_path):
+            # marker line segmenting runs in an appended metrics file: step
+            # indices restart at 0 per run, so post-hoc analysis needs the
+            # boundary (schema note at system.METRICS_FIELDS)
+            import json
+
+            with open(metrics_path, "a") as fh:
+                fh.write(json.dumps({"resume": True,
+                                     "t": float(state.time)}) + "\n")
         print(f"Resuming from t={float(state.time):.6g}")
     else:
         writer = TrajectoryWriter(traj)

@@ -1,7 +1,9 @@
 from . import param_tools, toml_io
 from .schema import (BackgroundSource, Body, Config, ConfigEllipsoidal,
                      ConfigRevolution, ConfigSpherical, DynamicInstability,
-                     EllipsoidalPeriphery, Fiber, Params, Periphery,
-                     PeripheryBinding, Point, RevolutionPeriphery,
-                     SphericalPeriphery, load_config, perturbed_fiber_positions,
-                     to_runtime_params, unpack)
+                     EllipsoidalPeriphery, EnsembleSweep, Fiber, Params,
+                     Periphery, PeripheryBinding, Point, RevolutionPeriphery,
+                     SphericalPeriphery, SweepAxis, load_config,
+                     perturbed_fiber_positions, to_runtime_params, unpack)
+from .sweep import (MemberPlan, apply_overrides, expand_members,  # noqa: F401
+                    load_members, load_sweep)

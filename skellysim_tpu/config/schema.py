@@ -31,6 +31,7 @@ __all__ = [
     "Periphery", "SphericalPeriphery", "EllipsoidalPeriphery",
     "RevolutionPeriphery", "Body", "Point", "BackgroundSource",
     "Config", "ConfigSpherical", "ConfigEllipsoidal", "ConfigRevolution",
+    "EnsembleSweep", "SweepAxis",
     "perturbed_fiber_positions", "load_config", "unpack", "to_runtime_params",
 ]
 
@@ -431,6 +432,51 @@ class BackgroundSource:
     components: List[int] = field(default_factory=_ivec3)
     scale_factor: List[float] = field(default_factory=_vec3)
     uniform: List[float] = field(default_factory=_vec3)
+
+
+@dataclass
+class SweepAxis:
+    """One swept parameter: a dotted config path and its values.
+
+    ``key`` addresses the BASE config (`skelly_config.toml`) with dots and
+    list indices, e.g. ``"fibers.0.length"``, ``"bodies.0.external_force"``,
+    ``"background.uniform"``. Member configs take the cartesian product over
+    all axes. Only values that land in simulation STATE are sweepable —
+    swept members share one compiled program, so a key that changes the
+    static runtime Params (eta, tolerances, evaluator choices, ...) is
+    rejected at expansion; `params.t_final` and `params.seed` are the two
+    params exceptions (per-member end time / RNG stream).
+    """
+    key: str = ""
+    values: List = field(default_factory=list)
+
+
+@dataclass
+class EnsembleSweep:
+    """`[ensemble]` table of a sweep-spec TOML (`python -m
+    skellysim_tpu.ensemble --sweep-file=...`; see docs/ensemble.md).
+
+    A sweep spec is its own small TOML file next to (or pointing at) a base
+    run config; members = ``replicas`` copies of every point in the sweep
+    axes' cartesian product, each with a deterministic per-member RNG
+    (`SimRNG.member(i)`) so replicas are reproducible independent of
+    scheduling order.
+    """
+    #: base run config, resolved relative to the sweep-spec file
+    base_config: str = "skelly_config.toml"
+    #: stochastic replicas per sweep point
+    replicas: int = 1
+    #: compiled lane count B (the continuous-batching scheduler's batch)
+    batch: int = 8
+    #: base seed for per-member RNG streams; -1 = the base config's
+    #: params.seed
+    seed: int = -1
+    #: per-member end time; -1.0 = the base config's params.t_final
+    t_final: float = -1.0
+    #: batched execution plan: "vmap" (throughput) or "unroll" (bit-reproducible
+    #: lanes; see docs/ensemble.md)
+    batch_impl: str = "vmap"
+    sweep: List[SweepAxis] = field(default_factory=list)
 
 
 @dataclass

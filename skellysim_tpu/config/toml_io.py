@@ -10,7 +10,12 @@ tables) — exactly the shapes the config schema produces.
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib  # Python >= 3.11
+except ModuleNotFoundError:
+    # API-compatible backport: on 3.10 boxes a bare `import tomllib` killed
+    # every config-dependent test module at collection
+    import tomli as tomllib
 from typing import Any
 
 

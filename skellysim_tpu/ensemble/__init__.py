@@ -1,0 +1,30 @@
+"""skelly-ensemble: batched execution of independent simulations.
+
+SkellySim's real scientific workload is not one simulation but thousands:
+stochastic replicas and parameter sweeps, each a small-N Stokes solve that
+leaves an accelerator chip mostly idle (docs/performance.md: the hot kernels
+only saturate at ~65k+ nodes). This subsystem inverts the
+one-simulation-per-process architecture: B independent members run as one
+compiled program by batching the existing jit'd trial step
+(`System.trial_step`) over a stacked `SimState` member axis, with per-member
+adaptive timestepping done as device-side masked accept/reject and a
+host-side continuous-batching scheduler that keeps the B lanes full from a
+work queue — the direct analogue of an inference server's batch scheduler
+(ROADMAP north star: "batching, async, caching").
+
+Layers (see docs/ensemble.md):
+
+* `runner`    — `EnsembleState` (stacked member pytree) + `EnsembleRunner`
+                (the jit'd masked batch step; `vmap` and bit-reproducible
+                `unroll` execution plans);
+* `scheduler` — work queue, lane retirement at `t_final`, backfill without
+                retracing (same static shapes, new leaves);
+* `cli`       — `python -m skellysim_tpu.ensemble`: TOML sweep spec
+                (`config.sweep`) -> per-member trajectories + one aggregated
+                metrics JSONL (`io.ensemble_io`).
+"""
+
+from .runner import (EnsembleRunner, EnsembleState,  # noqa: F401
+                     EnsembleStepInfo, lane_state, set_lane, stack_states)
+from .scheduler import (EnsembleScheduler, MemberSpec,  # noqa: F401
+                        run_ensemble)

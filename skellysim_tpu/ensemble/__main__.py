@@ -1,0 +1,6 @@
+"""`python -m skellysim_tpu.ensemble` — the ensemble sweep driver."""
+
+from .cli import main
+
+if __name__ == "__main__":
+    main()

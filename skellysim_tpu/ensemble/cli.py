@@ -1,0 +1,146 @@
+"""Ensemble driver: sweep-spec TOML -> per-member trajectories + metrics.
+
+Usage: python -m skellysim_tpu.ensemble --sweep-file=ensemble.toml
+           [--output-dir=DIR] [--batch=B] [--overwrite] [--metrics-file=F]
+
+The sweep spec (`config.sweep`, docs/ensemble.md) names a base run config
+and the member expansion (replicas x sweep axes). Every member is built
+through the same `builder.build_simulation` path as a single run, validated
+to share the base's compiled program (identical runtime Params up to
+seed/t_final, identical state structure), and streamed through the
+continuous-batching scheduler. Outputs land in the output directory:
+`<member_id>.out` reference-format trajectories plus one aggregated
+`ensemble_metrics.jsonl`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+
+
+def _members_from_sweep(sweep_file: str):
+    """(system, [MemberSpec], spec) — build every member simulation and
+    validate the one-compiled-program contract."""
+    from ..builder import build_simulation
+    from ..config import schema
+    from ..config.sweep import apply_overrides, load_members
+    from ..utils.rng import SimRNG
+    from .scheduler import MemberSpec
+
+    spec, base_path, base, plans = load_members(sweep_file)
+    if spec.replicas > 1 or any(ax.key == "params.seed" for ax in spec.sweep):
+        # nothing in the batched runner consumes the member RNG yet (dynamic
+        # instability — the stochastic driver — is rejected up front), so
+        # replica members differ ONLY in their serialized RNG streams and
+        # write identical physics; never let that burn a sweep silently
+        import logging
+
+        logging.getLogger("skellysim_tpu").warning(
+            "replicas/seed sweep: the batched runner does not support "
+            "dynamic instability yet, so members of one sweep point run "
+            "identical deterministic physics (they differ only in their "
+            "recorded RNG streams); use replicas=1 until stochastic "
+            "dynamics land in the ensemble path")
+    config_dir = os.path.dirname(os.path.abspath(base_path)) or "."
+    if not plans:
+        sys.exit(f"sweep spec '{sweep_file}' expands to zero members")
+
+    def norm(params: schema.Params):
+        # members may differ only in the knobs handled outside the trace
+        return dataclasses.replace(params, seed=0, t_final=0.0)
+
+    system = None
+    members = []
+    for plan in plans:
+        cfg = apply_overrides(base, plan.overrides)
+        sys_i, state_i, _ = build_simulation(cfg, config_dir=config_dir)
+        if system is None:
+            system = sys_i
+            base_norm = norm(cfg.params)
+        elif norm(cfg.params) != base_norm:
+            sys.exit(f"member {plan.member_id}: overrides changed runtime "
+                     "params; ensemble members must share one compiled "
+                     "program (sweep state values, not params)")
+        members.append(MemberSpec(
+            member_id=plan.member_id, state=state_i, t_final=plan.t_final,
+            rng=SimRNG(plan.seed).member(plan.index)))
+    return system, members, spec
+
+
+def run(sweep_file: str, output_dir: str | None = None,
+        batch: int | None = None, batch_impl: str | None = None,
+        overwrite: bool = False, metrics_path: str | None = None) -> list:
+    """Expand + drain a sweep; returns retired member ids."""
+    from ..io.ensemble_io import EnsembleMetricsWriter, MemberTrajectoryWriters
+    from .scheduler import EnsembleScheduler
+    from .runner import EnsembleRunner
+
+    out_dir = output_dir or (os.path.dirname(os.path.abspath(sweep_file))
+                             or ".")
+    system, members, spec = _members_from_sweep(sweep_file)
+    metrics_path = metrics_path or os.path.join(out_dir,
+                                                "ensemble_metrics.jsonl")
+    writers = MemberTrajectoryWriters(out_dir, overwrite=overwrite)
+    # fail on existing trajectories BEFORE any compute, like the single-run
+    # CLI's up-front clobber guard
+    if not overwrite:
+        clobbered = [m.member_id for m in members
+                     if os.path.exists(writers.path(m.member_id))]
+        if clobbered:
+            sys.exit(f"member trajectories already exist ({clobbered[0]}.out"
+                     f" + {len(clobbered) - 1} more); pass --overwrite")
+    runner = EnsembleRunner(system, batch_impl=batch_impl or spec.batch_impl)
+    with writers, EnsembleMetricsWriter(metrics_path) as metrics:
+        sched = EnsembleScheduler(
+            runner, members, batch or spec.batch, writer=writers,
+            metrics=metrics, write_initial_frames=True,
+            on_dt_underflow="retire")
+        retired = sched.run()
+    print(f"ensemble finished: {len(retired)}/{len(members)} members "
+          f"retired over {sched.rounds} batched steps")
+    return retired
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="skellysim-tpu-ensemble",
+        description="batched ensemble sweeps with a continuous-batching "
+                    "scheduler (docs/ensemble.md)")
+    ap.add_argument("--sweep-file", default="ensemble.toml",
+                    help="sweep-spec TOML ([ensemble] table)")
+    ap.add_argument("--output-dir", default=None,
+                    help="member trajectories + metrics land here "
+                         "(default: the sweep file's directory)")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="override the spec's compiled lane count B")
+    ap.add_argument("--batch-impl", default=None,
+                    choices=("vmap", "unroll"),
+                    help="override the spec's execution plan")
+    ap.add_argument("--overwrite", action="store_true",
+                    help="overwrite existing member trajectories")
+    ap.add_argument("--metrics-file", default=None,
+                    help="aggregated ensemble metrics JSONL "
+                         "(default: <output-dir>/ensemble_metrics.jsonl)")
+    ap.add_argument("--log-level",
+                    default=os.environ.get("SKELLYSIM_LOG", "INFO"))
+    args = ap.parse_args(argv)
+
+    import logging
+
+    logging.basicConfig(level=args.log_level.upper(),
+                        format="[%(asctime)s] [%(levelname)s] %(message)s",
+                        stream=sys.stderr)
+
+    # x64 for the same reason as the single-run CLI (cli.py): without it the
+    # builder's "f64" members silently canonicalize to f32 and tight
+    # tolerances floor at f32 noise while steps are still accepted
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    run(args.sweep_file, output_dir=args.output_dir, batch=args.batch,
+        batch_impl=args.batch_impl, overwrite=args.overwrite,
+        metrics_path=args.metrics_file)

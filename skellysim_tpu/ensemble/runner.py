@@ -1,0 +1,273 @@
+"""Batched member stepping: stacked states, masked adaptive accept/reject.
+
+The member axis is an ordinary leading batch axis over every `SimState` leaf
+(per-member ``time``/``dt`` ride along as [B] leaves), so the existing pure
+trial step (`System.trial_step` -> prep / GMRES / component advance) batches
+with `jax.vmap` unchanged — the JAX Fast Stokesian Dynamics recipe
+(PAPERS.md: arxiv 2503.07847) applied to the coupled SkellySim step. The
+host adaptive loop of `System._run_loop` becomes device-side masked
+selection: each member carries its own clock, rejected members roll back via
+`jnp.where` against the backup pytree (the step's input — backup/restore is
+free on immutable pytrees), and members past their ``t_final`` are inert
+masked lanes whose leaves pass through unchanged.
+
+Two execution plans for the same batched program (`EnsembleRunner(...,
+batch_impl=...)`):
+
+* ``"vmap"`` (default) — one fused program over the member axis; the
+  throughput mode, and the only mode whose member axis can be sharded
+  across a device mesh (`parallel.shard_ensemble`). Batched GEMM
+  accumulation orders differ from the unbatched step at ~1 ulp, so members
+  match sequential runs to roundoff, not bitwise.
+* ``"unroll"`` — the per-member step inlined once per lane inside the SAME
+  jit program. Each lane compiles to the exact unbatched computation (XLA
+  re-associates nothing across independent inlined subgraphs — measured;
+  `lax.map` does NOT have this property, its scan-body codegen differs
+  from the standalone program at ~1 ulp), so member trajectories are
+  BITWISE identical to sequential `System.run` executions — the
+  reproducibility mode, pinned by `tests/test_ensemble.py`. Trace/compile
+  time scales with B; the masked stepping, scheduler, and
+  backfill-without-retrace behave identically to vmap.
+
+The accept/reject/dt arithmetic reproduces `System._run_loop` exactly: it
+runs in float64 (the host loop computes it in Python floats) and casts back
+to the state dtype, so the per-member dt sequences are bit-identical to the
+sequential loop's for any state dtype.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..system.system import SimState, System
+
+
+class EnsembleState(NamedTuple):
+    """B members as one pytree (leaves of `states` carry a leading [B])."""
+
+    states: SimState
+    #: [B] float64 per-member end time; a lane whose ``time >= t_final`` is
+    #: inert (finished or idle — the scheduler parks empty lanes at -inf)
+    t_final: jnp.ndarray
+
+
+class EnsembleStepInfo(NamedTuple):
+    """Per-member outcome of one batched trial step (all leaves [B])."""
+
+    running: jnp.ndarray          # lane was live at step entry
+    accepted: jnp.ndarray         # trial accepted and state advanced
+    converged: jnp.ndarray
+    iters: jnp.ndarray
+    residual: jnp.ndarray
+    residual_true: jnp.ndarray
+    fiber_error: jnp.ndarray
+    refines: jnp.ndarray
+    loss_of_accuracy: jnp.ndarray
+    collided: jnp.ndarray
+    #: adaptive dt fell below dt_min: the lane is frozen un-advanced (the
+    #: sequential loop raises RuntimeError here; the scheduler decides)
+    dt_underflow: jnp.ndarray
+    dt_used: jnp.ndarray          # the dt this trial stepped with
+    t: jnp.ndarray                # per-member time AFTER the step
+    dt_next: jnp.ndarray          # per-member dt AFTER the step
+    solutions: jnp.ndarray        # [B, n_solution]
+
+
+def _check_member(i, template_leaves, state):
+    leaves = jax.tree_util.tree_leaves(state)
+    if len(leaves) != len(template_leaves):
+        raise ValueError(
+            f"member {i}: pytree structure differs from member 0 "
+            "(ensemble members must share one compiled program)")
+    for j, (a, b) in enumerate(zip(template_leaves, leaves)):
+        a, b = jnp.asarray(a), jnp.asarray(b)
+        if a.shape != b.shape or a.dtype != b.dtype:
+            raise ValueError(
+                f"member {i}: leaf {j} is {b.shape}/{b.dtype} vs member 0's "
+                f"{a.shape}/{a.dtype}; ensemble members must share static "
+                "shapes and dtypes (pad fiber capacity to a common size)")
+
+
+def stack_states(states) -> SimState:
+    """[SimState, ...] -> one SimState whose leaves carry a leading member
+    axis. Every member must share the pytree structure, leaf shapes, and
+    dtypes — the ensemble's one-compiled-program contract."""
+    states = list(states)
+    if not states:
+        raise ValueError("stack_states needs at least one member state")
+    treedef = jax.tree_util.tree_structure(states[0])
+    template_leaves = jax.tree_util.tree_leaves(states[0])
+    for i, s in enumerate(states[1:], start=1):
+        if jax.tree_util.tree_structure(s) != treedef:
+            raise ValueError(
+                f"member {i}: pytree structure differs from member 0 "
+                "(ensemble members must share one compiled program)")
+        _check_member(i, template_leaves, s)
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *states)
+
+
+def lane_state(bstates: SimState, lane: int) -> SimState:
+    """Member ``lane``'s SimState view of a stacked batch."""
+    return jax.tree_util.tree_map(lambda leaf: leaf[lane], bstates)
+
+
+def set_lane(bstates: SimState, lane: int, state: SimState) -> SimState:
+    """Replace lane ``lane``'s leaves — the scheduler's backfill primitive.
+
+    Pure leaf substitution at fixed shapes/dtypes, so a jit'd step over the
+    result reuses its compiled program (no retrace); shape/dtype mismatches
+    raise instead of silently retracing."""
+    _check_member(lane, jax.tree_util.tree_leaves(lane_state(bstates, 0)),
+                  state)
+    return jax.tree_util.tree_map(
+        lambda leaf, s: leaf.at[lane].set(jnp.asarray(s, dtype=leaf.dtype)),
+        bstates, state)
+
+
+def _where_lanes(mask, new_tree, old_tree):
+    """Per-lane select over every leaf (mask [B] broadcast to leaf rank)."""
+    def sel(n, o):
+        m = mask.reshape(mask.shape + (1,) * (n.ndim - 1))
+        return jnp.where(m, n, o)
+
+    return jax.tree_util.tree_map(sel, new_tree, old_tree)
+
+
+class EnsembleRunner:
+    """The jit'd batched trial step with masked per-member adaptive dt.
+
+    One compiled program for a fixed lane count B: the scheduler swaps
+    member leaves in and out of lanes without retracing. Dynamic
+    instability (host-side RNG re-bucketing between steps) and the
+    host-planned evaluators are incompatible with a closed batched trace,
+    so they are rejected at construction rather than silently degraded.
+    """
+
+    def __init__(self, system: System, batch_impl: str = "vmap"):
+        if batch_impl not in ("vmap", "unroll"):
+            raise ValueError(
+                f"unknown batch_impl {batch_impl!r}; use 'vmap' (throughput; "
+                "shardable member axis) or 'unroll' (bit-reproducible lanes)")
+        p = system.params
+        if p.pair_evaluator == "ewald":
+            raise ValueError(
+                "ensemble batching does not support pair_evaluator='ewald': "
+                "the Ewald plan is rebuilt host-side per step and cannot "
+                "live inside the closed batched trace; use 'direct' (small-N "
+                "members are below the Ewald crossover anyway)")
+        if p.pair_evaluator == "ring" and system.mesh is not None:
+            raise ValueError(
+                "ensemble batching does not support the ring pair evaluator "
+                "(shard_map inside the member batch axis); shard the MEMBER "
+                "axis instead (parallel.shard_ensemble) — batch parallelism "
+                "is the outer axis for small-N members")
+        if p.dynamic_instability.n_nodes > 0:
+            raise ValueError(
+                "ensemble batching does not support dynamic instability yet: "
+                "nucleation/catastrophe re-bucket fibers host-side between "
+                "steps (system.dynamic_instability); run those members "
+                "through System.run")
+        self.system = system
+        self.batch_impl = batch_impl
+        self._step_jit = jax.jit(self.step_impl)
+
+    # ------------------------------------------------------------- assembly
+
+    def make_ensemble(self, states, t_finals) -> EnsembleState:
+        """Stack member states + per-member end times into an EnsembleState."""
+        stacked = stack_states(states)
+        t_final = jnp.asarray(list(t_finals), dtype=jnp.float64)
+        if t_final.shape != (stacked.time.shape[0],):
+            raise ValueError(
+                f"t_finals has shape {t_final.shape}, expected "
+                f"({stacked.time.shape[0]},)")
+        return EnsembleState(states=stacked, t_final=t_final)
+
+    # ------------------------------------------------------------- the step
+
+    def _member_body(self, state: SimState):
+        """One member's trial: solve + (under the adaptive gate) collision."""
+        new_state, solution, info = self.system.trial_step(state)
+        if self.system.params.adaptive_timestep_flag:
+            collided = self.system.collision(new_state)
+        else:
+            collided = jnp.asarray(False)
+        return new_state, solution, info, collided
+
+    def step_impl(self, ens: EnsembleState):
+        """(EnsembleState, EnsembleStepInfo) after one masked batched trial.
+
+        Pure and jit-compiled once per (B, member structure); the scheduler
+        drives it. The accept/reject ladder mirrors `System._run_loop`
+        line for line, vectorized over members in float64.
+        """
+        p = self.system.params
+        states = ens.states
+        running = states.time.astype(jnp.float64) < ens.t_final
+
+        if self.batch_impl == "vmap":
+            new_states, solutions, infos, collided = jax.vmap(
+                self._member_body)(states)
+        else:
+            # one inlined copy of the member step per lane: bit-identical to
+            # the unbatched program (see the module docstring)
+            outs = [self._member_body(lane_state(states, i))
+                    for i in range(states.time.shape[0])]
+            new_states, solutions, infos, collided = jax.tree_util.tree_map(
+                lambda *ls: jnp.stack(ls), *outs)
+
+        conv = infos.converged
+        # the host loop's ladder runs in Python floats (f64); matching it
+        # bitwise for any state dtype means doing the dt/t arithmetic in f64
+        # and casting back only at the state boundary
+        dt64 = states.dt.astype(jnp.float64)
+        ferr64 = infos.fiber_error.astype(jnp.float64)
+        false_lanes = jnp.zeros_like(conv)
+        if p.adaptive_timestep_flag:
+            good = conv & (ferr64 <= p.fiber_error_tol)
+            grow = ferr64 <= 0.9 * p.fiber_error_tol
+            dt_new64 = jnp.where(
+                good,
+                jnp.where(grow, jnp.minimum(p.dt_max, dt64 * p.beta_up), dt64),
+                dt64 * p.beta_down)
+            coll = conv & collided
+            dt_new64 = jnp.where(coll, dt64 * 0.5, dt_new64)
+            accept = good & ~coll
+            dt_underflow = running & (dt_new64 < p.dt_min)
+        else:
+            accept = jnp.ones_like(conv)
+            dt_new64 = dt64
+            coll = false_lanes
+            dt_underflow = false_lanes
+
+        # the sequential loop raises BEFORE applying an underflowed update,
+        # leaving the state untouched: frozen lanes here do the same
+        advance = running & accept & ~dt_underflow
+        reject = running & ~accept & ~dt_underflow
+
+        merged = _where_lanes(advance, new_states, states)
+        t_new64 = states.time.astype(jnp.float64) + dt64
+        time_out = jnp.where(advance, t_new64.astype(states.time.dtype),
+                             states.time)
+        dt_out = jnp.where(advance | reject,
+                           dt_new64.astype(states.dt.dtype), states.dt)
+        merged = merged._replace(time=time_out, dt=dt_out)
+
+        info = EnsembleStepInfo(
+            running=running, accepted=advance, converged=conv,
+            iters=infos.iters, residual=infos.residual,
+            residual_true=infos.residual_true, fiber_error=infos.fiber_error,
+            refines=jnp.broadcast_to(
+                jnp.asarray(infos.refines, dtype=jnp.int32), conv.shape),
+            loss_of_accuracy=jnp.broadcast_to(
+                jnp.asarray(infos.loss_of_accuracy), conv.shape),
+            collided=coll, dt_underflow=dt_underflow, dt_used=states.dt,
+            t=merged.time, dt_next=merged.dt, solutions=solutions)
+        return EnsembleState(states=merged, t_final=ens.t_final), info
+
+    def step(self, ens: EnsembleState):
+        """One compiled batched trial step (same signature as `step_impl`)."""
+        return self._step_jit(ens)

@@ -1,0 +1,237 @@
+"""Continuous-batching scheduler: queue -> lanes -> retire -> backfill.
+
+The host-side half of the ensemble subsystem, shaped like an inference
+server's batch scheduler: a fixed number of compiled lanes B, a work queue
+of pending members, and a drain loop that steps the whole batch, writes
+per-member trajectory frames at dt_write boundaries, retires members that
+reach their ``t_final``, and immediately backfills freed lanes from the
+queue — pure leaf substitution at fixed shapes (`runner.set_lane`), so a
+10k-member sweep streams through ONE compiled program
+(`testing.trace_counting_jit` pins the single trace in
+tests/test_ensemble.py).
+
+The per-step host work is one small device fetch (the [B] outcome vectors in
+`EnsembleStepInfo`) plus frame encodes for whichever members crossed a write
+boundary; the solves themselves never leave the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time as _time
+from collections import deque
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..system.system import SimState, crossed_write_boundary
+from ..utils.rng import SimRNG
+from .runner import EnsembleRunner, lane_state, set_lane
+
+logger = logging.getLogger("skellysim_tpu")
+
+#: ensemble t_final for an empty lane: `time < -inf` is never true, so idle
+#: lanes are inert masked no-ops until the queue refills them
+IDLE_T_FINAL = float("-inf")
+
+
+@dataclasses.dataclass
+class MemberSpec:
+    """One queued simulation: initial state + end time (+ optional RNG whose
+    dump rides in the member's trajectory frames, `SimRNG.member(i)`)."""
+
+    member_id: str
+    state: SimState
+    t_final: float
+    rng: Optional[SimRNG] = None
+
+
+@dataclasses.dataclass
+class _Lane:
+    spec: MemberSpec
+    steps: int = 0       # trial steps taken (accepted + rejected)
+    frames: int = 0      # frames written (excluding the initial frame)
+    t: float = 0.0       # entry time of the NEXT trial
+    dt: float = 0.0      # entry dt of the NEXT trial
+
+
+class EnsembleScheduler:
+    """Drain a member queue through B compiled lanes.
+
+    ``writer(member_id, state, rng_state=None)`` is called for each frame a
+    member crosses (`io.ensemble_io.MemberTrajectoryWriters` is the
+    file-based implementation; any callable works). ``metrics`` is a
+    callable receiving one dict per record (`io.ensemble_io
+    .EnsembleMetricsWriter.write`); record kinds are "start", "step",
+    "retire", and "dt_underflow" (schema in docs/ensemble.md).
+
+    ``step_fn`` overrides the runner's jit'd step — the trace-counting tests
+    pass `testing.trace_counting_jit(runner.step_impl)` here.
+
+    ``on_dt_underflow``: the sequential loop raises RuntimeError when the
+    adaptive dt falls below dt_min; "raise" (default) mirrors that,
+    "retire" retires just the failing member (recorded in metrics) and keeps
+    the rest of the sweep running — the serving-shaped choice for large
+    sweeps.
+    """
+
+    def __init__(self, runner: EnsembleRunner, members, batch: int, *,
+                 writer: Optional[Callable] = None,
+                 metrics: Optional[Callable] = None,
+                 step_fn: Optional[Callable] = None,
+                 write_initial_frames: bool = False,
+                 on_dt_underflow: str = "raise",
+                 max_rounds: Optional[int] = None):
+        if batch < 1:
+            raise ValueError(f"batch must be >= 1, got {batch}")
+        if on_dt_underflow not in ("raise", "retire"):
+            raise ValueError(
+                f"unknown on_dt_underflow {on_dt_underflow!r}; "
+                "use 'raise' or 'retire'")
+        members = list(members)
+        if not members:
+            raise ValueError("ensemble needs at least one member")
+        self.runner = runner
+        self.batch = batch
+        self.queue = deque(members)
+        self.writer = writer
+        self.metrics = metrics
+        self.step_fn = step_fn or runner.step
+        self.write_initial_frames = write_initial_frames
+        self.on_dt_underflow = on_dt_underflow
+        self.max_rounds = max_rounds
+        self.rounds = 0
+        self.retired: list = []
+        #: template member state for idle-lane padding (inert masked lanes)
+        self._template = members[0].state
+        self.lanes: list = [None] * batch
+        # seed the lanes: every lane starts on the template (idle), then the
+        # queue fills as many as it can
+        self.ens = runner.make_ensemble([self._template] * batch,
+                                        [IDLE_T_FINAL] * batch)
+        for lane in range(batch):
+            if self.queue:
+                self._start_member(lane, self.queue.popleft())
+
+    # ----------------------------------------------------------- lane churn
+
+    def _emit(self, record: dict):
+        if self.metrics is not None:
+            self.metrics(record)
+
+    def _rng_state(self, spec: MemberSpec):
+        return spec.rng.dump_state() if spec.rng is not None else None
+
+    def _start_member(self, lane: int, spec: MemberSpec):
+        self.ens = self.ens._replace(
+            states=set_lane(self.ens.states, lane, spec.state),
+            t_final=self.ens.t_final.at[lane].set(spec.t_final))
+        self.lanes[lane] = _Lane(spec=spec, t=float(spec.state.time),
+                                 dt=float(spec.state.dt))
+        self._emit({"event": "start", "member": spec.member_id, "lane": lane,
+                    "t": float(spec.state.time), "t_final": spec.t_final})
+        if self.write_initial_frames and self.writer is not None:
+            self.writer(spec.member_id, spec.state,
+                        rng_state=self._rng_state(spec))
+        logger.info("ensemble start member=%s lane=%d t_final=%g",
+                    spec.member_id, lane, spec.t_final)
+
+    def _retire_member(self, lane: int, reason: str = "finished"):
+        ln = self.lanes[lane]
+        self._emit({"event": "retire" if reason == "finished" else reason,
+                    "member": ln.spec.member_id, "lane": lane, "t": ln.t,
+                    "steps": ln.steps, "frames": ln.frames})
+        logger.info("ensemble retire member=%s lane=%d t=%.6g steps=%d (%s)",
+                    ln.spec.member_id, lane, ln.t, ln.steps, reason)
+        self.retired.append(ln.spec.member_id)
+        if self.writer is not None and hasattr(self.writer, "close_member"):
+            # file-based writers free the handle as the lane frees
+            self.writer.close_member(ln.spec.member_id)
+        self.lanes[lane] = None
+        self.ens = self.ens._replace(
+            t_final=self.ens.t_final.at[lane].set(IDLE_T_FINAL))
+        if self.queue:
+            self._start_member(lane, self.queue.popleft())
+
+    # ------------------------------------------------------------ the drain
+
+    def run(self) -> list:
+        """Drain queue + lanes to completion; returns retired member ids in
+        retirement order."""
+        p = self.runner.system.params
+        while any(ln is not None for ln in self.lanes):
+            if self.max_rounds is not None and self.rounds >= self.max_rounds:
+                break
+            wall0 = _time.perf_counter()
+            self.ens, info = self.step_fn(self.ens)
+            # ONE device fetch for all [B] outcome vectors
+            fetched = {f: np.asarray(getattr(info, f))
+                       for f in ("running", "accepted", "iters", "residual",
+                                 "residual_true", "fiber_error", "refines",
+                                 "loss_of_accuracy", "dt_underflow",
+                                 "dt_used", "t", "dt_next")}
+            wall_s = _time.perf_counter() - wall0
+            self.rounds += 1
+
+            for lane, ln in enumerate(self.lanes):
+                if ln is None:
+                    continue
+                if not bool(fetched["running"][lane]):
+                    # occupied but inert: the member was seated already at or
+                    # past its t_final (e.g. a degenerate swept t_final, or a
+                    # resumed state beyond it). Without this retire the lane
+                    # would spin the drain loop forever.
+                    self._retire_member(lane)
+                    continue
+                accepted = bool(fetched["accepted"][lane])
+                underflow = bool(fetched["dt_underflow"][lane])
+                dt_used = float(fetched["dt_used"][lane])
+                t_new = float(fetched["t"][lane])
+                if underflow:
+                    # the sequential loop raises before writing this trial's
+                    # metrics line — no step record here either
+                    if self.on_dt_underflow == "raise":
+                        raise RuntimeError(
+                            f"ensemble member {ln.spec.member_id}: timestep "
+                            f"smaller than dt_min ({p.dt_min}) at t={ln.t:.6g}"
+                        )
+                    self._retire_member(lane, reason="dt_underflow")
+                    continue
+                ln.steps += 1
+                self._emit({
+                    "event": "step", "member": ln.spec.member_id,
+                    "lane": lane, "step": ln.steps - 1, "t": ln.t,
+                    "dt": dt_used, "iters": int(fetched["iters"][lane]),
+                    "residual": float(fetched["residual"][lane]),
+                    "residual_true": float(fetched["residual_true"][lane]),
+                    "fiber_error": float(fetched["fiber_error"][lane]),
+                    "accepted": accepted,
+                    "refines": int(fetched["refines"][lane]),
+                    "loss_of_accuracy": bool(
+                        fetched["loss_of_accuracy"][lane]),
+                    "wall_s": round(wall_s, 4)})
+                ln.t = t_new
+                ln.dt = float(fetched["dt_next"][lane])
+                if (accepted and self.writer is not None
+                        and crossed_write_boundary(t_new, dt_used,
+                                                   p.dt_write)):
+                    self.writer(ln.spec.member_id,
+                                lane_state(self.ens.states, lane),
+                                rng_state=self._rng_state(ln.spec))
+                    ln.frames += 1
+                if t_new >= ln.spec.t_final:
+                    self._retire_member(lane)
+        return self.retired
+
+
+def run_ensemble(system, members, batch: int = 8, *, batch_impl: str = "vmap",
+                 writer=None, metrics=None, write_initial_frames: bool = False,
+                 on_dt_underflow: str = "raise", max_rounds=None) -> list:
+    """One-call convenience: build an `EnsembleRunner` over ``system`` and
+    drain ``members`` (a MemberSpec iterable) through ``batch`` lanes."""
+    runner = EnsembleRunner(system, batch_impl=batch_impl)
+    return EnsembleScheduler(
+        runner, members, batch, writer=writer, metrics=metrics,
+        write_initial_frames=write_initial_frames,
+        on_dt_underflow=on_dt_underflow, max_rounds=max_rounds).run()

@@ -2,3 +2,5 @@ from .trajectory import (TrajectoryReader, TrajectoryWriter, frame_to_state,
                          resume_state)
 from .listener_client import (Listener, Request, StreamlinesRequest,
                               VelocityFieldRequest)
+from .ensemble_io import (EnsembleMetricsWriter,  # noqa: F401
+                          MemberTrajectoryWriters)

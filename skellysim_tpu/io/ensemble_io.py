@@ -1,0 +1,107 @@
+"""Ensemble I/O: per-member trajectory writers + one aggregated metrics JSONL.
+
+Each member gets its own reference-format trajectory
+(`<out_dir>/<member_id>.out`, byte-compatible with `io.trajectory` — every
+existing reader/paraview tool works per member), opened lazily on the
+member's first frame so a 10k-member sweep holds file handles only for the
+members currently in lanes. The aggregated metrics stream is one JSONL file
+with lane/member/step records — the ensemble analogue of the run-loop
+metrics JSONL (docs/performance.md), with `event` discriminating record
+kinds (schema below + docs/ensemble.md; pinned by tests/test_ensemble.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from .trajectory import TrajectoryWriter
+
+#: keys of an ``event == "step"`` record, one per member trial step — the
+#: sequential METRICS_FIELDS (system.system) plus the ensemble coordinates
+ENSEMBLE_STEP_FIELDS = ("event", "member", "lane", "step", "t", "dt", "iters",
+                        "residual", "residual_true", "fiber_error",
+                        "accepted", "refines", "loss_of_accuracy", "wall_s")
+
+#: keys of an ``event == "start"`` record (member entered a lane)
+ENSEMBLE_START_FIELDS = ("event", "member", "lane", "t", "t_final")
+
+#: keys of an ``event == "retire"`` / ``"dt_underflow"`` record (lane freed)
+ENSEMBLE_RETIRE_FIELDS = ("event", "member", "lane", "t", "steps", "frames")
+
+
+class EnsembleMetricsWriter:
+    """Append ensemble records as JSON lines; usable as the scheduler's
+    ``metrics`` callable."""
+
+    def __init__(self, path: str, *, append: bool = False):
+        self.path = path
+        self._fh = open(path, "a" if append else "w")
+
+    def write(self, record: dict):
+        self._fh.write(json.dumps(record) + "\n")
+        self._fh.flush()
+
+    __call__ = write
+
+    def close(self):
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class MemberTrajectoryWriters:
+    """Per-member trajectory files under one directory; usable as the
+    scheduler's ``writer`` callable.
+
+    Handles open lazily (first frame) and close on `close_member` /
+    `close`, so the live handle count tracks the lane count, not the sweep
+    size. Existing member files are refused unless ``overwrite`` — the
+    single-run CLI's no-clobber guard, per member.
+    """
+
+    def __init__(self, out_dir: str, *, overwrite: bool = False):
+        self.out_dir = out_dir
+        self.overwrite = overwrite
+        os.makedirs(out_dir, exist_ok=True)
+        self._writers: dict = {}
+
+    def path(self, member_id: str) -> str:
+        return os.path.join(self.out_dir, f"{member_id}.out")
+
+    def _writer(self, member_id: str) -> TrajectoryWriter:
+        w = self._writers.get(member_id)
+        if w is None:
+            path = self.path(member_id)
+            if os.path.exists(path) and not self.overwrite:
+                raise FileExistsError(
+                    f"member trajectory '{path}' already exists; pass "
+                    "overwrite=True (or the CLI's --overwrite) to replace it")
+            w = self._writers[member_id] = TrajectoryWriter(path)
+        return w
+
+    def write_frame(self, member_id: str, state, *,
+                    rng_state: Optional[list] = None):
+        self._writer(member_id).write_frame(state, rng_state=rng_state)
+
+    __call__ = write_frame
+
+    def close_member(self, member_id: str):
+        w = self._writers.pop(member_id, None)
+        if w is not None:
+            w.close()
+
+    def close(self):
+        for member_id in list(self._writers):
+            self.close_member(member_id)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,4 +1,5 @@
-from .mesh import FIBER_AXIS, make_mesh, shard_state  # noqa: F401
+from .mesh import (FIBER_AXIS, MEMBER_AXIS, make_mesh,  # noqa: F401
+                   make_member_mesh, shard_ensemble, shard_state)
 from .multihost import initialize as initialize_multihost  # noqa: F401
 from .multihost import process_info  # noqa: F401
 from .ring import (ring_oseen_contract, ring_stokeslet,  # noqa: F401

@@ -16,6 +16,17 @@ with a pure-JAX implementation:
 
 The solver runs entirely on device; the per-step "rebuild the Belos problem"
 host round-trip of the reference (`system.cpp:467`) has no analogue here.
+
+Batching semantics (the ensemble subsystem's contract, pinned by
+`tests/test_ensemble.py::test_gmres_vmap_masked_convergence`): because all
+control flow is `lax` primitives, `jax.vmap(gmres)` lifts to ONE batched
+while_loop that runs until every member is done; members whose ``cond`` has
+gone false get their carries select-masked (unchanged), so each member's
+``x``/``iters``/``residual`` are exactly what its solo solve reports — a
+converged member is never perturbed by a slower neighbor still iterating.
+Values match the solo solve to roundoff (batched GEMM accumulation orders
+differ at ~1 ulp); bit-exact members need the per-member program inlined
+per lane (the ensemble runner's ``batch_impl="unroll"``).
 """
 
 from __future__ import annotations

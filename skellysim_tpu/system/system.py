@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import time as _time
 from typing import NamedTuple, Optional
 
@@ -77,6 +78,36 @@ def _rewrap_fibers(fibers, new_buckets: tuple):
     if isinstance(fibers, fc.FiberGroup):
         return new_buckets[0]
     return tuple(new_buckets)
+
+
+#: run-loop metrics JSONL schema: `System.run(metrics_path=...)` appends one
+#: JSON object per TRIAL step with exactly these keys (documented in
+#: docs/performance.md "Run-loop metrics JSONL"; schema-pinned by
+#: tests/test_cli_pipeline.py). Resumed runs are segmented by a marker line
+#: {"resume": true, "t": ...} that `cli.run(resume=True)` appends first.
+METRICS_FIELDS = ("step", "t", "dt", "iters", "residual", "residual_true",
+                  "fiber_error", "accepted", "refines", "loss_of_accuracy",
+                  "wall_s")
+
+
+def crossed_write_boundary(t_new: float, dt: float, dt_write: float) -> bool:
+    """True when the accepted step (t_new - dt, t_new] crosses a dt_write
+    frame boundary.
+
+    Float-robust: the naive ``int(t_new / dt_write) > int((t_new - dt) /
+    dt_write)`` comparison skips a frame when t, accumulated by repeated
+    addition, lands just BELOW a boundary (e.g. eight 0.1-steps reach
+    0.7999999999999999, whose naive frame index is still 7 — the t=0.8 frame
+    is silently dropped). Boundary indices here tolerate a 1e-9 relative
+    shortfall, far above accumulated roundoff (~n ulps) and far below any
+    physical dt. Shared by `System._run_loop` and the ensemble scheduler so
+    batched and sequential runs write identical frame sets.
+    """
+    def idx(t: float) -> int:
+        r = t / dt_write
+        return math.floor(r + 1e-9 * max(abs(r), 1.0))
+
+    return idx(t_new) > idx(t_new - dt)
 
 
 class StepInfo(NamedTuple):
@@ -1009,6 +1040,23 @@ class System:
         plan, anchors = self._ewald_args(state)
         return self._solve_jit(state, ewald_plan=plan, ewald_anchors=anchors)
 
+    def trial_step(self, state: SimState):
+        """The pure, un-jitted trial step: (new_state, solution, info) with a
+        per-member `StepInfo`. This is the batch-steppable seam the ensemble
+        subsystem (`skellysim_tpu.ensemble`) maps over a stacked member axis
+        — `jax.vmap(system.trial_step)` batches the whole prep/GMRES/advance
+        pipeline, because GMRES already keeps its control flow in `lax`
+        primitives (solver/gmres.py "batching" note). Dense evaluators only:
+        the Ewald plan is built host-side per step and cannot live inside a
+        closed batched trace (the ensemble runner rejects it up front)."""
+        return self._solve_impl(state)
+
+    def collision(self, state: SimState):
+        """Pure collision gate (traced bool) — the adaptive loop's reject
+        trigger, exposed un-jitted so the ensemble runner can evaluate it
+        inside the batched step."""
+        return self._check_collision(state)
+
     def run(self, state: SimState, *, writer=None, max_steps: int | None = None,
             rng=None, metrics_path: str | None = None,
             profile_dir: str | None = None):
@@ -1117,11 +1165,15 @@ class System:
                     residual, float(info.residual_true),
                     p.gmres_tol)
             if metrics_fh is not None:
+                # key set == METRICS_FIELDS (schema-pinned; docs/performance.md)
                 metrics_fh.write(json.dumps({
+                    "step": n_steps - 1,
                     "t": float(state.time), "dt": dt, "iters": int(info.iters),
                     "residual": residual,
                     "residual_true": float(info.residual_true),
                     "fiber_error": fiber_error, "accepted": accept,
+                    "refines": int(info.refines),
+                    "loss_of_accuracy": bool(info.loss_of_accuracy),
                     "wall_s": round(wall_s, 4)}) + "\n")
                 metrics_fh.flush()
 
@@ -1130,8 +1182,8 @@ class System:
                 state = new_state._replace(
                     time=jnp.asarray(t_new, dtype=state.time.dtype),
                     dt=jnp.asarray(dt_new, dtype=state.dt.dtype))
-                if writer is not None and (int(t_new / p.dt_write)
-                                           > int((t_new - dt) / p.dt_write)):
+                if writer is not None and crossed_write_boundary(
+                        t_new, dt, p.dt_write):
                     if rng is not None:
                         writer(state, solution, rng_state=rng.dump_state())
                     else:

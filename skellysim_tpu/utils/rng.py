@@ -76,6 +76,26 @@ class SimRNG:
         self.shared = Stream(seed, 0)
         self.distributed = Stream(seed, 1)
 
+    def member(self, i: int) -> "SimRNG":
+        """Deterministic per-member bundle for ensemble replicas.
+
+        Member ``i`` draws from stream ids ``(2i + 2, 2i + 3)`` of the same
+        seeds — disjoint from the base bundle's ``(0, 1)`` and from every
+        other member, and a pure function of ``(seed, i)``: replica i's
+        draws are reproducible no matter how the ensemble scheduler packs
+        lanes or in what order members run. Derivation ignores the base
+        streams' counters for the same reason. The derived bundle
+        round-trips through `dump_state`/`from_state` unchanged (stream
+        state is ``seed:stream_id:counter``), so member trajectories
+        resume like single runs.
+        """
+        if i < 0:
+            raise ValueError(f"member index must be >= 0, got {i}")
+        rng = SimRNG.__new__(SimRNG)
+        rng.shared = Stream(self.shared.seed, 2 * i + 2)
+        rng.distributed = Stream(self.distributed.seed, 2 * i + 3)
+        return rng
+
     def dump_state(self):
         """Trajectory `rng_state` payload: [[name, state], ...]."""
         return [["shared", self.shared.dump()],

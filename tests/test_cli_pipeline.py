@@ -78,10 +78,13 @@ def test_cli_subprocess_enables_x64(tmp_path):
     assert "did not converge" not in p.stderr
 
 
-def test_cli_metrics_file(tmp_path):
-    """--metrics-file appends one JSON step record per trial step
-    (structured metrics, SURVEY.md §5.1/§5.5)."""
+def test_cli_metrics_file_schema_pinned(tmp_path):
+    """--metrics-file appends one JSON step record per trial step with
+    EXACTLY the METRICS_FIELDS schema (structured metrics, SURVEY.md
+    §5.1/§5.5; documented in docs/performance.md)."""
     import json
+
+    from skellysim_tpu.system.system import METRICS_FIELDS
 
     cfg_path = _free_fiber_config(tmp_path)
     metrics = str(tmp_path / "metrics.jsonl")
@@ -89,10 +92,81 @@ def test_cli_metrics_file(tmp_path):
     lines = [json.loads(ln) for ln in open(metrics)]
     assert len(lines) >= 2
     for rec in lines:
-        assert set(rec) == {"t", "dt", "iters", "residual", "residual_true",
-                            "fiber_error", "accepted", "wall_s"}
+        assert set(rec) == set(METRICS_FIELDS)
         assert rec["accepted"] and rec["residual"] < 1e-8
         assert rec["residual_true"] < 1e-7
+        assert rec["refines"] >= 0 and rec["loss_of_accuracy"] is False
+    # trial-step index: contiguous from 0 within one run
+    assert [rec["step"] for rec in lines] == list(range(len(lines)))
+
+
+def test_snapshot_path_aliasing_guard():
+    """cli._snapshot_path: '.out' is substituted, anything else appended —
+    a naive replace could alias the trajectory file itself."""
+    assert (cli._snapshot_path("skelly_sim.out", "initial_config")
+            == "skelly_sim.initial_config")
+    assert (cli._snapshot_path("/a/b/run.out", "final_config")
+            == "/a/b/run.final_config")
+    # non-.out trajectories get the suffix APPENDED, never substituted
+    assert (cli._snapshot_path("traj.bin", "initial_config")
+            == "traj.bin.initial_config")
+    assert (cli._snapshot_path("noext", "initial_config")
+            == "noext.initial_config")
+    # '.out' only counts as the final extension
+    assert (cli._snapshot_path("weird.out.bak", "initial_config")
+            == "weird.out.bak.initial_config")
+    # the snapshot path never equals the trajectory path
+    for traj in ("skelly_sim.out", "traj.bin", "noext", "a.out.out"):
+        assert cli._snapshot_path(traj, "initial_config") != traj
+
+
+def test_crossed_write_boundary_float_robust():
+    """Satellite: the dt_write boundary check survives accumulated float
+    error. With dt == dt_write == 0.1 every step crosses a boundary, but
+    repeated addition lands t=0.7999999999999999 whose naive frame index is
+    still 7 — the naive check skips that frame."""
+    from skellysim_tpu.system.system import crossed_write_boundary
+
+    dt = dt_write = 0.1
+    t = 0.0
+    naive_missed = 0
+    for _ in range(16):
+        t += dt
+        assert crossed_write_boundary(t, dt, dt_write), t
+        if not int(t / dt_write) > int((t - dt) / dt_write):
+            naive_missed += 1
+    assert naive_missed >= 1, "the regression case no longer reproduces"
+    # no double-fire: a step strictly inside one frame window stays silent
+    assert not crossed_write_boundary(0.25, 0.04, 0.1)
+    assert crossed_write_boundary(0.32, 0.04, 0.1)
+
+
+def test_run_loop_writes_every_exact_boundary_frame(tmp_path):
+    """Integration regression: dt dividing dt_write exactly must produce a
+    frame at EVERY boundary (the naive check dropped one around t=0.8)."""
+    cfg = Config()
+    cfg.params.dt_initial = 0.1
+    cfg.params.dt_write = 0.1
+    # 0.95, not 1.0: accumulated t reaches 0.9999999999999999 and the loop's
+    # strict `t < t_final` would take an 11th step — the off-boundary end
+    # keeps this a pure frame-boundary regression
+    cfg.params.t_final = 0.95
+    cfg.params.gmres_tol = 1e-10
+    cfg.params.adaptive_timestep_flag = False
+    fib = Fiber(n_nodes=16, length=1.0, bending_rigidity=0.01)
+    fib.fill_node_positions(np.zeros(3), np.array([0.0, 0.0, 1.0]))
+    cfg.fibers = [fib]
+    cfg.background = BackgroundSource(uniform=[1.0, 0.0, 0.0])
+    cfg_path = str(tmp_path / "skelly_config.toml")
+    cfg.save(cfg_path)
+    cli.run(cfg_path)
+
+    r = TrajectoryReader(str(tmp_path / "skelly_sim.out"))
+    # initial frame + one per 0.1-boundary in (0, 1.0]
+    assert len(r) == 11, [r.load_frame(i)["time"] for i in range(len(r))]
+    times = [r.load_frame(i)["time"] for i in range(len(r))]
+    np.testing.assert_allclose(times, np.arange(11) * 0.1, atol=1e-9)
+    r.close()
 
 
 def test_cli_run_free_fiber_uniform_background(tmp_path):
@@ -128,21 +202,29 @@ def test_cli_guards(tmp_path):
                 trajectory_path=str(tmp_path / "nope.out"), resume=True)
 
 
-def test_cli_resume_continues(tmp_path):
+def test_cli_resume_continues_and_appends_metrics(tmp_path):
+    """--resume extends the trajectory, and with --metrics-file appends to
+    the existing metrics file after a {"resume": true} marker line so
+    post-hoc analysis can segment runs (step indices restart at 0 per
+    run)."""
+    import json
+
     cfg_path = _free_fiber_config(tmp_path)
-    cli.run(cfg_path)
+    metrics = str(tmp_path / "metrics.jsonl")
+    cli.run(cfg_path, metrics_path=metrics)
     traj = str(tmp_path / "skelly_sim.out")
     r = TrajectoryReader(traj)
     t_end1 = r.load_frame(len(r) - 1)["time"]
     n1 = len(r)
     r.close()
+    n_first = len(open(metrics).readlines())
 
     # extend t_final and resume
     from skellysim_tpu.config import load_config
     cfg = load_config(cfg_path)
     cfg.params.t_final = 0.04
     cfg.save(cfg_path)
-    cli.run(cfg_path, resume=True)
+    cli.run(cfg_path, resume=True, metrics_path=metrics)
 
     r = TrajectoryReader(traj)
     assert len(r) > n1
@@ -150,6 +232,16 @@ def test_cli_resume_continues(tmp_path):
     assert t_end2 > t_end1
     assert t_end2 == pytest.approx(0.04, abs=0.006)
     r.close()
+
+    lines = [json.loads(ln) for ln in open(metrics)]
+    assert len(lines) > n_first + 1
+    markers = [(i, rec) for i, rec in enumerate(lines) if "resume" in rec]
+    assert len(markers) == 1
+    i_mark, marker = markers[0]
+    assert i_mark == n_first and marker["resume"] is True
+    assert marker["t"] == pytest.approx(0.02, abs=0.006)
+    # both segments' step indices restart at 0
+    assert lines[0]["step"] == 0 and lines[i_mark + 1]["step"] == 0
 
 
 def test_precompute_and_body_drag_pipeline(tmp_path):

@@ -1,0 +1,341 @@
+"""skelly-ensemble: batched execution + continuous-batching scheduler.
+
+Pins the ISSUE-2 acceptance criteria:
+
+* an ensemble of B >= 8 small systems on the 8-device virtual CPU mesh
+  produces per-member trajectories BITWISE identical to B sequential
+  single-run `System.run` executions with the same per-member dt sequences
+  (masked adaptive stepping changes nothing observable) — `batch_impl=
+  "unroll"`, including through lane backfills;
+* the batched step traces exactly once across backfills
+  (`testing.trace_counting_jit`);
+* GMRES's masked-convergence semantics under vmap: a converged member's
+  solution/iteration count is unperturbed by slower members still
+  iterating.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from __graft_entry__ import _make_system
+from skellysim_tpu.ensemble import (EnsembleRunner, EnsembleScheduler,
+                                    MemberSpec, lane_state, stack_states)
+from skellysim_tpu.io.ensemble_io import (ENSEMBLE_RETIRE_FIELDS,
+                                          ENSEMBLE_START_FIELDS,
+                                          ENSEMBLE_STEP_FIELDS)
+from skellysim_tpu.io.trajectory import frame_bytes
+from skellysim_tpu.testing import trace_counting_jit
+from skellysim_tpu.utils.rng import SimRNG
+
+
+def _ensemble_system():
+    """Small adaptive free-fiber system: 1 x 8-node fiber, f64 (tiny B/N —
+    these tests must fit the per-commit fast tier)."""
+    system, state = _make_system(n_fibers=1, n_nodes=8, dtype=jnp.float64)
+    system.params = dataclasses.replace(
+        system.params, adaptive_timestep_flag=True, dt_max=4e-3,
+        dt_write=2e-3, fiber_error_tol=0.1, t_final=1.0)
+    return system, state
+
+
+#: lane count for the acceptance pin (the ISSUE's "B >= 8 ... on the
+#: 8-device virtual CPU mesh") and member count (> B, so retirement +
+#: backfill churn through the lanes)
+B_LANES = 8
+N_MEMBERS = 10
+
+
+def _members(base_state, n=N_MEMBERS):
+    """n members with distinct geometry, dt sequences, and end times."""
+    members = []
+    for i in range(n):
+        st = base_state._replace(
+            fibers=base_state.fibers._replace(x=base_state.fibers.x + 0.01 * i),
+            dt=jnp.asarray(1e-3 * (1 + 0.1 * i), dtype=jnp.float64))
+        members.append(MemberSpec(member_id=f"m{i}", state=st,
+                                  t_final=0.004 + 0.002 * i))
+    return members
+
+
+@pytest.fixture(scope="module")
+def scene():
+    system, base_state = _ensemble_system()
+    return system, _members(base_state)
+
+
+@pytest.fixture(scope="module")
+def runners(scene):
+    """One runner per execution plan, shared module-wide so every test at
+    lane count B reuses the same compiled batched step."""
+    system, _ = scene
+    return {"unroll": EnsembleRunner(system, batch_impl="unroll"),
+            "vmap": EnsembleRunner(system, batch_impl="vmap")}
+
+
+@pytest.fixture(scope="module")
+def sequential_frames(scene):
+    """Reference: each member through the sequential adaptive loop (one solo
+    System — its jit is t_final-independent, so one compile serves all)."""
+    system, members = scene
+    solo, _ = _ensemble_system()
+    out = {}
+    for m in members:
+        solo.params = dataclasses.replace(system.params, t_final=m.t_final)
+        frames = []
+        solo.run(m.state,
+                 writer=lambda s, sol, **kw: frames.append(frame_bytes(s)))
+        out[m.member_id] = frames
+    return out
+
+
+def _drain(runner, members, batch, **kw):
+    frames = {m.member_id: [] for m in members}
+    records = []
+    sched = EnsembleScheduler(
+        runner, members, batch,
+        writer=lambda mid, s, rng_state=None: frames[mid].append(
+            frame_bytes(s)),
+        metrics=records.append, **kw)
+    retired = sched.run()
+    return frames, records, retired, sched
+
+
+@pytest.fixture(scope="module")
+def unroll_drain(scene, runners):
+    """One unroll-plan sweep shared by the parity and cross-plan tests."""
+    _, members = scene
+    return _drain(runners["unroll"], members, batch=B_LANES)
+
+
+def test_unroll_trajectories_bitwise_vs_sequential(scene, unroll_drain,
+                                                   sequential_frames):
+    """THE acceptance pin: B=8 lanes on the 8-device virtual CPU platform,
+    10 members (so lanes retire + backfill mid-sweep), masked adaptive
+    stepping — per-member frame sequences bitwise identical to 10
+    sequential `System.run` executions."""
+    _, members = scene
+    frames, _, retired, _ = unroll_drain
+    assert sorted(retired) == sorted(m.member_id for m in members)
+    for m in members:
+        seq = sequential_frames[m.member_id]
+        ens = frames[m.member_id]
+        assert len(seq) == len(ens) > 0, m.member_id
+        for k, (a, b) in enumerate(zip(seq, ens)):
+            assert a == b, (f"{m.member_id} frame {k} differs from the "
+                            "sequential run (bytes)")
+
+
+def test_vmap_matches_unroll_to_roundoff(scene, runners, unroll_drain):
+    """The throughput plan agrees with the bit-reproducible plan to
+    roundoff: same frame count, same accept/reject pattern, values tight."""
+    _, members = scene
+    f_unroll, r_unroll, _, _ = unroll_drain
+    f_vmap, r_vmap, _, _ = _drain(runners["vmap"], members, batch=B_LANES)
+    steps_u = [(r["member"], r["step"], r["accepted"]) for r in r_unroll
+               if r["event"] == "step"]
+    steps_v = [(r["member"], r["step"], r["accepted"]) for r in r_vmap
+               if r["event"] == "step"]
+    assert steps_u == steps_v
+    from skellysim_tpu.io import eigen
+    import msgpack
+
+    for mid in f_unroll:
+        assert len(f_unroll[mid]) == len(f_vmap[mid])
+        for a, b in zip(f_unroll[mid], f_vmap[mid]):
+            fa = eigen.decode_tree(msgpack.unpackb(a, raw=False))
+            fb = eigen.decode_tree(msgpack.unpackb(b, raw=False))
+            assert fa["time"] == fb["time"] and fa["dt"] == fb["dt"]
+            np.testing.assert_allclose(np.asarray(fa["fibers"][1][0]["x_"]),
+                                       np.asarray(fb["fibers"][1][0]["x_"]),
+                                       rtol=1e-9, atol=1e-12)
+
+
+def test_batched_step_traces_once_across_backfills(scene, runners):
+    """Continuous batching's compiled-program contract: retiring members and
+    backfilling lanes from the queue must reuse the one traced program."""
+    _, members = scene
+    runner = runners["vmap"]
+    step = trace_counting_jit(runner.step_impl)
+    sched = EnsembleScheduler(runner, members, batch=3, step_fn=step)
+    retired = sched.run()
+    assert sorted(retired) == sorted(m.member_id for m in members)
+    assert sched.rounds > len(members) / 3  # several generations of lanes
+    assert step.trace_count == 1, (
+        "backfill retraced the batched step — a leaf swap changed its "
+        "static signature")
+
+
+def test_member_axis_shards_across_mesh(scene, runners):
+    """B=8 members shard over the 8-device virtual CPU mesh (batch
+    parallelism as the outer axis) and step to the same answer."""
+    from skellysim_tpu.parallel import make_member_mesh, shard_ensemble
+
+    _, members = scene
+    runner = runners["vmap"]
+    ens = runner.make_ensemble([m.state for m in members[:8]],
+                               [m.t_final for m in members[:8]])
+    mesh = make_member_mesh(8)
+    sharded = shard_ensemble(ens, mesh)
+    assert len(sharded.t_final.sharding.device_set) == 8
+    out_ref, info_ref = runner.step(ens)
+    out_sh, info_sh = runner.step(sharded)
+    np.testing.assert_array_equal(np.asarray(info_ref.iters),
+                                  np.asarray(info_sh.iters))
+    np.testing.assert_allclose(np.asarray(out_sh.states.fibers.x),
+                               np.asarray(out_ref.states.fibers.x),
+                               rtol=1e-9, atol=1e-12)
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_ensemble(runner.make_ensemble([members[0].state] * 3,
+                                            [0.1] * 3), mesh)
+
+
+def test_ensemble_metrics_schema(scene, runners):
+    """Aggregated metrics JSONL schema: start/step/retire records carry
+    exactly the documented keys (docs/ensemble.md)."""
+    _, members = scene
+    _, records, _, _ = _drain(runners["vmap"], members[:3], batch=B_LANES)
+    kinds = {r["event"] for r in records}
+    assert kinds == {"start", "step", "retire"}
+    for r in records:
+        if r["event"] == "start":
+            assert set(r) == set(ENSEMBLE_START_FIELDS)
+        elif r["event"] == "step":
+            assert set(r) == set(ENSEMBLE_STEP_FIELDS)
+        else:
+            assert set(r) == set(ENSEMBLE_RETIRE_FIELDS)
+    # step indices are contiguous per member from 0
+    for m in members[:3]:
+        steps = [r["step"] for r in records
+                 if r["event"] == "step" and r["member"] == m.member_id]
+        assert steps == list(range(len(steps))) and steps
+
+
+def test_dt_underflow_policies(scene):
+    """An adaptive member whose dt collapses mirrors the sequential
+    RuntimeError by default; 'retire' keeps the rest of the sweep alive."""
+    system, members = scene
+    sys2, _ = _ensemble_system()
+    sys2.params = dataclasses.replace(system.params, fiber_error_tol=0.0,
+                                      dt_min=1e-3)
+    runner = EnsembleRunner(sys2, batch_impl="vmap")
+    bad = [MemberSpec("bad", members[0].state, t_final=0.1)]
+    with pytest.raises(RuntimeError, match="smaller than dt_min"):
+        _drain(runner, bad, batch=1)
+    _, records, retired, _ = _drain(runner, bad, batch=1,
+                                    on_dt_underflow="retire")
+    assert retired == ["bad"]
+    assert any(r["event"] == "dt_underflow" for r in records)
+
+
+def test_degenerate_t_final_member_retires_instead_of_hanging(scene, runners):
+    """A member seated at or past its t_final (degenerate swept value,
+    resumed state beyond it) must retire unstepped — an inert occupied lane
+    used to spin the drain loop forever."""
+    _, members = scene
+    degenerate = MemberSpec("done", members[0].state, t_final=0.0)
+    live = MemberSpec("live", members[1].state, t_final=members[1].t_final)
+    _, records, retired, sched = _drain(runners["vmap"], [degenerate, live],
+                                        batch=2, max_rounds=50)
+    assert sorted(retired) == ["done", "live"]
+    done_steps = [r for r in records
+                  if r["event"] == "step" and r["member"] == "done"]
+    assert not done_steps
+    assert sched.rounds < 50
+
+
+def test_runner_rejects_untraceable_configs(scene):
+    system, members = scene
+    with pytest.raises(ValueError, match="batch_impl"):
+        EnsembleRunner(system, batch_impl="pmap")
+    ew = dataclasses.replace(system.params, pair_evaluator="ewald")
+    sys_ew, _ = _ensemble_system()
+    sys_ew.params = ew
+    with pytest.raises(ValueError, match="ewald"):
+        EnsembleRunner(sys_ew)
+    di = dataclasses.replace(
+        system.params,
+        dynamic_instability=dataclasses.replace(
+            system.params.dynamic_instability, n_nodes=16))
+    sys_di, _ = _ensemble_system()
+    sys_di.params = di
+    with pytest.raises(ValueError, match="dynamic instability"):
+        EnsembleRunner(sys_di)
+
+
+def test_stack_states_rejects_mismatched_members(scene):
+    system, members = scene
+    a = members[0].state
+    wrong_shape = a._replace(fibers=a.fibers._replace(
+        x=jnp.concatenate([a.fibers.x, a.fibers.x], axis=0)))
+    with pytest.raises(ValueError, match="leaf"):
+        stack_states([a, wrong_shape])
+    wrong_dtype = jax.tree_util.tree_map(
+        lambda l: l.astype(jnp.float32)
+        if jnp.issubdtype(l.dtype, jnp.floating) else l, a)
+    with pytest.raises(ValueError, match="dtype|leaf"):
+        stack_states([a, wrong_dtype])
+
+
+def test_gmres_vmap_masked_convergence():
+    """solver/ pin: under vmap, a member that converges early keeps exactly
+    its solo solution/iters while slower members keep iterating (the
+    while_loop's select-masked carries); `lax`-only control flow is what
+    makes the whole system step batchable."""
+    from skellysim_tpu.solver import gmres
+
+    rng = np.random.default_rng(11)
+    n, B = 24, 3
+    # member i's conditioning worsens with i -> strictly more iterations
+    As, bs = [], []
+    for i in range(B):
+        Q = rng.standard_normal((n, n)) / np.sqrt(n)
+        As.append(jnp.asarray(np.eye(n) + (0.1 + 0.4 * i) * Q))
+        bs.append(jnp.asarray(rng.standard_normal(n)))
+    As, bs = jnp.stack(As), jnp.stack(bs)
+
+    def solve(A, b):
+        return gmres(lambda v: A @ v, b, tol=1e-12, restart=30, maxiter=90)
+
+    batched = jax.jit(jax.vmap(solve))(As, bs)
+    solo = [solve(As[i], bs[i]) for i in range(B)]
+    iters = [int(r.iters) for r in solo]
+    assert len(set(iters)) > 1, "members must genuinely differ in iters"
+    for i, r in enumerate(solo):
+        assert int(batched.iters[i]) == iters[i]
+        assert bool(batched.converged[i]) and bool(r.converged)
+        np.testing.assert_allclose(np.asarray(batched.x[i]), np.asarray(r.x),
+                                   rtol=1e-9, atol=1e-12)
+
+
+def test_simrng_member_streams():
+    """Satellite: deterministic per-member stream derivation — disjoint,
+    scheduling-order independent, and dump/restore round-trippable."""
+    base = SimRNG(seed=7)
+    m0, m3 = base.member(0), base.member(3)
+    # derivation ignores the base bundle's draw position
+    base.shared.uniform(size=4)
+    base.distributed.normal(size=4)
+    assert base.member(3).distributed.dump() == m3.distributed.dump()
+    # streams are disjoint across members and from the base bundle
+    draws = {tuple(rng.distributed.uniform(size=3).tolist())
+             for rng in (SimRNG(seed=7), m0, m3, base.member(1))}
+    assert len(draws) == 4
+    with pytest.raises(ValueError):
+        base.member(-1)
+
+
+def test_simrng_member_dump_restore_roundtrip():
+    m = SimRNG(seed=13).member(5)
+    m.shared.uniform(size=2)
+    m.distributed.normal(size=3)
+    dumped = m.dump_state()
+    restored = SimRNG.from_state(dumped)
+    assert restored.dump_state() == dumped
+    np.testing.assert_array_equal(restored.distributed.uniform(size=8),
+                                  m.distributed.uniform(size=8))
+    np.testing.assert_array_equal(restored.shared.normal(size=8),
+                                  m.shared.normal(size=8))

@@ -1,0 +1,167 @@
+"""Sweep spec (config.sweep) + the `python -m skellysim_tpu.ensemble` driver.
+
+Spec expansion is pure host logic (fast, exhaustive); the driver test runs a
+real free-fiber sweep in-process: base config -> members -> continuous
+batching -> per-member reference-format trajectories + aggregated metrics.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from skellysim_tpu.config import (Config, BackgroundSource, Fiber,
+                                  apply_overrides, expand_members,
+                                  load_sweep)
+from skellysim_tpu.config.schema import EnsembleSweep, SweepAxis
+
+
+def _base_config(tmp_path, t_final=0.02):
+    cfg = Config()
+    cfg.params.eta = 1.0
+    cfg.params.dt_initial = 0.005
+    cfg.params.dt_write = 0.005
+    cfg.params.t_final = t_final
+    cfg.params.gmres_tol = 1e-10
+    cfg.params.adaptive_timestep_flag = False
+    cfg.params.seed = 42
+    fib = Fiber(n_nodes=8, length=1.0, bending_rigidity=0.01)
+    fib.fill_node_positions(np.zeros(3), np.array([0.0, 0.0, 1.0]))
+    cfg.fibers = [fib]
+    cfg.background = BackgroundSource(uniform=[1.0, 0.0, 0.0])
+    path = str(tmp_path / "skelly_config.toml")
+    cfg.save(path)
+    return cfg, path
+
+
+def _sweep_file(tmp_path, body: str) -> str:
+    path = str(tmp_path / "ensemble.toml")
+    with open(path, "w") as fh:
+        fh.write(body)
+    return path
+
+
+def test_load_sweep_and_validation(tmp_path):
+    path = _sweep_file(tmp_path, """
+[ensemble]
+base_config = "skelly_config.toml"
+replicas = 2
+batch = 4
+seed = 9
+t_final = 0.01
+
+[[ensemble.sweep]]
+key = "fibers.0.length"
+values = [1.0, 1.25]
+""")
+    spec = load_sweep(path)
+    assert (spec.replicas, spec.batch, spec.seed, spec.t_final) == (2, 4, 9,
+                                                                    0.01)
+    assert [ax.key for ax in spec.sweep] == ["fibers.0.length"]
+
+    with pytest.raises(ValueError, match="missing \\[ensemble\\]"):
+        load_sweep(_sweep_file(tmp_path, "[other]\nx = 1\n"))
+    with pytest.raises(ValueError, match="unknown \\[ensemble\\] keys"):
+        load_sweep(_sweep_file(tmp_path, "[ensemble]\nreplicass = 2\n"))
+    with pytest.raises(ValueError, match="batch_impl"):
+        load_sweep(_sweep_file(tmp_path,
+                               "[ensemble]\nbatch_impl = 'pmap'\n"))
+    with pytest.raises(ValueError, match="static runtime Params"):
+        load_sweep(_sweep_file(tmp_path, """
+[ensemble]
+[[ensemble.sweep]]
+key = "params.eta"
+values = [1.0, 2.0]
+"""))
+
+
+def test_expand_members_cartesian_replicas(tmp_path):
+    base, _ = _base_config(tmp_path)
+    spec = EnsembleSweep(
+        replicas=2, seed=-1, t_final=-1.0,
+        sweep=[SweepAxis(key="fibers.0.length", values=[1.0, 1.25]),
+               SweepAxis(key="fibers.0.bending_rigidity",
+                         values=[0.01, 0.02, 0.03])])
+    plans = expand_members(spec, base)
+    assert len(plans) == 2 * 2 * 3
+    assert [p.member_id for p in plans[:3]] == ["m00000", "m00001", "m00002"]
+    assert all(p.index == i for i, p in enumerate(plans))
+    # seed/t_final default to the base config's
+    assert all(p.seed == 42 for p in plans)
+    assert all(p.t_final == base.params.t_final for p in plans)
+    # every cartesian point appears replicas times
+    points = {(p.overrides["fibers.0.length"],
+               p.overrides["fibers.0.bending_rigidity"]) for p in plans}
+    assert len(points) == 6
+
+
+def test_apply_overrides_paths(tmp_path):
+    base, _ = _base_config(tmp_path)
+    out = apply_overrides(base, {"fibers.0.length": 2.0,
+                                 "background.uniform.1": 0.5})
+    assert out.fibers[0].length == 2.0
+    assert out.background.uniform[1] == 0.5
+    # the base is untouched (deep copy)
+    assert base.fibers[0].length == 1.0 and base.background.uniform[1] == 0.0
+    with pytest.raises(ValueError, match="no\\s+field"):
+        apply_overrides(base, {"fibers.0.lenght": 2.0})
+    with pytest.raises(ValueError, match="out of range"):
+        apply_overrides(base, {"fibers.3.length": 2.0})
+    with pytest.raises(ValueError, match="static runtime Params"):
+        apply_overrides(base, {"params.gmres_tol": 1e-6})
+
+
+def test_ensemble_cli_end_to_end(tmp_path):
+    """Sweep -> trajectories: 2 lengths x 2 replicas through 2 lanes, then
+    every member trajectory reads back with the right geometry and its own
+    RNG stream, and the metrics JSONL segments by member."""
+    from skellysim_tpu.ensemble import cli as ens_cli
+    from skellysim_tpu.io.trajectory import TrajectoryReader
+
+    _base_config(tmp_path)
+    sweep = _sweep_file(tmp_path, """
+[ensemble]
+base_config = "skelly_config.toml"
+replicas = 2
+batch = 2
+t_final = 0.01
+
+[[ensemble.sweep]]
+key = "fibers.0.length"
+values = [1.0, 1.25]
+""")
+    out_dir = str(tmp_path / "out")
+    retired = ens_cli.run(sweep, output_dir=out_dir)
+    assert sorted(retired) == [f"m{i:05d}" for i in range(4)]
+
+    lengths = []
+    rng_states = set()
+    for i in range(4):
+        r = TrajectoryReader(os.path.join(out_dir, f"m{i:05d}.out"))
+        assert len(r) >= 2  # initial frame + at least one dt_write frame
+        frame = r.load_frame(-1)
+        lengths.append(frame["fibers"][1][0]["length_"])
+        rng_states.add(json.dumps(frame["rng_state"]))
+        # uniform background advected the fiber: x drifted by u * t
+        x0 = np.asarray(r.load_frame(0)["fibers"][1][0]["x_"]).reshape(-1, 3)
+        x1 = np.asarray(frame["x_"] if "x_" in frame else
+                        frame["fibers"][1][0]["x_"]).reshape(-1, 3)
+        np.testing.assert_allclose(x1[:, 0] - x0[:, 0],
+                                   frame["time"] - r.load_frame(0)["time"],
+                                   atol=1e-10)
+        r.close()
+    assert sorted(lengths) == [1.0, 1.0, 1.25, 1.25]
+    assert len(rng_states) == 4, "each member must carry its own RNG stream"
+
+    with open(os.path.join(out_dir, "ensemble_metrics.jsonl")) as fh:
+        records = [json.loads(ln) for ln in fh]
+    by_event = {}
+    for r in records:
+        by_event.setdefault(r["event"], []).append(r)
+    assert len(by_event["start"]) == len(by_event["retire"]) == 4
+    assert {r["member"] for r in by_event["step"]} == set(retired)
+
+    # clobber guard: a second run without --overwrite refuses up front
+    with pytest.raises(SystemExit, match="already exist"):
+        ens_cli.run(sweep, output_dir=out_dir)

@@ -214,6 +214,7 @@ def test_system_solve_with_ewald_evaluator():
     assert err < 1e-6, err
 
 
+@pytest.mark.slow  # 23s on the 2-core box (~45s+ 1-core-calibrated): heavy in-process integration (fast-tier budget)
 def test_ewald_with_inactive_padding_fibers():
     """grow_capacity padding (inactive slots replicating slot 0) must not
     blow up bucket occupancy or change results: padded sources are spread
@@ -280,6 +281,7 @@ def test_ewald_anchor_hop_reuses_compiled_program():
                                rtol=0, atol=1e-8)
 
 
+@pytest.mark.slow  # 30s on the 2-core box (~60s 1-core-calibrated): heavy in-process integration (fast-tier budget)
 def test_block_sparse_near_field_on_fiber_cloud():
     """Line-clustered clouds auto-select the block-sparse near field
     (no occupancy padding waste); it agrees with the cells mode and the

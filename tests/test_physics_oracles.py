@@ -65,6 +65,7 @@ def test_fiber_const_force_sbt_drag():
     assert rel < 1e-6, rel
 
 
+@pytest.mark.slow  # 39s on the 2-core box: heavy in-process integration (fast-tier budget)
 def test_fiber_dualfilament_deflection():
     """A perturbed compressed filament drives its straight neighbor through
     hydrodynamics alone; final tip x-positions vs the reference's committed

@@ -140,6 +140,7 @@ def test_velocity_at_targets_far_field(tmp_path):
     np.testing.assert_allclose(v_near[0], [1.0, 0.0, 0.0], atol=1e-8)
 
 
+@pytest.mark.slow  # coupled-solve + field integration (fast-tier budget)
 def test_velocity_inside_body_is_rigid_motion(tmp_path):
     """Targets inside a rigid body report v + omega x dx (`system.cpp:364-381`)."""
     from skellysim_tpu.config import Body, ConfigSpherical
@@ -179,6 +180,7 @@ def test_velocity_inside_body_is_rigid_motion(tmp_path):
 
 # ----------------------------------------------------------------- listener
 
+@pytest.mark.slow  # listener server e2e (fast-tier budget)
 def test_listener_server_roundtrip(tmp_path):
     """Full request/response through the in-process server loop."""
     cfg_path, traj_path = _run_fiber_sim(tmp_path)
@@ -214,6 +216,7 @@ def test_listener_server_roundtrip(tmp_path):
         [1.0, 0.0, 0.0], atol=5e-2)
 
 
+@pytest.mark.slow  # subprocess pipeline (fast-tier budget)
 def test_listener_client_subprocess(tmp_path, monkeypatch):
     """The Python client drives a real --listen server subprocess
     (`reader.py:126-194` semantics)."""
@@ -244,6 +247,7 @@ def test_listener_invalid_frame_returns_empty(tmp_path):
     (size,) = struct.unpack("<Q", stdout.read(8))
     assert size == 0
 
+@pytest.mark.slow  # coupled-solve + field integration (fast-tier budget)
 def test_velocity_inside_ellipsoid_body_is_rigid_motion():
     """Ellipsoid containment override (`system.cpp:371-380`): probes inside
     an ELLIPSOIDAL body report its rigid motion v + omega x dx, including
@@ -286,6 +290,7 @@ def test_velocity_inside_ellipsoid_body_is_rigid_motion():
     assert not np.allclose(v_out[0], v_body + np.cross(omega, p_out[0]),
                            atol=1e-12)
 
+@pytest.mark.slow  # 24s Ewald streamline integration (fast-tier budget)
 def test_listener_streamlines_through_ewald(tmp_path):
     """An "FMM" request integrates streamlines through the spectral-Ewald
     evaluator (per-request extended-box plan, matching the reference's

@@ -12,9 +12,14 @@ from this image (`toml`, `dataclass_utils`, `nptyping`,
 logic that runs is the reference's own.
 """
 
+import os
 import sys
-import tomllib
 import types
+
+try:
+    import tomllib  # Python >= 3.11
+except ModuleNotFoundError:
+    import tomli as tomllib  # API-compatible backport (3.10 boxes)
 
 import numpy as np
 import pytest
@@ -37,6 +42,8 @@ _STUBS = ("toml", "dataclass_utils", "nptyping", "function_generator")
 def ref_reader_module():
     """Import the reference's `skelly_sim.reader` with dependency shims,
     cleaning all of it out of `sys.modules` afterwards."""
+    if not os.path.isdir(REF_SRC):
+        pytest.skip(f"reference checkout not present at {REF_SRC}")
     saved = {name: sys.modules.get(name)
              for name in _STUBS + ("skelly_sim",)}
 

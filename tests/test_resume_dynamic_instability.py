@@ -7,6 +7,7 @@ stream round-trip through the trajectory, so a resumed run continues cleanly.
 """
 
 import numpy as np
+import pytest
 
 from skellysim_tpu import builder, cli, precompute
 from skellysim_tpu.config import Body, Config
@@ -40,6 +41,7 @@ def _di_config(tmp_path, t_final):
     return path
 
 
+@pytest.mark.slow  # 27s e2e run->resume pipeline (fast-tier budget)
 def test_resume_with_dynamic_instability(tmp_path):
     cfg_path = _di_config(tmp_path, t_final=0.3)
     precompute.precompute_from_config(cfg_path, verbose=False)
