@@ -37,7 +37,12 @@ case "$TIER" in
   *) echo "unknown tier '$TIER' (use fast|full|nightly)" >&2; exit 2 ;;
 esac
 
-echo "== graft entry compile check =="
+echo "== graft entry: compile check + FULL-STEP multichip dryrun =="
+# dryrun_multichip(8) is the full coupled implicit step as one explicitly-
+# sharded shard_map program (parallel/spmd.py) on the 8-device virtual CPU
+# mesh; it asserts residual AND solution parity against the 1-device solve
+# to <= 5e-9 (the reference's backend-agreement gate) internally, plus the
+# mixed-precision leg whose refinement sweeps run inside the mesh program.
 JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as ge
 import jax
@@ -45,7 +50,7 @@ fn, args = ge.entry()
 jax.jit(fn).lower(*args).compile()
 print('entry() compiles')
 ge.dryrun_multichip(8)
-print('dryrun_multichip(8) ok')
+print('dryrun_multichip(8) full-step parity ok (gate %.0e)' % ge.PARITY_GATE)
 "
 
 echo "CI $TIER tier: PASS"

@@ -304,6 +304,57 @@ def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
     return vel
 
 
+def flow_multi_local(buckets, caches_list, forces_list, r_loc, r_rep, eta, *,
+                     axis_name, n_dev: int, subtract_self: bool = True,
+                     impl: str = "exact"):
+    """`flow_multi` for callers ALREADY INSIDE a `shard_map` over the fiber
+    axis (the SPMD implicit step, `parallel.spmd`).
+
+    ``buckets``/``caches_list``/``forces_list`` are this shard's resident
+    fiber blocks. Two target classes with different evaluation strategies:
+
+    * ``r_loc`` — targets resident on this shard (its own fiber nodes, its
+      shell row block). Source blocks rotate the ring (`lax.ppermute`), so
+      every shard's resident targets see all sources: n_dev-1 nearest-
+      neighbor hops, O(N/D) peak memory, identical to `parallel.ring`.
+    * ``r_rep`` — targets REPLICATED across shards (body nodes, a
+      replicated shell). Evaluated as one local source block partial whose
+      `psum` is the caller's job — summing partials is what keeps the
+      replicated rows bitwise identical on every shard (a ring accumulation
+      would add the same terms in a different order per shard, and
+      ulp-level divergence in replicated values desynchronizes the
+      solver's convergence control flow across devices).
+
+    Returns ``(v_loc, v_rep_partial)`` (``None`` for an absent class); when
+    ``subtract_self`` the leading rows of ``r_loc`` must be this shard's
+    concatenated fiber nodes in bucket order. DF impls ("df"/"pallas_df")
+    accumulate in float64 and cast back to the target dtype at the seam,
+    like `flow_multi`'s ring branch.
+    """
+    from ..parallel.ring import ring_flow_local
+
+    pos = jnp.concatenate([node_positions(g) for g in buckets], axis=0)
+    wf = jnp.concatenate([weighted_forces(g, f).reshape(-1, 3)
+                          for g, f in zip(buckets, forces_list)], axis=0)
+
+    v_loc = ring_flow_local("stokeslet", impl, r_loc, pos, wf, eta,
+                            axis_name=axis_name, n_dev=n_dev, ring=True)
+    v_rep = (ring_flow_local("stokeslet", impl, r_rep, pos, wf, eta,
+                             axis_name=axis_name, n_dev=n_dev, ring=False)
+             if r_rep is not None else None)
+
+    if subtract_self:
+        off = 0
+        for g, caches in zip(buckets, caches_list):
+            nfn = g.n_fibers * g.n_nodes
+            self_vel = jnp.einsum("fij,fj->fi", caches.stokeslet,
+                                  wf[off:off + nfn].reshape(g.n_fibers, -1))
+            v_loc = v_loc.at[off:off + nfn].add(
+                -self_vel.reshape(-1, 3).astype(v_loc.dtype))
+            off += nfn
+    return v_loc, v_rep
+
+
 def apply_fiber_force(group: FiberGroup, caches: FiberCaches, x_all) -> jnp.ndarray:
     """Solution -> force density on nodes, [nf, n, 3] (`apply_fiber_force`, `:272-287`)."""
     f = jnp.einsum("fij,fj->fi", caches.force_op, x_all)  # [nf, 3n]

@@ -44,7 +44,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_kernels import _pad_to, _vma
+from .pallas_kernels import _out_struct, _pad_to
 
 __all__ = ["stokeslet_pallas_df", "stresslet_pallas_df",
            "stokeslet_pallas_df_block", "stresslet_pallas_df_block"]
@@ -275,8 +275,7 @@ def _pallas_df_call(kernel, trg_hl, src_hl, payload_hl, n_trg, tile_t, tile_s,
     z = np.int32(0)  # i64/i32 index-map mix breaks Mosaic (pallas_kernels)
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((6, nt), jnp.float32,
-                                       vma=_vma(trg_p, src_p, pay_p)),
+        out_shape=_out_struct((6, nt), jnp.float32, trg_p, src_p, pay_p),
         grid=grid,
         in_specs=[
             pl.BlockSpec((6, tile_t), lambda i, j: (z, i),

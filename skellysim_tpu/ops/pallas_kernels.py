@@ -49,11 +49,26 @@ STRESSLET_TILE_S = 2048
 def _vma(*arrays):
     """Union of the operands' varying-mesh-axes: pallas_call under shard_map
     must declare which mesh axes its output varies over (jax >= 0.9
-    check_vma); outside shard_map every vma is empty and this is a no-op."""
+    check_vma); outside shard_map every vma is empty and this is a no-op.
+    Pre-0.9 jax (the pinned container version) has neither `jax.typeof` nor
+    the vma system — nothing to declare (`parallel.compat` runs those
+    shard_maps with replication checking off)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
     out = frozenset()
     for a in arrays:
-        out |= getattr(jax.typeof(a), "vma", frozenset())
+        out |= getattr(typeof(a), "vma", frozenset())
     return out
+
+
+def _out_struct(shape, dtype, *arrays):
+    """`jax.ShapeDtypeStruct` carrying the operands' vma union where the
+    jax version supports it (>= 0.9); plain struct on the pre-vma pinned
+    container jax, whose ShapeDtypeStruct rejects the kwarg."""
+    if getattr(jax, "typeof", None) is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=_vma(*arrays))
 
 
 def _pad_to(a, n, axis, value=0.0):
@@ -125,8 +140,7 @@ def stokeslet_pallas(r_src, r_trg, f_src, eta, *, tile_t: int = DEFAULT_TILE_T,
         _stokeslet_kernel,
         # vma: inside shard_map (the ring evaluator's tile) the output varies
         # over whatever mesh axes the operands do; outside it's frozenset()
-        out_shape=jax.ShapeDtypeStruct((3, nt), dtype, vma=_vma(trg_T, src_T,
-                                                               f_T)),
+        out_shape=_out_struct((3, nt), dtype, trg_T, src_T, f_T),
         grid=grid,
         in_specs=[
             pl.BlockSpec((3, tile_t), lambda i, j: (z, i),
@@ -204,8 +218,7 @@ def stresslet_pallas(r_dl, r_trg, f_dl, eta, *, tile_t: int = STRESSLET_TILE_T,
     z = np.int32(0)  # see stokeslet_pallas: i64/i32 index-map mix breaks Mosaic
     u_T = pl.pallas_call(
         _stresslet_kernel,
-        out_shape=jax.ShapeDtypeStruct((3, nt), dtype,
-                                       vma=_vma(trg_T, src_T, s_T)),
+        out_shape=_out_struct((3, nt), dtype, trg_T, src_T, s_T),
         grid=grid,
         in_specs=[
             pl.BlockSpec((3, tile_t), lambda i, j: (z, i),

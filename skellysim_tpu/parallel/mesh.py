@@ -61,15 +61,31 @@ def shard_ensemble(ens, mesh: Mesh):
         ens)
 
 
-def shard_state(state, mesh: Mesh, *, allow_replicated_shell: bool = False):
-    """Place a SimState on the mesh.
+#: shell placement schema, by PeripheryState FIELD NAME: the two O(n_nodes^2)
+#: dense operators row-shard (the analogue of the reference's Scatterv'd shell
+#: rows, `periphery.cpp:408-442`, whose matvec becomes all-gather(density) +
+#: local row-block GEMV, `periphery.cpp:21-47`); every other shell leaf
+#: (nodes/normals/weights/density — all O(n_nodes)) replicates.
+SHELL_ROW_SHARDED_FIELDS = ("stresslet_plus_complementary", "M_inv")
 
-    - fiber-batch leaves: sharded along the fiber axis;
-    - shell dense operators (stresslet_plus_complementary, M_inv): row-sharded
-      — the analogue of the reference's Scatterv'd shell rows
-      (`periphery.cpp:408-442`), whose matvec becomes all-gather(density) +
-      local row-block GEMV (`periphery.cpp:21-47`), inserted by GSPMD;
-    - everything else (small body state, scalars, shell vectors): replicated.
+
+def shard_state(state, mesh: Mesh, *, allow_replicated_shell: bool = False):
+    """Place a SimState on the mesh, schema-driven off the field names.
+
+    - ``fibers``: every leaf of a bucket is [n_fibers]-leading by
+      construction (`fibers.container.FiberGroup`), so the whole bucket
+      shards along the fiber axis when the mesh divides its fiber count
+      (and replicates as a unit otherwise);
+    - ``shell``: per-field spec table (`SHELL_ROW_SHARDED_FIELDS`) — the
+      dense operators row-shard, the O(n_nodes) vectors replicate;
+    - everything else (time/dt scalars, bodies, point/background sources):
+      replicated, the analogue of the reference's rank-0 body ownership.
+
+    Placement used to shape-sniff leaves (leading dim == some bucket's
+    n_fibers), which mis-sharded any replicated leaf whose length collided
+    with a fiber count — e.g. a [3*n_nodes] shell density when n_fibers ==
+    3*n_nodes (regression-pinned in tests/test_shell_sharding.py). Field
+    names, not shapes, now decide.
 
     pjit rejects uneven shardings, so the shell rows can only distribute when
     the mesh size divides 3*n_nodes. Anything else raises: silently
@@ -82,21 +98,24 @@ def shard_state(state, mesh: Mesh, *, allow_replicated_shell: bool = False):
     row_sharding = NamedSharding(mesh, P(FIBER_AXIS, None))
     rep_sharding = NamedSharding(mesh, P())
 
-    from ..fibers.container import as_buckets
+    from ..fibers.container import FiberGroup, as_buckets
 
-    nfs = {g.n_fibers for g in as_buckets(state.fibers) if g.n_fibers > 0}
+    def rep(leaf):
+        return jax.device_put(jax.numpy.asarray(leaf), rep_sharding)
 
-    def place(leaf):
-        leaf = jax.numpy.asarray(leaf)
-        if (leaf.ndim >= 1 and leaf.shape[0] in nfs
-                and leaf.shape[0] % mesh.size == 0):
-            return jax.device_put(leaf, fib_sharding)
-        return jax.device_put(leaf, rep_sharding)
+    def place_bucket(group):
+        if group.n_fibers > 0 and group.n_fibers % mesh.size == 0:
+            return jax.tree_util.tree_map(
+                lambda leaf: jax.device_put(jax.numpy.asarray(leaf),
+                                            fib_sharding), group)
+        return jax.tree_util.tree_map(rep, group)
 
-    # place the O(n^2) shell operators straight to their final sharding (never
-    # replicate them first — peak per-device memory would be the full matrix)
+    fibers = state.fibers
+    if fibers is not None:
+        placed = tuple(place_bucket(g) for g in as_buckets(fibers))
+        fibers = placed[0] if isinstance(fibers, FiberGroup) else placed
+
     shell = state.shell
-    state = jax.tree_util.tree_map(place, state._replace(shell=None))
     if shell is not None:
         rows = shell.M_inv.shape[0]
         if rows % mesh.size == 0:
@@ -111,11 +130,15 @@ def shard_state(state, mesh: Mesh, *, allow_replicated_shell: bool = False):
                 "on every device. Pick a shell n_nodes that is a multiple of "
                 f"{mesh.size}, or pass allow_replicated_shell=True to accept "
                 "the per-device memory cost.")
-        rest = jax.tree_util.tree_map(
-            place, shell._replace(stresslet_plus_complementary=None,
-                                  M_inv=None))
-        shell = rest._replace(
-            stresslet_plus_complementary=jax.device_put(
-                shell.stresslet_plus_complementary, big),
-            M_inv=jax.device_put(shell.M_inv, big))
-    return state._replace(shell=shell)
+        # place the O(n^2) operators straight to their final sharding (never
+        # replicate them first — peak per-device memory would be the full
+        # matrix)
+        shell = type(shell)(*[
+            jax.device_put(jax.numpy.asarray(leaf),
+                           big if name in SHELL_ROW_SHARDED_FIELDS else
+                           rep_sharding)
+            for name, leaf in zip(shell._fields, shell)])
+
+    rest = jax.tree_util.tree_map(
+        rep, state._replace(fibers=None, shell=None))
+    return rest._replace(fibers=fibers, shell=shell)

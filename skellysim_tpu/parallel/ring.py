@@ -33,6 +33,7 @@ from ..ops.kernels import (DEFAULT_EPS, DEFAULT_REG, oseen_block,
                            pallas_impl_for, stokeslet_block,
                            stokeslet_block_mxu, stresslet_block,
                            stresslet_block_mxu)
+from .compat import shard_map
 from .mesh import FIBER_AXIS
 
 
@@ -118,9 +119,83 @@ def _ring_eval(block_fn, mesh: Mesh, axis_name: str, specs, scale, *operands,
     # _pallas_interpret): its grid emulation's dynamic_slice mixes
     # varying/non-varying operands, which the vma checker rejects — the jax
     # error message itself prescribes check_vma=False as the workaround
-    return jax.shard_map(local, mesh=mesh, in_specs=specs,
-                         out_specs=P(axis_name),
-                         check_vma=not unroll)(*operands)
+    return shard_map(local, mesh=mesh, in_specs=specs,
+                     out_specs=P(axis_name),
+                     check_vma=not unroll)(*operands)
+
+
+#: per-kernel block table for `ring_flow_local`: (exact block, MXU block,
+#: pallas block name, XLA DF block name, pallas DF block name)
+_LOCAL_FLOW_BLOCKS = {
+    "stokeslet": (stokeslet_block, stokeslet_block_mxu,
+                  "stokeslet_pallas_block", "_stokeslet_block_df",
+                  "stokeslet_pallas_df_block"),
+    "stresslet": (stresslet_block, stresslet_block_mxu,
+                  "stresslet_pallas_block", "_stresslet_block_df",
+                  "stresslet_pallas_df_block"),
+}
+
+
+def ring_flow_local(kind: str, impl: str, r_trg, src, payload, eta, *,
+                    axis_name: str, n_dev: int, ring: bool = True):
+    """Pairwise flow for callers ALREADY INSIDE a `shard_map` over
+    ``axis_name`` (the SPMD full step, `parallel.spmd`) — the ONE place the
+    local-ring evaluation contract lives for every tile family, so the DF
+    seam (f64 accumulate, weak-typing-safe eta scale, cast back to the
+    target dtype) cannot drift between the fiber and shell callers.
+
+    ``kind`` picks the kernel ("stokeslet" payload [n, 3] forces,
+    "stresslet" payload [n, 3, 3]); ``impl`` any of the tile names
+    (exact/mxu/pallas/df/pallas_df — pallas falls back per
+    `ops.kernels.pallas_impl_for`, interpret-mode unrolling per
+    `_pallas_interpret`). ``ring=True`` accumulates over the rotating
+    source blocks (targets stay resident — every shard's targets see all
+    sources after n_dev-1 `ppermute` hops); ``ring=False`` evaluates ONE
+    local source-block partial for the caller to `psum` — the evaluation
+    strategy for REPLICATED target rows, whose values must come out
+    bitwise identical on every shard (a ring would add the same terms in a
+    different order per shard).
+    """
+    exact_block, mxu_block, pallas_name, df_name, pallas_df_name = \
+        _LOCAL_FLOW_BLOCKS[kind]
+    if impl in ("df", "pallas_df"):
+        from ..ops import df_kernels
+
+        if not jax.config.jax_enable_x64:
+            raise RuntimeError("DF ring tiles need jax_enable_x64 for "
+                               "their float64 accumulator")
+        block, interp = _df_ring_block(impl, getattr(df_kernels, df_name),
+                                       pallas_df_name)
+        th, tl = df_kernels._df_split(r_trg)
+        sh, sl = df_kernels._df_split(src)
+        ph, pl = df_kernels._df_split(payload)
+        # eta enters as an f64 scalar: a weak-typed eta would demote the
+        # f64 DF accumulator
+        scale = jnp.asarray(1.0 / (8.0 * math.pi), dtype=jnp.float64) \
+            / jnp.asarray(eta, dtype=jnp.float64)
+        if ring:
+            # accumulator derived via zeros_like so it carries the
+            # mesh-varying axis (see `_ring_df`)
+            u0 = jnp.zeros_like(th, dtype=jnp.float64)
+            u = _ring_accumulate(
+                lambda sh_r, sl_r, ph_r, pl_r: block(
+                    (th, tl), (sh_r, sl_r), (ph_r, pl_r)),
+                axis_name, n_dev, u0, sh, sl, ph, pl, unroll=interp)
+        else:
+            u = block((th, tl), (sh, sl), (ph, pl))
+        # seam contract: DF accumulates f64, callers get the target dtype
+        return (u * scale).astype(r_trg.dtype)
+
+    impl = pallas_impl_for(impl, r_trg, src, payload)
+    block = _ring_block(impl, exact_block, mxu_block, pallas_name)
+    scale = 1.0 / (8.0 * math.pi * eta)
+    if ring:
+        u = _ring_accumulate(lambda s, f: block(r_trg, s, f), axis_name,
+                             n_dev, jnp.zeros_like(r_trg), src, payload,
+                             unroll=_pallas_interpret(impl))
+    else:
+        u = block(r_trg, src, payload)
+    return u * scale
 
 
 @partial(jax.jit, static_argnames=("mesh", "axis_name", "impl"))
@@ -208,9 +283,9 @@ def _ring_df(block_fn, mesh: Mesh, axis_name: str, r_src, r_trg, payload, eta,
             axis_name, n_dev, u0, sh_l, sl_l, ph_l, pl_l, unroll=unroll)
         return u / (8.0 * math.pi) / _jnp.asarray(eta, dtype=jnp.float64)  # skelly-lint: ignore[dtype-discipline] — eta scales the f64 DF accumulator; a weak-typed eta would demote it
 
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 6,
-                         out_specs=spec,
-                         check_vma=not unroll)(th, tl, sh, sl, ph, pl)
+    return shard_map(local, mesh=mesh, in_specs=(spec,) * 6,
+                     out_specs=spec,
+                     check_vma=not unroll)(th, tl, sh, sl, ph, pl)
 
 
 @partial(jax.jit, static_argnames=("mesh", "axis_name", "impl"))

@@ -253,6 +253,34 @@ def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct
     return kernels.stresslet_direct(shell.nodes, r_trg, f_dl, eta, impl=impl)
 
 
+def flow_local(shell: PeripheryState, r_loc, r_rep, density, eta, *,
+               axis_name, n_dev: int, impl: str = "exact"):
+    """`flow` for callers ALREADY INSIDE a `shard_map` over the fiber axis
+    (`parallel.spmd`): ``shell`` is this shard's row block (nodes/normals
+    node-aligned with ``density``'s [3*N/D] rows).
+
+    Like `fibers.container.flow_multi_local`, two target classes:
+    ``r_loc`` (shard-resident rows — fiber nodes) accumulates over the
+    rotating shell source blocks with `lax.ppermute`; ``r_rep``
+    (replicated rows — body nodes) is one local source-block partial for
+    the caller to `psum`, which keeps replicated values bitwise identical
+    across shards. Returns ``(v_loc, v_rep_partial)``. The shell
+    SELF-interaction is not computed in any mode — it lives in the dense
+    stored operator (`System._apply_matvec`)."""
+    from ..parallel.ring import ring_flow_local
+
+    rho = density.reshape(-1, 3)
+    f_dl = 2.0 * eta * shell.normals[:, :, None] * rho[:, None, :]
+    src = shell.nodes
+
+    v_loc = ring_flow_local("stresslet", impl, r_loc, src, f_dl, eta,
+                            axis_name=axis_name, n_dev=n_dev, ring=True)
+    v_rep = (ring_flow_local("stresslet", impl, r_rep, src, f_dl, eta,
+                             axis_name=axis_name, n_dev=n_dev, ring=False)
+             if r_rep is not None else None)
+    return v_loc, v_rep
+
+
 # ------------------------------------------------- shape-specific interactions
 
 def check_collision(shape: PeripheryShape, points, threshold):

@@ -59,31 +59,51 @@ class GmresResult(NamedTuple):
     refines: int | jnp.ndarray = 0
 
 
-def _icgs(V, w, k, n_restart):
+def _icgs(V, w, k, n_restart, rdot):
     """Two-pass classical Gram-Schmidt of w against V[:k+1] (rows are basis vectors).
 
     Uses a mask over the fixed-size basis so the loop stays shape-static.
+    ``rdot(V, w)`` computes the batch of basis dot products — under the SPMD
+    solver this is the one collective (a `psum`) per orthogonalization pass.
     """
     mask = (jnp.arange(n_restart + 1, dtype=jnp.int32) <= k).astype(w.dtype)
     h = jnp.zeros(n_restart + 1, dtype=w.dtype)
     for _ in range(2):
-        proj = mask * (V @ w)            # [m+1] masked dots  <v_i, w>
+        proj = mask * rdot(V, w)         # [m+1] masked dots  <v_i, w>
         w = w - proj @ V
         h = h + proj
     return w, h
 
 
+def _reductions(rdot):
+    """(rdot, norm) pair from an optional injected reduction.
+
+    ``rdot(A, w)`` contracts the vector (solution-layout) axis: ``A @ w`` for
+    the single-program solver; the SPMD solver (`parallel.spmd`) injects a
+    partial-dot + `lax.psum` so GMRES runs unchanged on row-sharded Krylov
+    vectors with explicit collectives. The default path keeps
+    `jnp.linalg.norm` bit-for-bit (golden trajectories pin it)."""
+    if rdot is None:
+        return (lambda A, w: A @ w), jnp.linalg.norm
+    return rdot, lambda v: jnp.sqrt(rdot(v, v))
+
+
 @partial(jax.jit, static_argnames=("matvec", "precond", "restart", "maxiter",
-                                   "debug"))
+                                   "debug", "rdot"))
 def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
           tol: float = 1e-10, restart: int = 100, maxiter: int = 1000,
-          debug: bool = False) -> GmresResult:
+          debug: bool = False, rdot: Callable | None = None) -> GmresResult:
     """Solve ``matvec(x) = b`` with right-preconditioned restarted GMRES.
 
     ``precond`` approximates A^-1 (applied on the right). Initial guess is zero,
     like the reference's freshly constructed solution vector each step.
     ``debug=True`` prints the residuals after each restart cycle (the
     analogue of Belos' per-iteration verbosity, `solver_hydro.cpp:73-83`).
+
+    ``rdot`` optionally replaces the vector-axis contraction (``A @ w``) for
+    every dot product and norm — the seam `parallel.spmd` uses to run this
+    exact solver on row-sharded Krylov vectors inside `shard_map`, with one
+    explicit `psum` per reduction instead of compiler-chosen all-gathers.
 
     Acceptance is on the explicit residual ``||b - A x|| / ||b||`` recomputed
     at every restart boundary (one extra matvec per cycle), so the returned
@@ -94,8 +114,9 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
     dtype = b.dtype
     m = min(restart, maxiter)
     M = precond if precond is not None else (lambda v: v)
+    rdot, _norm = _reductions(rdot)
 
-    b_norm = jnp.linalg.norm(b)
+    b_norm = _norm(b)
     # all-zero RHS -> solution zero, declare converged immediately
     safe_b_norm = jnp.where(b_norm > 0.0, b_norm, 1.0)
     tol_abs = tol * safe_b_norm
@@ -103,7 +124,7 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
     def arnoldi_cycle(x0, r0):
         """One restart cycle from x0 with precomputed residual r0 = b - A x0;
         returns (x, implicit_resid, inner_iters)."""
-        beta = jnp.linalg.norm(r0)
+        beta = _norm(r0)
         safe_beta = jnp.where(beta > 0.0, beta, 1.0)
 
         V0 = jnp.zeros((m + 1, n), dtype=dtype).at[0].set(r0 / safe_beta)
@@ -119,8 +140,8 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
         def body(state):
             k, V, H, cs, sn, g, done = state
             w = matvec(M(V[k]))
-            w, h = _icgs(V, w, k, m)
-            h_norm = jnp.linalg.norm(w)
+            w, h = _icgs(V, w, k, m, rdot)
+            h_norm = _norm(w)
             h = h.at[k + 1].set(h_norm)
             V = V.at[k + 1].set(w / jnp.where(h_norm > 0.0, h_norm, 1.0))
 
@@ -182,7 +203,7 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
         x, resid_impl, k = arnoldi_cycle(x, r)
         r = b - matvec(x)
         prev_true = resid_true
-        resid_true = jnp.linalg.norm(r) / safe_b_norm
+        resid_true = _norm(r) / safe_b_norm
         if debug:
             jax.debug.print(
                 "gmres restart {c}: iters={i} implicit={ri:.3e} "
@@ -203,11 +224,12 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
 
 
 @partial(jax.jit, static_argnames=("matvec_hi", "matvec_lo", "precond_lo",
-                                   "restart", "maxiter", "max_refine"))
+                                   "restart", "maxiter", "max_refine",
+                                   "rdot"))
 def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
              precond_lo: Callable | None = None, tol: float = 1e-10,
              inner_tol: float = 1e-5, restart: int = 100, maxiter: int = 1000,
-             max_refine: int = 8) -> GmresResult:
+             max_refine: int = 8, rdot: Callable | None = None) -> GmresResult:
     """Mixed-precision GMRES with iterative refinement.
 
     The TPU-native answer to the reference's f64 accuracy gates (GMRES tol
@@ -234,7 +256,8 @@ def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
     GMRES).
     """
     M = precond_lo if precond_lo is not None else (lambda v: v)
-    b_norm = jnp.linalg.norm(b)
+    _norm = _reductions(rdot)[1]
+    b_norm = _norm(b)
     safe_b_norm = jnp.where(b_norm > 0.0, b_norm, 1.0)
 
     def cond(state):
@@ -245,10 +268,10 @@ def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
     def body(state):
         x, r, _, outer, total = state
         d = gmres(matvec_lo, r, precond=M, tol=inner_tol,
-                  restart=restart, maxiter=maxiter)
+                  restart=restart, maxiter=maxiter, rdot=rdot)
         x = x + d.x
         r = b - matvec_hi(x)
-        r_rel = jnp.linalg.norm(r) / safe_b_norm
+        r_rel = _norm(r) / safe_b_norm
         return x, r, r_rel, outer + 1, total + d.iters
 
     x0 = jnp.zeros_like(b)

@@ -180,6 +180,19 @@ class System:
                 f"unknown precond {params.precond!r}; use 'gs' or 'jacobi'")
         self._solve_jit = jax.jit(self._solve_impl,
                                   static_argnames=("ewald_plan",))
+        # donating twin for the run loop: the input state's buffers (the
+        # dense shell operators above all) alias into the unchanged output
+        # leaves instead of double-buffering per step. Only safe where a
+        # rejected step never rolls back to the donated input — `_run_loop`
+        # selects it exactly when the adaptive gate is off; CPU XLA has no
+        # donation (it would warn per call), so there it is never selected
+        # (tests pin the aliasing at lowering time instead).
+        self._solve_jit_donated = jax.jit(self._solve_impl,
+                                          static_argnames=("ewald_plan",),
+                                          donate_argnums=(0,))
+        #: built SPMD step programs keyed by (mesh, state structure) —
+        #: see `step_spmd`
+        self._spmd_steps = {}
         self._collision_jit = jax.jit(self._check_collision)
         self._vel_jit = jax.jit(self._velocity_at_targets_impl,
                                 static_argnames=("ewald_plan",))
@@ -1040,6 +1053,42 @@ class System:
         plan, anchors = self._ewald_args(state)
         return self._solve_jit(state, ewald_plan=plan, ewald_anchors=anchors)
 
+    def _step_donating(self, state: SimState):
+        """`step` through the donating jit — the caller's ``state`` buffers
+        are CONSUMED on backends with donation support (see __init__)."""
+        plan, anchors = self._ewald_args(state)
+        return self._solve_jit_donated(state, ewald_plan=plan,
+                                       ewald_anchors=anchors)
+
+    def step_spmd(self, state: SimState, mesh, *,
+                  allow_replicated_shell: bool = False,
+                  flat_solution: bool = True, donate: str | bool = "auto"):
+        """One explicitly-sharded implicit step on ``mesh`` — the whole
+        prep/GMRES/advance pipeline as ONE `shard_map` program with manual
+        collectives (`parallel.spmd`: psum'd dot products, ring ppermutes
+        for the pairwise flows, one density all-gather per shell operator
+        application) instead of GSPMD-chosen ones. The built program is
+        cached per (mesh, state structure); returns (new_state, solution,
+        info) with ``new_state`` still sharded.
+
+        ``donate="auto"`` donates ``state``'s buffers on accelerator
+        backends — do not reuse the argument afterwards there."""
+        from ..parallel.spmd import build_spmd_step
+
+        buckets = fiber_buckets(state.fibers)
+        key = (mesh, allow_replicated_shell, flat_solution, donate,
+               jax.tree_util.tree_structure(state), state.time.dtype,
+               tuple(g.n_fibers for g in buckets),
+               state.shell.n_nodes if state.shell is not None else 0)
+        fn = self._spmd_steps.get(key)
+        if fn is None:
+            fn = build_spmd_step(
+                self, mesh, state,
+                allow_replicated_shell=allow_replicated_shell,
+                flat_solution=flat_solution, donate=donate)
+            self._spmd_steps[key] = fn
+        return fn(state)
+
     def trial_step(self, state: SimState):
         """The pure, un-jitted trial step: (new_state, solution, info) with a
         per-member `StepInfo`. This is the batch-steppable seam the ensemble
@@ -1099,6 +1148,15 @@ class System:
 
         p = self.params
         n_steps = 0
+        # with the adaptive gate off no step is ever rejected, so the
+        # pre-step pytree is never rolled back to — donate it through the
+        # jit (the ~GB-class caches/operators alias in place instead of
+        # double-buffering per step). Adaptive runs keep the non-donating
+        # jit: `backup` must stay alive for rejects. CPU XLA has no
+        # donation support, so skip there (jit warns on every call).
+        donate_ok = (not p.adaptive_timestep_flag
+                     and jax.default_backend() != "cpu")
+        step_fn = self._step_donating if donate_ok else self.step
         while float(state.time) < p.t_final:
             if max_steps is not None and n_steps >= max_steps:
                 break
@@ -1109,8 +1167,12 @@ class System:
                 nm = self.mesh.size if self._ring_active() else 1
                 state = apply_dynamic_instability(state, p, rng,
                                                   node_multiple=nm)
+            # snapshot the time scalars BEFORE the step: with donation on,
+            # the step consumes the input state's buffers
+            t_cur = float(state.time)
+            dt = float(state.dt)
             wall0 = _time.perf_counter()
-            new_state, solution, info = self.step(state)
+            new_state, solution, info = step_fn(state)
             # host fetch, not block_until_ready: blocking on one leaf was
             # observed returning before the program finished, undermeasuring
             # wall_s by >100x
@@ -1120,7 +1182,6 @@ class System:
             converged = bool(info.converged)
             fiber_error = float(info.fiber_error)
 
-            dt = float(state.dt)
             dt_new = dt
             accept = True
             if p.adaptive_timestep_flag:
@@ -1141,7 +1202,7 @@ class System:
 
             logger.info(
                 "step t=%.6g dt=%.4g iters=%d residual=%.3e (true %.3e) "
-                "fiber_error=%.3e %s (%.3fs)", float(state.time), dt,
+                "fiber_error=%.3e %s (%.3fs)", t_cur, dt,
                 int(info.iters), residual,
                 float(info.residual_true), fiber_error,
                 "accepted" if accept else "rejected", wall_s)
@@ -1168,7 +1229,7 @@ class System:
                 # key set == METRICS_FIELDS (schema-pinned; docs/performance.md)
                 metrics_fh.write(json.dumps({
                     "step": n_steps - 1,
-                    "t": float(state.time), "dt": dt, "iters": int(info.iters),
+                    "t": t_cur, "dt": dt, "iters": int(info.iters),
                     "residual": residual,
                     "residual_true": float(info.residual_true),
                     "fiber_error": fiber_error, "accepted": accept,
@@ -1178,7 +1239,7 @@ class System:
                 metrics_fh.flush()
 
             if accept:
-                t_new = float(state.time) + dt
+                t_new = t_cur + dt
                 state = new_state._replace(
                     time=jnp.asarray(t_new, dtype=state.time.dtype),
                     dt=jnp.asarray(dt_new, dtype=state.dt.dtype))

@@ -26,7 +26,10 @@ def test_bench_prints_one_parseable_json_line(tmp_path):
         shutil.copy(BENCH_JSON, saved)
     env = dict(os.environ)
     env.update({"BENCH_FORCE_CPU": "1", "BENCH_BUDGET_S": "120",
-                "BENCH_PROBE_S": "1"})
+                "BENCH_PROBE_S": "1",
+                # keep this smoke run's partial ladder out of the real
+                # MULTICHIP_r06.json artifact
+                "BENCH_MULTICHIP_PATH": str(tmp_path / "MULTICHIP.json")})
     env.pop("JAX_PLATFORMS", None)
     # scrub the conftest's 8-virtual-device pin too: a real `python bench.py`
     # run sees the host's devices, not cores split 8 ways (which slows every

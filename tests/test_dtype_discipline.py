@@ -137,6 +137,7 @@ def test_container_dtype_lint_clean():
     assert _lint_dtype("skellysim_tpu/fibers/container.py") == []
 
 
+@pytest.mark.slow  # drives the full mixed solve through the DF tier: ~1 min on the CPU tier
 def test_df_tier_kernel_impl_preserves_f32_solve_dtype():
     """The DF tiles return float64 internally; the evaluator seam must cast
     back so an f32 solve with kernel_impl="df"/"pallas_df" stays f32 end to

@@ -52,6 +52,7 @@ def _oracle_stresslet(r_dl, r_trg, S, eta=1.0):
     return np.einsum("ts,tsk->tk", -3.0 * dSd * rinv**5, d) / (8 * np.pi * eta)
 
 
+@pytest.mark.slow  # interpret-mode pallas: minutes-class on the 1-core CPU tier
 def test_stokeslet_pallas_df_f64_accuracy():
     r_src, r_trg, f = _cloud(300, 200, overlap=40)
     got = np.asarray(stokeslet_pallas_df(jnp.asarray(r_src), jnp.asarray(r_trg),
@@ -61,6 +62,7 @@ def test_stokeslet_pallas_df_f64_accuracy():
     assert np.linalg.norm(got - ref) / np.linalg.norm(ref) < 5e-13
 
 
+@pytest.mark.slow  # interpret-mode pallas: minutes-class on the 1-core CPU tier
 def test_stokeslet_pallas_df_matches_xla_df_twin():
     r_src, r_trg, f = _cloud(520, 140)  # src spans >1 source tile (512)
     a = np.asarray(stokeslet_pallas_df(jnp.asarray(r_src), jnp.asarray(r_trg),
@@ -70,6 +72,7 @@ def test_stokeslet_pallas_df_matches_xla_df_twin():
     assert np.linalg.norm(a - b) / np.linalg.norm(b) < 1e-13
 
 
+@pytest.mark.slow  # interpret-mode pallas: minutes-class on the 1-core CPU tier
 def test_stokeslet_pallas_df_f32_inputs():
     """f32 inputs pass through with zero lo words — still DF-accurate
     relative to the f64 evaluation of the same f32 points."""
@@ -83,6 +86,7 @@ def test_stokeslet_pallas_df_f32_inputs():
     assert np.linalg.norm(got - ref) / np.linalg.norm(ref) < 5e-13
 
 
+@pytest.mark.slow  # interpret-mode pallas: minutes-class on the 1-core CPU tier
 def test_stresslet_pallas_df_accuracy():
     r_dl = RNG.uniform(-3, 3, (300, 3))
     r_trg = np.concatenate([r_dl[:50], RNG.uniform(-3, 3, (100, 3))], axis=0)
@@ -110,6 +114,7 @@ def test_empty_and_seam_routing():
     assert np.linalg.norm(via_seam - ref) / np.linalg.norm(ref) < 5e-13
 
 
+@pytest.mark.slow  # interpret-mode pallas: minutes-class on the 1-core CPU tier
 def test_mixed_solver_accepts_pallas_df():
     """refine_pair_impl="pallas_df": the mixed solve converges to 1e-10 with
     the Pallas DF residual tiles (interpret mode on this CPU suite)."""
@@ -162,6 +167,7 @@ print("RESULT=" + json.dumps({
 
 
 @pytest.mark.tpu
+@pytest.mark.slow  # interpret-mode pallas: minutes-class on the 1-core CPU tier
 def test_tpu_agreement():
     """Mosaic-compiled DF tiles on the real chip: the hardware authority for
     the compensation surviving the TPU pipeline (the reference's 5e-9

@@ -12,7 +12,7 @@ import numpy as np
 
 from skellysim_tpu.fibers import container as fc
 from skellysim_tpu.params import Params
-from skellysim_tpu.parallel import make_mesh, shard_state
+from skellysim_tpu.parallel import make_mesh, shard_state, use_mesh
 from skellysim_tpu.periphery import periphery as peri
 from skellysim_tpu.periphery.precompute import precompute_periphery
 from skellysim_tpu.system import System
@@ -54,7 +54,7 @@ def test_sharded_shell_solve_matches_replicated():
     state = shard_state(_coupled_state(sys_sh, shell_data), mesh)
     # the dense operators really are distributed row-wise
     assert len(state.shell.M_inv.sharding.device_set) == N_DEV
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         s_sh, sol_sh, info_sh = sys_sh.step(state)
         jax.block_until_ready(sol_sh)
 
@@ -85,6 +85,43 @@ def test_indivisible_shell_rows_raise():
     # explicit opt-in replicates instead
     sharded = shard_state(state, mesh, allow_replicated_shell=True)
     assert len(sharded.shell.M_inv.sharding.device_set) in (1, N_DEV)
+
+
+def test_schema_placement_ignores_shape_collision():
+    """Placement is schema-driven off field names, not shapes: a shell
+    density whose length happens to equal a bucket's n_fibers must stay
+    replicated (the old shape-sniffing heuristic fiber-sharded any
+    [n_fibers]-long leaf, mis-sharding replicated shell vectors)."""
+    # 16-node shell -> density [48]; 48 fibers (divisible by the 8-mesh):
+    # the collision the old heuristic tripped on
+    from skellysim_tpu.fibers import container as fc
+    from skellysim_tpu.testing import make_coupled_parts
+
+    shell, shape, _ = make_coupled_parts(16, 50, jnp.float64)
+    params = Params(eta=1.0, dt_initial=1e-3, t_final=1e-2, gmres_tol=1e-10,
+                    adaptive_timestep_flag=False)
+    system = System(params, shell_shape=shape)
+    rng = np.random.default_rng(3)
+    nf, n_nodes = 48, 16
+    t = np.linspace(0, 1, n_nodes)
+    x = (rng.uniform(-1.5, 1.5, size=(nf, 3))[:, None, :]
+         + t[None, :, None] * np.array([0.0, 0.0, 1.0])[None, None, :])
+    fibers = fc.make_group(x, lengths=1.0, bending_rigidity=0.01,
+                           radius=0.0125, dtype=jnp.float64)
+    state = system.make_state(fibers=fibers, shell=shell)
+    assert state.shell.density.shape[0] == state.fibers.n_fibers  # collision
+
+    mesh = make_mesh(N_DEV)
+    sharded = shard_state(state, mesh)
+    # shell vectors replicate by schema regardless of the shape collision
+    assert len(sharded.shell.density.sharding.device_set) == 1 \
+        or sharded.shell.density.sharding.is_fully_replicated
+    assert sharded.shell.weights.sharding.is_fully_replicated
+    # the fiber bucket and the shell operator rows still shard
+    assert len(sharded.fibers.x.sharding.device_set) == N_DEV
+    assert not sharded.fibers.x.sharding.is_fully_replicated
+    assert len(sharded.shell.M_inv.sharding.device_set) == N_DEV
+    assert not sharded.shell.M_inv.sharding.is_fully_replicated
 
 
 def test_multihost_initialize_noop_single_process():
