@@ -25,6 +25,16 @@ echo "== lint: skelly-lint static analysis (dtype/trace/sharding) =="
 # the class of defect value-checking tests miss (commit 46b498b; docs/lint.md)
 JAX_PLATFORMS=cpu python -m skellysim_tpu.lint skellysim_tpu/
 
+echo "== audit: skelly-audit lowered-program contracts (docs/audit.md) =="
+# the compiled-program twin of the lint gate, in EVERY tier: every
+# registered entry point (single-chip step, step_spmd on 2/4/8-device
+# meshes, ensemble vmap step, bare GMRES) is traced + lowered and checked
+# against audit/contracts/*.toml — collective inventory (incl. the
+# density-bounded all-gather), dtype promotion edges, host callbacks,
+# donation markers, retrace budgets. Fails on any unsuppressed finding or
+# unused suppression. (Bootstraps its own 8-device CPU + x64 backend.)
+python -m skellysim_tpu.audit
+
 echo "== docs: config reference in sync with the schema =="
 JAX_PLATFORMS=cpu python scripts/gen_config_reference.py --check
 

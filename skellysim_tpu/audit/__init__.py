@@ -1,0 +1,36 @@
+"""skelly-audit: trace-time program auditor over lowered jaxprs/StableHLO.
+
+`skellysim_tpu.lint` polices the Python *source*; this package audits what
+the source actually *lowers to*. Every registered entry point (the
+single-chip implicit step, the explicitly-sharded `step_spmd` on 2/4/8
+device meshes, the vmapped ensemble step, the bare GMRES kernel) is traced
+and lowered, and the resulting program is checked against a per-program
+contract file (`audit/contracts/<name>.toml`):
+
+* ``collective-contract`` — the StableHLO collective inventory (op kind,
+  static count, operand/result element count and bytes) must match the
+  contract exactly; any collective the contract does not name is a finding.
+  This is the engine behind docs/parallel.md's collective table and the
+  GSPMD guardrail (no all-gather bigger than the shell density).
+* ``dtype-flow`` — `convert_element_type` promotion edges in the closed
+  jaxpr (narrow float -> wider float, and weak-typed float promotions) that
+  the AST linter cannot prove; the mixed-precision program pins its
+  deliberate refinement merges, everything else pins zero.
+* ``host-sync`` — `pure_callback` / `io_callback` / `debug_callback`
+  primitives reachable from the jitted program (each one is a device->host
+  round-trip per execution).
+* ``donation`` — input->output buffer aliasing markers present (or absent)
+  at lowering time, per contract.
+* ``retrace-budget`` — `testing.trace_counting_jit` pins the compile count
+  across same-structure calls of the entry point.
+
+CLI: ``python -m skellysim_tpu.audit [--list-checks] [--list-programs]
+[--program NAME] [--dump-contract NAME]`` — exit 0 only when every program
+is contract-clean (gated in ci/run_ci.sh after the lint tier). Deliberate
+deviations are suppressed in the contract file with a mandatory reason
+(``[[suppress]]``); unused suppressions are findings, mirroring
+skelly-lint's pragma discipline. docs/audit.md has the full write-up.
+"""
+
+from .engine import Finding, load_contract, run_program_audit  # noqa: F401
+from .registry import AuditProgram, BuiltProgram, built_from  # noqa: F401

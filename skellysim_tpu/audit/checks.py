@@ -1,0 +1,298 @@
+"""The audit checks: extractors over lowered artifacts + contract comparison.
+
+Each check is a pure function ``(program_name, built, contract, probe) ->
+[Finding]`` over the artifacts in `registry.BuiltProgram`. Extraction is
+deliberately split from comparison so ``--dump-contract`` can print the
+observed inventory in contract syntax (the sanctioned way to update a
+contract after a deliberate program change).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from .engine import Finding
+
+#: StableHLO collective ops audited (sans the `stablehlo.` prefix). Anything
+#: matching here that the contract does not name is an uncontracted
+#: collective — the GSPMD silent-resharding failure mode this layer exists
+#: to rule out.
+COLLECTIVE_OPS = ("all_reduce", "all_gather", "collective_permute",
+                  "all_to_all", "reduce_scatter", "collective_broadcast")
+
+_OP_RE = re.compile(
+    r'"?stablehlo\.(%s)"?\(' % "|".join(COLLECTIVE_OPS))
+#: the op's function-type signature: `... : (operand types) -> results`,
+#: preceded by the attr-dict close (`}> : (...) ->`, inline ops) or the
+#: region close (`}) : (...) ->`, all_reduce/reduce_scatter) or a bare
+#: operand-list close (`) : (`). It is the first `: (` after the op head —
+#: attr dicts and region bodies only contain value-typed colons
+#: (`0 : i64`, `: tensor<f64>`), never `: (`.
+_SIG_RE = re.compile(r"[)>]\s*:\s*\(([^)]*)\)\s*->\s*([^\n]*)")
+_TENSOR_RE = re.compile(r"tensor<([0-9x]*)x?(f|bf|i|ui|c)([0-9]+)>")
+
+
+def _tensor_elems_bytes(type_list: str):
+    """[(elems, bytes)] for every tensor type in a signature fragment."""
+    out = []
+    for dims, kind, bits in _TENSOR_RE.findall(type_list):
+        elems = 1
+        for d in dims.split("x"):
+            if d:
+                elems *= int(d)
+        width = int(bits) * (2 if kind == "c" else 1)
+        out.append((elems, max(1, width // 8) * elems))
+    return out
+
+
+@dataclass(frozen=True)
+class CollectiveSite:
+    op: str
+    max_elems: int    # largest tensor (operand or result) at the site
+    max_bytes: int
+
+
+def collective_inventory(lowered_text: str):
+    """Every collective site in the StableHLO text, in program order."""
+    sites = []
+    for m in _OP_RE.finditer(lowered_text):
+        window = lowered_text[m.start():m.start() + 6000]
+        sig = _SIG_RE.search(window)
+        tensors = _tensor_elems_bytes(
+            f"{sig.group(1)} {sig.group(2)}") if sig else []
+        sites.append(CollectiveSite(
+            op=m.group(1),
+            max_elems=max((e for e, _ in tensors), default=0),
+            max_bytes=max((b for _, b in tensors), default=0)))
+    return sites
+
+
+def _subjaxprs(params):
+    for v in params.values():
+        for item in (v if isinstance(v, (list, tuple)) else [v]):
+            if hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                yield item.jaxpr           # ClosedJaxpr
+            elif hasattr(item, "eqns"):
+                yield item                 # raw Jaxpr
+
+
+def walk_eqns(jaxpr):
+    """Every equation in ``jaxpr`` and its sub-jaxprs (while/cond/scan/
+    shard_map/... bodies), statically — one visit per program-text site."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _subjaxprs(eqn.params):
+            yield from walk_eqns(sub)
+
+
+_FLOAT_WIDTH = {"bfloat16": 16, "float16": 16, "float32": 32, "float64": 64}
+
+
+def dtype_flow(closed_jaxpr):
+    """(promotions, weak_promotions): ``promotions`` maps
+    "float32->float64"-style edges to their static site count;
+    ``weak_promotions`` counts converts whose *weak-typed float* operand
+    widens — the Python-literal promotion family the AST cannot see."""
+    promotions = {}
+    weak = {}
+    for eqn in walk_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = eqn.invars[0].aval
+        dst = eqn.outvars[0].aval
+        sw = _FLOAT_WIDTH.get(str(src.dtype))
+        dw = _FLOAT_WIDTH.get(str(dst.dtype))
+        if sw is None or dw is None or dw <= sw:
+            continue
+        edge = f"{src.dtype}->{dst.dtype}"
+        if getattr(src, "weak_type", False):
+            weak[edge] = weak.get(edge, 0) + 1
+        else:
+            promotions[edge] = promotions.get(edge, 0) + 1
+    return promotions, weak
+
+
+def callback_inventory(closed_jaxpr):
+    """Host-callback primitive -> static site count (each site is a
+    device->host round-trip per execution of its enclosing region)."""
+    out = {}
+    for eqn in walk_eqns(closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if "callback" in name or name in ("infeed", "outfeed"):
+            out[name] = out.get(name, 0) + 1
+    return out
+
+
+DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
+
+
+# ------------------------------------------------------------------ checks
+
+def check_collective_contract(name, built, contract, probe):
+    out = []
+    cid = "collective-contract"
+    want = dict(contract.get("collectives", {}))
+    sites = collective_inventory(built.lowered_text)
+    by_op = {}
+    for s in sites:
+        by_op.setdefault(s.op, []).append(s)
+    for op, op_sites in sorted(by_op.items()):
+        spec = want.pop(op, None)
+        if spec is None:
+            out.append(Finding(name, cid, (
+                f"uncontracted collective: {len(op_sites)} "
+                f"stablehlo.{op} site(s) in the lowered program but the "
+                f"contract has no [collectives.{op}] entry")))
+            continue
+        count = spec.get("count")
+        if count is None:
+            # a bound-only entry would rot silently once the op vanishes
+            # (no count gate, no stale gate) — the count pin is mandatory
+            out.append(Finding(name, cid, (
+                f"[collectives.{op}] has no `count` pin — every "
+                "contracted collective must pin its static count")))
+        elif count != len(op_sites):
+            out.append(Finding(name, cid, (
+                f"{op} count drifted: contract pins {count}, lowered "
+                f"program has {len(op_sites)}")))
+        max_elems = spec.get("max_elems")
+        max_bytes = spec.get("max_bytes")
+        for s in op_sites:
+            if max_elems is not None and s.max_elems > max_elems:
+                out.append(Finding(name, cid, (
+                    f"{op} carries {s.max_elems} elements, over the "
+                    f"contract bound of {max_elems} — an unexpected "
+                    "operand is crossing the mesh")))
+            if max_bytes is not None and s.max_bytes > max_bytes:
+                out.append(Finding(name, cid, (
+                    f"{op} moves {s.max_bytes} bytes, over the contract "
+                    f"bound of {max_bytes}")))
+    for op, spec in sorted(want.items()):
+        if spec.get("count", 1) != 0:
+            out.append(Finding(name, cid, (
+                f"stale contract: [collectives.{op}] pins count="
+                f"{spec.get('count')} but the lowered program has none")))
+    return out
+
+
+def check_dtype_flow(name, built, contract, probe):
+    out = []
+    cid = "dtype-flow"
+    spec = contract.get("dtype", {})
+    allowed = dict(spec.get("promotions", {}))
+    promotions, weak = dtype_flow(built.closed_jaxpr)
+    for edge, n in sorted(promotions.items()):
+        pinned = allowed.pop(edge, None)
+        if pinned is None:
+            out.append(Finding(name, cid, (
+                f"{n} {edge} promotion site(s): a narrow float widens on "
+                "the traced path with no [dtype.promotions] entry — the "
+                "46b498b leak family, now visible at the jaxpr level")))
+        elif pinned != n:
+            out.append(Finding(name, cid, (
+                f"{edge} promotion count drifted: contract pins {pinned}, "
+                f"jaxpr has {n}")))
+    for edge, pinned in sorted(allowed.items()):
+        out.append(Finding(name, cid, (
+            f"stale contract: [dtype.promotions] pins {edge} = {pinned} "
+            "but the jaxpr has no such edge")))
+    for edge, n in sorted(weak.items()):
+        out.append(Finding(name, cid, (
+            f"{n} weak-typed {edge} promotion site(s): a Python float "
+            "literal is widening traced data (pin the literal's dtype at "
+            "the site)")))
+    return out
+
+
+def check_host_sync(name, built, contract, probe):
+    out = []
+    cid = "host-sync"
+    allowed = set(contract.get("host_sync", {}).get("allowed_callbacks", []))
+    found = callback_inventory(built.closed_jaxpr)
+    for prim, n in sorted(found.items()):
+        if prim in allowed:
+            allowed.discard(prim)
+        else:
+            out.append(Finding(name, cid, (
+                f"{n} {prim} site(s) inside the jitted program: each is a "
+                "host round-trip per execution (and a tracer sync point); "
+                "hoist it out of the step or allow it in the contract "
+                "with a reason")))
+    for prim in sorted(allowed):
+        out.append(Finding(name, cid, (
+            f"stale contract: host_sync allows {prim!r} but the program "
+            "has no such callback")))
+    return out
+
+
+def check_donation(name, built, contract, probe):
+    spec = contract.get("donation")
+    if spec is None:
+        return []
+    cid = "donation"
+    marked = any(m in built.lowered_text for m in DONATION_MARKERS)
+    if spec.get("donated") and not marked:
+        return [Finding(name, cid, (
+            "contract says the input buffers are donated but the lowered "
+            "program carries no aliasing marker "
+            f"({' / '.join(DONATION_MARKERS)}) — every step double-buffers "
+            "the pass-through leaves"))]
+    if not spec.get("donated") and marked:
+        return [Finding(name, cid, (
+            "contract says NO donation (rollback safety) but the lowered "
+            "program aliases its inputs — a rejected step would roll back "
+            "into consumed buffers"))]
+    return []
+
+
+def check_retrace_budget(name, built, contract, probe):
+    spec = contract.get("retrace")
+    if spec is None:
+        return []
+    cid = "retrace-budget"
+    if probe is None:
+        return [Finding(name, cid, (
+            "contract has a [retrace] budget but the program registers no "
+            "retrace probe — drop the section or register one"))]
+    budget = spec.get("max_traces", 1)
+    traces = probe()
+    if traces > budget:
+        return [Finding(name, cid, (
+            f"entry point traced {traces}x across same-structure calls "
+            f"(budget {budget}): some argument's static signature varies "
+            "call-to-call, paying full XLA compilation on the hot path"))]
+    return []
+
+
+@dataclass(frozen=True)
+class Check:
+    id: str
+    summary: str
+    run: object  # callable(name, built, contract, probe) -> [Finding]
+    #: needs the (possibly expensive) retrace probe instead of artifacts
+    wants_probe: bool = False
+
+
+CHECKS = (
+    Check("collective-contract",
+          "StableHLO collective inventory (kind/count/operand size) must "
+          "match the per-program contract exactly",
+          check_collective_contract),
+    Check("dtype-flow",
+          "convert_element_type promotion edges and weak-typed float "
+          "widenings in the closed jaxpr vs the contract",
+          check_dtype_flow),
+    Check("host-sync",
+          "pure_callback/io_callback/debug_callback (and in/outfeed) "
+          "primitives inside the jitted program",
+          check_host_sync),
+    Check("donation",
+          "input->output buffer aliasing markers at lowering time match "
+          "the contract's donated flag",
+          check_donation),
+    Check("retrace-budget",
+          "trace_counting_jit compile count across same-structure calls "
+          "stays within the contract budget",
+          check_retrace_budget, wants_probe=True),
+)

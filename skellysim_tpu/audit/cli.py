@@ -1,0 +1,115 @@
+"""skelly-audit CLI: `python -m skellysim_tpu.audit [--program NAME]`.
+
+Exit status mirrors skelly-lint so CI gates on it directly: 0 when every
+audited program is contract-clean, 1 when any unsuppressed finding remains,
+2 on usage errors.
+
+The auditor needs the same backend environment as the test suite — an
+8-device virtual CPU platform (the SPMD programs lower on 2/4/8 sub-meshes)
+with x64 enabled (the contracts pin f64 inventories) — and sets it up
+itself before any jax-importing module loads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _bootstrap_backend():
+    from ..utils.bootstrap import force_cpu_devices
+
+    force_cpu_devices(8)
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m skellysim_tpu.audit",
+        description="Trace-time program auditor: lowered-jaxpr/StableHLO "
+                    "contracts for collectives, dtype flow, host syncs, "
+                    "donation, and retrace budgets (see docs/audit.md).")
+    parser.add_argument("--program", action="append", default=None,
+                        metavar="NAME",
+                        help="audit only this program (repeatable)")
+    parser.add_argument("--check", action="append", default=None,
+                        metavar="CHECK-ID",
+                        help="run only this check (repeatable)")
+    parser.add_argument("--list-checks", action="store_true",
+                        help="print every check id with its summary and exit")
+    parser.add_argument("--list-programs", action="store_true",
+                        help="print every registered program and exit")
+    parser.add_argument("--dump-contract", metavar="NAME",
+                        help="print NAME's observed inventory as contract "
+                             "TOML (the starting point for a deliberate "
+                             "contract update) and exit")
+    args = parser.parse_args(argv)
+
+    from .checks import CHECKS
+
+    if args.list_checks:
+        width = max(len(c.id) for c in CHECKS)
+        for c in CHECKS:
+            print(f"{c.id:<{width}}  {c.summary}")
+        return 0
+    if args.check:
+        known = {c.id for c in CHECKS}
+        unknown = [c for c in args.check if c not in known]
+        if unknown:
+            print(f"skelly-audit: unknown check id(s): "
+                  f"{', '.join(unknown)} (try --list-checks)",
+                  file=sys.stderr)
+            return 2
+
+    _bootstrap_backend()
+    from .engine import run_program_audit
+    from .programs import all_programs
+
+    progs = all_programs()
+    if args.list_programs:
+        width = max(len(p.name) for p in progs)
+        for p in progs:
+            print(f"{p.name:<{width}}  [{p.layer}] {p.summary}")
+        return 0
+
+    if args.dump_contract:
+        from .engine import dump_contract
+
+        try:
+            prog = next(p for p in progs if p.name == args.dump_contract)
+        except StopIteration:
+            print(f"skelly-audit: unknown program {args.dump_contract!r} "
+                  f"(try --list-programs)", file=sys.stderr)
+            return 2
+        print(dump_contract(prog), end="")
+        return 0
+
+    if args.program:
+        known = {p.name for p in progs}
+        unknown = [n for n in args.program if n not in known]
+        if unknown:
+            print(f"skelly-audit: unknown program(s): "
+                  f"{', '.join(unknown)} (try --list-programs)",
+                  file=sys.stderr)
+            return 2
+        progs = [p for p in progs if p.name in set(args.program)]
+
+    findings = []
+    for prog in progs:
+        findings.extend(run_program_audit(prog, checks=args.check))
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"skelly-audit: {len(findings)} finding(s) across "
+              f"{len(progs)} program(s). Fix the program, or record the "
+              "deliberate change in its audit/contracts/<name>.toml "
+              "(docs/audit.md).", file=sys.stderr)
+        return 1
+    print(f"skelly-audit: {len(progs)} program(s) contract-clean.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

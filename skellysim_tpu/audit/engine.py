@@ -1,0 +1,179 @@
+"""Contract loading, suppression discipline, and the per-program driver.
+
+A contract (`audit/contracts/<program>.toml`) is the machine-readable twin
+of docs/parallel.md's collective table: it pins what the lowered program is
+allowed to look like. Deviations are findings; deliberate deviations are
+suppressed *in the contract file* with a mandatory reason::
+
+    [[suppress]]
+    check = "dtype-flow"
+    match = "float32->float64"
+    reason = "refinement merges the f64 correction back into the f32 basis"
+
+mirroring skelly-lint's pragma discipline: a suppression that matches no
+finding is itself a finding, so every entry stays load-bearing.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from ..config import toml_io
+
+CONTRACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "contracts")
+
+#: contract sections the engine understands; anything else is drift (a
+#: typo'd section would otherwise silently stop gating)
+_KNOWN_SECTIONS = ("program", "collectives", "dtype", "host_sync",
+                   "donation", "retrace", "suppress")
+
+
+@dataclass(frozen=True)
+class Finding:
+    program: str
+    check: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.program}: {self.check}: {self.message}"
+
+
+def contract_path(name: str) -> str:
+    return os.path.join(CONTRACT_DIR, f"{name}.toml")
+
+
+def load_contract(name: str):
+    """(contract dict, [Finding]) — findings for missing/invalid files."""
+    path = contract_path(name)
+    if not os.path.exists(path):
+        return None, [Finding(name, "contract", (
+            f"no contract file at audit/contracts/{name}.toml — every "
+            "registered program must pin its lowered shape (run "
+            f"`python -m skellysim_tpu.audit --dump-contract {name}` for "
+            "the observed inventory)"))]
+    data = toml_io.load(path)
+    out = []
+    for key in data:
+        if key not in _KNOWN_SECTIONS:
+            out.append(Finding(name, "contract", (
+                f"unknown contract section [{key}] (known: "
+                f"{', '.join(_KNOWN_SECTIONS)}) — a typo here would "
+                "silently stop gating")))
+    declared = data.get("program", {}).get("name")
+    if declared is not None and declared != name:
+        out.append(Finding(name, "contract", (
+            f"contract file {name}.toml declares program.name="
+            f"{declared!r} — copy-paste drift")))
+    for i, sup in enumerate(data.get("suppress", [])):
+        if not sup.get("check") or not sup.get("match"):
+            # an EMPTY match would substring-match every finding of the
+            # check — a blanket suppression must not be expressible
+            out.append(Finding(name, "contract", (
+                f"suppress entry #{i + 1} needs both `check` and a "
+                "non-empty `match`")))
+        if not sup.get("reason"):
+            out.append(Finding(name, "contract", (
+                f"suppress entry #{i + 1} is missing its reason: every "
+                "suppression must say why")))
+    return data, out
+
+
+def apply_suppressions(name, contract, findings, active_checks=None):
+    """Filter ``findings`` through the contract's ``[[suppress]]`` entries;
+    unused entries become findings (the lint-pragma rule, contract-side).
+
+    ``active_checks`` limits the unused-suppression enforcement: a
+    ``--check``-filtered run must not flag entries for checks it skipped
+    (same rule as the lint engine's filtered-pragma behavior).
+    """
+    entries = [dict(e, used=False) for e in (contract or {}).get(
+        "suppress", [])]
+    kept = []
+    for f in findings:
+        hit = False
+        for e in entries:
+            if (e.get("check") == f.check and e.get("reason")
+                    and e.get("match") and e["match"] in f.message):
+                e["used"] = True
+                hit = True
+        if not hit:
+            kept.append(f)
+    for e in entries:
+        if (not e["used"] and e.get("reason") and e.get("check")
+                and (active_checks is None or e["check"] in active_checks)):
+            kept.append(Finding(name, "contract", (
+                f"unused suppression (check={e['check']!r}, "
+                f"match={e.get('match')!r}): it matches no finding — "
+                "remove it or it hides the next real one")))
+    return kept
+
+
+def run_program_audit(prog, contract=None, checks=None):
+    """Audit one registered `AuditProgram`; returns unsuppressed findings.
+
+    ``contract=None`` loads the program's file from `CONTRACT_DIR` (tests
+    pass a dict directly to exercise drift/suppression paths without
+    touching the tree's contracts).
+    """
+    from .checks import CHECKS
+
+    if contract is None:
+        contract, findings = load_contract(prog.name)
+        if contract is None:
+            return findings
+    else:
+        findings = []
+    active_ids = (None if checks is None
+                  else {c.id for c in CHECKS if c.id in set(checks)})
+    try:
+        built = prog.build()
+    except Exception as e:  # a program that no longer lowers IS the finding
+        findings.append(Finding(prog.name, "build", (
+            f"entry point failed to trace/lower: {type(e).__name__}: {e}")))
+        return apply_suppressions(prog.name, contract, findings, active_ids)
+    active = CHECKS if checks is None else tuple(
+        c for c in CHECKS if c.id in set(checks))
+    for check in active:
+        probe = prog.retrace_probe if check.wants_probe else None
+        findings.extend(check.run(prog.name, built, contract, probe))
+    return apply_suppressions(prog.name, contract, findings, active_ids)
+
+
+def dump_contract(prog) -> str:
+    """The observed inventory of ``prog`` in contract TOML — the starting
+    point for writing (or deliberately updating) its contract file."""
+    from .checks import callback_inventory, collective_inventory, dtype_flow
+
+    built = prog.build()
+    sites = collective_inventory(built.lowered_text)
+    by_op = {}
+    for s in sites:
+        spec = by_op.setdefault(s.op, {"count": 0, "max_elems": 0,
+                                       "max_bytes": 0})
+        spec["count"] += 1
+        spec["max_elems"] = max(spec["max_elems"], s.max_elems)
+        spec["max_bytes"] = max(spec["max_bytes"], s.max_bytes)
+    promotions, weak = dtype_flow(built.closed_jaxpr)
+    callbacks = callback_inventory(built.closed_jaxpr)
+    from .checks import DONATION_MARKERS
+
+    data = {"program": {"name": prog.name}}
+    if by_op:
+        data["collectives"] = {op: spec for op, spec in sorted(by_op.items())}
+    if promotions:
+        data["dtype"] = {"promotions": dict(sorted(promotions.items()))}
+    if callbacks:
+        data["host_sync"] = {"allowed_callbacks": sorted(callbacks)}
+    data["donation"] = {"donated": any(m in built.lowered_text
+                                       for m in DONATION_MARKERS)}
+    if prog.retrace_probe is not None:
+        data["retrace"] = {"max_traces": 1}
+    text = toml_io.dumps(data)
+    if weak:
+        text += ("\n# NOTE: weak-typed promotions observed (always findings;"
+                 " fix or suppress):\n")
+        for edge, n in sorted(weak.items()):
+            text += f"#   {edge} x{n}\n"
+    return text

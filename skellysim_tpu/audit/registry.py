@@ -1,0 +1,59 @@
+"""Auditable-program registration seam.
+
+Each layer that owns a jit entry point (`system.system`, `parallel.spmd`,
+`ensemble.runner`, `solver.gmres`) exposes a small ``auditable_programs()``
+returning `AuditProgram`s; `audit.programs.all_programs` aggregates them.
+The layer declares *what* to lower (it knows its own entry points and their
+fixtures); the audit engine owns *how* the lowered artifacts are checked.
+
+Keeping this module import-light matters: layer modules import it lazily
+inside their ``auditable_programs()`` so the audit package never becomes an
+import-time dependency of the simulation stack.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass
+class BuiltProgram:
+    """The two lowering artifacts every check consumes.
+
+    ``closed_jaxpr`` is the traced `jax.core.ClosedJaxpr` (dtype-flow and
+    host-sync walk its equations recursively); ``lowered_text`` is the
+    StableHLO module text (collective inventory and donation markers — the
+    program XLA actually receives, including the shard_map lowering the
+    jaxpr only names symbolically).
+    """
+
+    closed_jaxpr: object
+    lowered_text: str
+
+
+@dataclass
+class AuditProgram:
+    """One registered entry point.
+
+    ``build()`` assembles the fixture scene, traces, and lowers — called
+    lazily so ``--list-programs`` and single-program runs never pay for the
+    rest of the matrix. ``retrace_probe()``, when provided, runs the entry
+    point twice with same-structure/different-value arguments through
+    `testing.trace_counting_jit` and returns the trace count (the
+    ``retrace-budget`` check compares it against the contract).
+    """
+
+    name: str
+    layer: str                      # system | parallel | ensemble | solver
+    summary: str
+    build: Callable[[], BuiltProgram]
+    retrace_probe: Callable[[], int] | None = None
+
+
+def built_from(jitted, *args, **kwargs) -> BuiltProgram:
+    """Trace + lower a `jax.jit`-wrapped callable once, capturing both
+    artifacts from the same trace (no double tracing)."""
+    traced = jitted.trace(*args, **kwargs)
+    return BuiltProgram(closed_jaxpr=traced.jaxpr,
+                        lowered_text=traced.lower().as_text())

@@ -46,6 +46,14 @@ def _format_value(v: Any) -> str:
     return _format_scalar(v)
 
 
+def _format_key(k: str) -> str:
+    """Bare keys are [A-Za-z0-9_-]+ in TOML; anything else (the audit
+    contracts' "float32->float64" promotion edges) must be quoted."""
+    if k and all(c.isascii() and (c.isalnum() or c in "_-") for c in k):
+        return k
+    return _format_scalar(str(k))
+
+
 def _is_table(v: Any) -> bool:
     return isinstance(v, dict)
 
@@ -59,16 +67,16 @@ def _emit_table(out: list[str], table: dict, prefix: str) -> None:
     scalars = {k: v for k, v in table.items()
                if not _is_table(v) and not _is_table_array(v)}
     for k, v in scalars.items():
-        out.append(f"{k} = {_format_value(v)}")
+        out.append(f"{_format_key(k)} = {_format_value(v)}")
     for k, v in table.items():
         if _is_table(v):
-            name = f"{prefix}{k}"
+            name = f"{prefix}{_format_key(k)}"
             out.append("")
             out.append(f"[{name}]")
             _emit_table(out, v, name + ".")
     for k, v in table.items():
         if _is_table_array(v):
-            name = f"{prefix}{k}"
+            name = f"{prefix}{_format_key(k)}"
             for item in v:
                 out.append("")
                 out.append(f"[[{name}]]")

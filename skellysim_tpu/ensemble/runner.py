@@ -271,3 +271,49 @@ class EnsembleRunner:
     def step(self, ens: EnsembleState):
         """One compiled batched trial step (same signature as `step_impl`)."""
         return self._step_jit(ens)
+
+
+# ---------------------------------------------------------------- skelly-audit
+
+def auditable_programs():
+    """The ensemble layer's audit entry: the vmapped batched trial step
+    over B=4 free-fiber members. Pins that batching stays collective-free
+    and callback-free (members are independent rows) and that the scheduler
+    can swap member leaves without retracing (the continuous-batching
+    invariant `tests/test_ensemble.py` relies on)."""
+    from ..audit import fixtures
+    from ..audit.registry import AuditProgram, built_from
+
+    def make_runner_and_ensemble(n_fibers=4, n_nodes=8):
+        system = fixtures.make_system()
+        runner = EnsembleRunner(system)
+        import jax.numpy as jnp
+
+        from ..system import BackgroundFlow
+
+        states = [system.make_state(
+            fibers=fixtures.make_fibers(n_fibers=n_fibers, n_nodes=n_nodes,
+                                        seed=i),
+            background=BackgroundFlow.make(uniform=(1.0, 0.0, 0.0),
+                                           dtype=jnp.float64))
+            for i in range(4)]
+        return runner, runner.make_ensemble(states, [1e-2] * 4)
+
+    def build():
+        runner, ens = make_runner_and_ensemble()
+        return built_from(runner._step_jit, ens)
+
+    def retrace_probe():
+        from ..testing import trace_counting_jit
+
+        runner, ens = make_runner_and_ensemble()
+        step = trace_counting_jit(runner.step_impl)
+        new_ens, _ = step(ens)
+        step(new_ens)  # same lane structure, new values: must not retrace
+        return step.trace_count
+
+    return [AuditProgram(
+        name="ensemble_step", layer="ensemble",
+        summary="vmapped batched trial step (B=4 free-fiber members, "
+                "masked per-member accept ladder)",
+        build=build, retrace_probe=retrace_probe)]

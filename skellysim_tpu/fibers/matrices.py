@@ -45,7 +45,7 @@ class FibMats:
     weights0: np.ndarray       # [n] trapezoid weights on [-1, 1]
 
 
-def _cast_mats(m: FibMats, dtype_name: str) -> FibMats:  # skelly-lint: ignore-function[trace-hygiene] — casts host NumPy FibMats constants with a static dtype name; runs at trace time by design (module docstring)
+def _cast_mats(m: FibMats, dtype_name: str) -> FibMats:  # skelly-lint: ignore-function[host-sync] — casts host NumPy FibMats constants (never traced values) with a static dtype name; runs at trace time by design (module docstring)
     def c(a):
         return np.asarray(a, dtype=dtype_name)
 

@@ -8,11 +8,16 @@ write-ups and the pragma syntax):
   commit 46b498b (a silent f64 flow promoting the whole Krylov pipeline)
   and the round-2 FibMats leak (f64 constants promoting f32 states until
   TPU's f32-only LU fell off the device).
-* ``trace-hygiene`` — host syncs and concretizations inside jit-traced
-  code: ``float()``/``int()``/``bool()``/``.item()``/``np.*`` on traced
-  values abort tracing or silently bake run-time values into the compiled
-  program; ``block_until_ready``/``device_get`` in hot-path modules stall
-  the device pipeline mid-solve.
+* ``trace-hygiene`` — concretizations inside jit-traced code:
+  ``bool()``/``np.*`` on traced values abort tracing or silently bake
+  run-time values into the compiled program; ``block_until_ready``/
+  ``device_get`` in hot-path modules stall the device pipeline mid-solve.
+* ``host-sync`` — the device->host transfer family inside jit-reachable
+  code: ``.item()``, ``float()``/``int()``, and ``np.asarray``/``np.array``
+  applied to traced values. Under jit these abort tracing; in eager
+  callers of the same helpers they silently serialize the pipeline one
+  scalar at a time. The runtime companion (`skellysim_tpu.audit`'s
+  host-sync check) catches the callback-based variants the AST cannot see.
 * ``sharding-annotation`` — ``shard_map`` without explicit
   ``in_specs``/``out_specs`` (or ``device_put`` in ``parallel/`` without an
   explicit sharding) silently replicates operands: the expected O(N/D)
@@ -225,6 +230,17 @@ def _shape_like(node) -> bool:
     return False
 
 
+def _literal_payload(node) -> bool:
+    """Payloads that are host constants at trace time (literals, possibly
+    nested in lists/tuples, or shape arithmetic) — `np.asarray` of these
+    freezes a constant rather than syncing a traced value."""
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_literal_payload(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _literal_payload(node.operand)
+    return isinstance(node, ast.Constant) or _shape_like(node)
+
+
 def check_trace_hygiene(mod: ModuleInfo, ctx: RepoContext):
     out = []
     rid = "trace-hygiene"
@@ -237,23 +253,22 @@ def check_trace_hygiene(mod: ModuleInfo, ctx: RepoContext):
             if not isinstance(node, ast.Call):
                 continue
             fn = node.func
-            if (isinstance(fn, ast.Name) and fn.id in ("float", "int", "bool")
+            if (isinstance(fn, ast.Name) and fn.id == "bool"
                     and fn.id not in shadowed and node.args
                     and not _shape_like(node.args[0])):
                 out.append(Finding(
                     mod.path, node.lineno, node.col_offset, rid,
-                    f"{fn.id}() inside jit-reachable `{qualname}` "
+                    f"bool() inside jit-reachable `{qualname}` "
                     "concretizes its operand: a traced value here aborts "
                     "tracing (or silently bakes in a host constant)"))
-            elif isinstance(fn, ast.Attribute) and fn.attr == "item":
-                out.append(Finding(
-                    mod.path, node.lineno, node.col_offset, rid,
-                    f".item() inside jit-reachable `{qualname}` forces a "
-                    "device->host sync per call"))
             elif (isinstance(fn, ast.Attribute)
                   and isinstance(fn.value, ast.Name)
                   and fn.value.id in np_names
-                  and fn.attr not in NP_TRACE_SAFE):
+                  and fn.attr not in NP_TRACE_SAFE
+                  and not (fn.attr in NP_SYNC_CALLS and node.args
+                           and not _literal_payload(node.args[0]))):
+                # asarray/array of a NON-literal payload is host-sync's
+                # (a device->host transfer, not a frozen constant)
                 out.append(Finding(
                     mod.path, node.lineno, node.col_offset, rid,
                     f"np.{fn.attr}() inside jit-reachable `{qualname}` "
@@ -280,6 +295,63 @@ def check_trace_hygiene(mod: ModuleInfo, ctx: RepoContext):
                     f"{name} in a hot-path module stalls the device "
                     "pipeline; fetch results once per step at the loop "
                     "boundary instead"))
+    return out
+
+
+# ------------------------------------------------------- rule: host-sync
+
+#: np calls that force a device->host transfer when their payload is a
+#: traced value (not a literal/shape constant)
+NP_SYNC_CALLS = ("asarray", "array")
+
+
+def check_host_sync(mod: ModuleInfo, ctx: RepoContext):
+    """Device->host transfers at trace time inside jit-reachable code.
+
+    `.item()`, `float()`/`int()`, and `np.asarray`/`np.array` on a traced
+    value abort tracing under jit; reached from an eager caller they
+    silently sync the device pipeline one value at a time (the per-scalar
+    transfer stall SURVEY §5.8 charges against the reference's host loop).
+    The lowered-program twin is `skellysim_tpu.audit`'s host-sync check,
+    which catches the callback-based syncs no source pattern reveals.
+    """
+    out = []
+    rid = "host-sync"
+    np_names = mod.np_aliases
+    shadowed = set(mod.from_imports) | set(mod.import_aliases)
+
+    for qual, fi in mod.functions.items():
+        if not ctx.is_reachable(mod, qual):
+            continue
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (isinstance(fn, ast.Name) and fn.id in ("float", "int")
+                    and fn.id not in shadowed and node.args
+                    and not _shape_like(node.args[0])):
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, rid,
+                    f"{fn.id}() inside jit-reachable `{qual}` pulls its "
+                    "operand to host: a traced value here aborts tracing; "
+                    "an eager caller syncs the pipeline per scalar"))
+            elif isinstance(fn, ast.Attribute) and fn.attr == "item":
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, rid,
+                    f".item() inside jit-reachable `{qual}` forces a "
+                    "device->host sync per call"))
+            elif (isinstance(fn, ast.Attribute)
+                  and isinstance(fn.value, ast.Name)
+                  and fn.value.id in np_names
+                  and fn.attr in NP_SYNC_CALLS and node.args
+                  and not _literal_payload(node.args[0])):
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, rid,
+                    f"np.{fn.attr}() of a non-literal payload inside "
+                    f"jit-reachable `{qual}` transfers the value to host "
+                    "(aborts tracing under jit; serializes the device "
+                    "pipeline in eager callers) — use jnp.asarray, or "
+                    "fetch once at the loop boundary"))
     return out
 
 
@@ -323,9 +395,13 @@ RULES = (
          "in hot-path code (the 46b498b weak-type leak family)",
          check_dtype_discipline),
     Rule("trace-hygiene",
-         "float()/int()/bool()/.item()/np.* inside jit-reachable functions; "
+         "bool()/np.* concretizations inside jit-reachable functions; "
          "block_until_ready/device_get in hot-path modules",
          check_trace_hygiene),
+    Rule("host-sync",
+         ".item()/float()/int()/np.asarray on traced values in "
+         "jit-reachable code (device->host transfer at trace time)",
+         check_host_sync),
     Rule("sharding-annotation",
          "shard_map without explicit in_specs/out_specs; device_put in "
          "parallel/ without an explicit sharding",

@@ -90,7 +90,7 @@ def _quick_two_sum(a, b):
 def _split_factor(dtype):
     # 2^ceil(p/2) + 1: 4097 for f32 (p=24), 134217729 for f64 (p=53)
     bits = jnp.finfo(dtype).nmant + 1
-    return float(2 ** math.ceil(bits / 2) + 1)  # skelly-lint: ignore[trace-hygiene] — Python-int mantissa arithmetic on a static dtype, never a traced value
+    return float(2 ** math.ceil(bits / 2) + 1)  # skelly-lint: ignore[host-sync] — Python-int mantissa arithmetic on a static dtype, never a traced value
 
 
 def _two_prod(a, b):

@@ -151,7 +151,8 @@ def _make_rdot(axis: str, nonrep_end: int) -> Callable:
 
 def build_spmd_step(system, mesh: Mesh, state: SimState, *,
                     allow_replicated_shell: bool = False,
-                    flat_solution: bool = True, donate: str | bool = "auto"):
+                    flat_solution: bool = True, donate: str | bool = "auto",
+                    jit_wrapper=None):
     """Build the jitted explicitly-sharded full step for states shaped like
     ``state``. Returns ``step(state) -> (new_state, solution, info)`` with
     ``new_state`` still sharded on ``mesh``.
@@ -164,6 +165,9 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
     shell operators above all — instead of double-buffering them); rejected
     adaptive steps must not reuse a donated input, so callers that roll
     back pass ``donate=False``.
+
+    ``jit_wrapper`` replaces the final `jax.jit` (same kwargs) — the
+    audit layer's retrace-probe seam (`testing.trace_counting_jit`).
     """
     p = system.params
     axis = FIBER_AXIS
@@ -665,7 +669,8 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
     if donate == "auto":
         # CPU XLA has no buffer donation — jit would warn on every call
         donate = jax.default_backend() != "cpu"
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    wrap = jax.jit if jit_wrapper is None else jit_wrapper
+    return wrap(step, donate_argnums=(0,) if donate else ())
 
 
 def spmd_step(system, state: SimState, mesh: Mesh, *,
@@ -680,3 +685,51 @@ def spmd_step(system, state: SimState, mesh: Mesh, *,
                          allow_replicated_shell=allow_replicated_shell,
                          flat_solution=flat_solution, donate=False)
     return fn(state)
+
+
+# ---------------------------------------------------------------- skelly-audit
+
+def auditable_programs():
+    """The SPMD scaling ladder's audit entries: the coupled explicitly-
+    sharded step lowered on 2/4/8-device CPU meshes. The contracts pin the
+    collective inventory of docs/parallel.md's table per mesh size —
+    including the bound that no all-gather ever exceeds the shell density
+    (the GSPMD silent-replication failure mode). The d2 program also runs
+    the retrace probe (d4/d8 would re-pay the same compile for no new
+    signal)."""
+    from ..audit import fixtures
+    from ..audit.registry import AuditProgram, built_from
+    from . import shard_state
+    from .mesh import make_mesh
+
+    def build(n_dev):
+        def _build():
+            mesh = make_mesh(n_dev)
+            system = fixtures.make_system(shell=True)
+            state = shard_state(fixtures.coupled_state(system), mesh)
+            fn = build_spmd_step(system, mesh, state, flat_solution=False,
+                                 donate=True)
+            return built_from(fn, state)
+        return _build
+
+    def retrace_probe():
+        from ..testing import trace_counting_jit
+
+        mesh = make_mesh(2)
+        system = fixtures.make_system()
+        state = shard_state(fixtures.free_state(system), mesh)
+        fn = build_spmd_step(system, mesh, state, donate=False,
+                             jit_wrapper=trace_counting_jit)
+        new_state, _, _ = fn(state)
+        fn(new_state)  # same structure, new values: must not retrace
+        return fn.trace_count
+
+    progs = []
+    for n_dev in (2, 4, 8):
+        progs.append(AuditProgram(
+            name=f"step_spmd_d{n_dev}", layer="parallel",
+            summary=f"explicitly-sharded coupled step on the {n_dev}-device "
+                    "mesh (row-sharded shell, donated state)",
+            build=build(n_dev),
+            retrace_probe=retrace_probe if n_dev == 2 else None))
+    return progs

@@ -282,3 +282,49 @@ def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
     return GmresResult(x=x, iters=iters, residual=r_rel,
                        converged=r_rel <= tol, residual_true=r_rel,
                        refines=outers)
+
+
+# ---------------------------------------------------------------- skelly-audit
+
+def auditable_programs():
+    """The solver layer's audit entry: a bare f32 GMRES solve on a dense
+    well-conditioned operator. This is the program the mixed-precision path
+    embeds as its Krylov inner loop — its contract pins that the f32 hot
+    loop stays f32 (zero promotion edges: a single f64 constant here would
+    promote every Arnoldi vector), collective-free, callback-free, and
+    compiles once."""
+    from ..audit.registry import AuditProgram, built_from
+
+    def make_problem(n=64, seed=11):
+        import jax.numpy as jnp
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        A = jnp.asarray(np.eye(n) + 0.1 * rng.standard_normal((n, n)),
+                        dtype=jnp.float32)
+        b = jnp.asarray(rng.standard_normal(n), dtype=jnp.float32)
+        return A, b
+
+    def solve(A, b):
+        return gmres(lambda x: A @ x, b, tol=1e-4, restart=32, maxiter=64)
+
+    def build():
+        import jax
+
+        A, b = make_problem()
+        return built_from(jax.jit(solve), A, b)
+
+    def retrace_probe():
+        from ..testing import trace_counting_jit
+
+        A, b = make_problem()
+        step = trace_counting_jit(solve)
+        step(A, b)
+        step(A, b + 1.0)  # same shapes/dtypes: must not retrace
+        return step.trace_count
+
+    return [AuditProgram(
+        name="gmres_f32", layer="solver",
+        summary="bare f32 GMRES on a dense 64x64 operator (the mixed "
+                "path's Krylov inner loop)",
+        build=build, retrace_probe=retrace_probe)]

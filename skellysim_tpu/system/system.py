@@ -1252,3 +1252,51 @@ class System:
             else:
                 state = backup._replace(dt=jnp.asarray(dt_new, dtype=state.dt.dtype))
         return state
+
+
+# ---------------------------------------------------------------- skelly-audit
+
+def auditable_programs():
+    """This layer's entries in the audit matrix (docs/audit.md): the
+    single-chip implicit step (plain + donating twin — the donation check
+    pins what `tests/test_spmd.py` used to regex out of the HLO) and the
+    mixed-precision step whose deliberate f32->f64 refinement merges the
+    dtype-flow contract pins."""
+    from ..audit import fixtures
+    from ..audit.registry import AuditProgram, built_from
+
+    def build(donated=False, **overrides):
+        def _build():
+            system = fixtures.make_system(**overrides)
+            state = fixtures.free_state(system)
+            fn = (system._solve_jit_donated if donated
+                  else system._solve_jit)
+            return built_from(fn, state, ewald_plan=None, ewald_anchors=None)
+        return _build
+
+    def retrace_probe():
+        from ..testing import trace_counting_jit
+
+        system = fixtures.make_system()
+        step = trace_counting_jit(system._solve_impl,
+                                  static_argnames=("ewald_plan",))
+        new_state, _, _ = step(fixtures.free_state(system))
+        step(new_state)  # same structure, new values: must not retrace
+        return step.trace_count
+
+    return [
+        AuditProgram(
+            name="step_single", layer="system",
+            summary="single-chip implicit step (free fibers, f64, "
+                    "non-donating jit)",
+            build=build(), retrace_probe=retrace_probe),
+        AuditProgram(
+            name="step_single_donated", layer="system",
+            summary="single-chip implicit step through the donating jit "
+                    "(run-loop twin; must alias its inputs)",
+            build=build(donated=True)),
+        AuditProgram(
+            name="step_mixed", layer="system",
+            summary="mixed-precision step (f32 Krylov + f64 df refinement)",
+            build=build(solver_precision="mixed", refine_pair_impl="df")),
+    ]

@@ -1,0 +1,290 @@
+"""skelly-audit engine tests (`skellysim_tpu.audit`).
+
+Each check gets flag / pass / suppress coverage on *synthetic* programs
+(tiny jits lowered in-process — the real entry-point matrix is expensive to
+build, so the fast tier exercises the engine on small fixtures plus the
+bare-GMRES program, and the multi-device lowering fixtures ride the slow
+tier). The contract-drift case pins the acceptance property: perturbing a
+contract makes the auditor exit non-zero.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skellysim_tpu.audit import checks as ck
+from skellysim_tpu.audit import engine
+from skellysim_tpu.audit.cli import main as audit_main
+from skellysim_tpu.audit.registry import AuditProgram, built_from
+from skellysim_tpu.config import toml_io
+
+
+def _prog(fn, *args, name="synthetic", probe=None):
+    return AuditProgram(
+        name=name, layer="test", summary="synthetic",
+        build=lambda: built_from(jax.jit(fn), *args), retrace_probe=probe)
+
+
+def _audit(prog, contract, checks=None):
+    return engine.run_program_audit(prog, contract=contract, checks=checks)
+
+
+def _ids(findings):
+    return sorted(f.check for f in findings)
+
+
+# ------------------------------------------------------ collective-contract
+
+@pytest.fixture(scope="module")
+def psum_prog():
+    from skellysim_tpu.parallel.compat import shard_map
+    from skellysim_tpu.parallel.mesh import FIBER_AXIS, make_mesh
+
+    mesh = make_mesh(8)
+    from jax.sharding import PartitionSpec as P
+
+    def fn(x):
+        return shard_map(lambda s: jax.lax.psum(s, FIBER_AXIS), mesh=mesh,
+                         in_specs=P(FIBER_AXIS), out_specs=P())(x)
+
+    return _prog(fn, jnp.zeros(16, jnp.float64))
+
+
+def test_collectives_flag_uncontracted_and_drift(psum_prog):
+    f = _audit(psum_prog, {}, checks=["collective-contract"])
+    assert _ids(f) == ["collective-contract"]
+    assert "uncontracted" in f[0].message
+
+    good = {"collectives": {"all_reduce": {"count": 1, "max_elems": 2}}}
+    assert _audit(psum_prog, good, checks=["collective-contract"]) == []
+
+    drift = {"collectives": {"all_reduce": {"count": 3, "max_elems": 2}}}
+    f = _audit(psum_prog, drift, checks=["collective-contract"])
+    assert len(f) == 1 and "count drifted" in f[0].message
+
+    bound = {"collectives": {"all_reduce": {"count": 1, "max_elems": 1}}}
+    f = _audit(psum_prog, bound, checks=["collective-contract"])
+    assert len(f) == 1 and "over the contract bound" in f[0].message
+
+
+def test_collectives_flag_stale_contract_entry():
+    prog = _prog(lambda x: x * 2.0, jnp.zeros(4, jnp.float64))
+    stale = {"collectives": {"all_gather": {"count": 2}}}
+    f = _audit(prog, stale, checks=["collective-contract"])
+    assert len(f) == 1 and "stale contract" in f[0].message
+    # bound-only entries rot silently once the op vanishes: also stale
+    bound_only = {"collectives": {"all_gather": {"max_elems": 100}}}
+    f = _audit(prog, bound_only, checks=["collective-contract"])
+    assert len(f) == 1 and "stale contract" in f[0].message
+
+
+def test_collectives_require_a_count_pin(psum_prog):
+    # a contracted op present in the program must pin its static count
+    bound_only = {"collectives": {"all_reduce": {"max_elems": 2}}}
+    f = _audit(psum_prog, bound_only, checks=["collective-contract"])
+    assert len(f) == 1 and "no `count` pin" in f[0].message
+
+
+def test_collectives_suppressed_with_contract_entry(psum_prog):
+    contract = {"suppress": [{
+        "check": "collective-contract", "match": "uncontracted collective",
+        "reason": "fixture: deliberate psum under test"}]}
+    assert _audit(psum_prog, contract, checks=["collective-contract"]) == []
+
+
+# --------------------------------------------------------------- dtype-flow
+
+def _promoting(x):
+    # a deliberate narrow->wide edge on the traced path
+    return x.astype(jnp.float64) * 2.0
+
+
+def test_dtype_flags_promotion_edge():
+    prog = _prog(_promoting, jnp.zeros(4, jnp.float32))
+    f = _audit(prog, {}, checks=["dtype-flow"])
+    assert len(f) == 1 and "float32->float64" in f[0].message
+
+    pinned = {"dtype": {"promotions": {"float32->float64": 1}}}
+    assert _audit(prog, pinned, checks=["dtype-flow"]) == []
+
+    drifted = {"dtype": {"promotions": {"float32->float64": 2}}}
+    f = _audit(prog, drifted, checks=["dtype-flow"])
+    assert len(f) == 1 and "count drifted" in f[0].message
+
+
+def test_dtype_flags_stale_promotion_pin():
+    prog = _prog(lambda x: x + 1.0, jnp.zeros(4, jnp.float64))
+    stale = {"dtype": {"promotions": {"float32->float64": 1}}}
+    f = _audit(prog, stale, checks=["dtype-flow"])
+    assert len(f) == 1 and "stale contract" in f[0].message
+
+
+def test_dtype_suppressed_via_contract():
+    prog = _prog(_promoting, jnp.zeros(4, jnp.float32))
+    contract = {"suppress": [{
+        "check": "dtype-flow", "match": "float32->float64",
+        "reason": "fixture: the refinement-merge pattern"}]}
+    assert _audit(prog, contract, checks=["dtype-flow"]) == []
+
+
+# ---------------------------------------------------------------- host-sync
+
+def _callback_prog():
+    def fn(x):
+        y = jax.pure_callback(
+            lambda a: np.asarray(a), jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return y + x
+
+    return _prog(fn, jnp.zeros(3, jnp.float64))
+
+
+def test_host_sync_flags_pure_callback():
+    f = _audit(_callback_prog(), {}, checks=["host-sync"])
+    assert len(f) == 1 and "pure_callback" in f[0].message
+
+
+def test_host_sync_allowed_by_contract_and_stale_allowance():
+    allowed = {"host_sync": {"allowed_callbacks": ["pure_callback"]}}
+    assert _audit(_callback_prog(), allowed, checks=["host-sync"]) == []
+
+    clean = _prog(lambda x: x * 2.0, jnp.zeros(3, jnp.float64))
+    f = _audit(clean, allowed, checks=["host-sync"])
+    assert len(f) == 1 and "stale contract" in f[0].message
+
+
+# ----------------------------------------------------------------- donation
+
+def test_donation_check_both_directions():
+    x = jnp.zeros(8, jnp.float64)
+
+    donating = AuditProgram(
+        name="synthetic", layer="test", summary="",
+        build=lambda: built_from(jax.jit(lambda v: v + 1.0,
+                                         donate_argnums=(0,)), x))
+    plain = _prog(lambda v: v + 1.0, x)
+
+    assert _audit(donating, {"donation": {"donated": True}},
+                  checks=["donation"]) == []
+    f = _audit(donating, {"donation": {"donated": False}},
+               checks=["donation"])
+    assert len(f) == 1 and "rollback" in f[0].message
+
+    assert _audit(plain, {"donation": {"donated": False}},
+                  checks=["donation"]) == []
+    f = _audit(plain, {"donation": {"donated": True}}, checks=["donation"])
+    assert len(f) == 1 and "no aliasing marker" in f[0].message
+
+
+# ----------------------------------------------------------- retrace-budget
+
+def test_retrace_budget_flags_over_budget_and_missing_probe():
+    x = jnp.zeros(2, jnp.float64)
+    over = _prog(lambda v: v, x, probe=lambda: 3)
+    f = _audit(over, {"retrace": {"max_traces": 1}},
+               checks=["retrace-budget"])
+    assert len(f) == 1 and "traced 3x" in f[0].message
+
+    ok = _prog(lambda v: v, x, probe=lambda: 1)
+    assert _audit(ok, {"retrace": {"max_traces": 1}},
+                  checks=["retrace-budget"]) == []
+
+    no_probe = _prog(lambda v: v, x)
+    f = _audit(no_probe, {"retrace": {"max_traces": 1}},
+               checks=["retrace-budget"])
+    assert len(f) == 1 and "no retrace probe" in f[0].message
+
+
+# ----------------------------------------------- contract file / suppression
+
+def test_contract_validation_findings(tmp_path, monkeypatch):
+    monkeypatch.setattr(engine, "CONTRACT_DIR", str(tmp_path))
+    _, f = engine.load_contract("nope")
+    assert len(f) == 1 and "no contract file" in f[0].message
+
+    (tmp_path / "bad.toml").write_text(
+        '[program]\nname = "other"\n[typo_section]\nx = 1\n'
+        '[[suppress]]\ncheck = "dtype-flow"\nmatch = "x"\n'
+        '[[suppress]]\ncheck = "dtype-flow"\nmatch = ""\nreason = "r"\n')
+    _, f = engine.load_contract("bad")
+    msgs = " | ".join(x.message for x in f)
+    assert "unknown contract section" in msgs
+    assert "copy-paste drift" in msgs
+    assert "missing its reason" in msgs
+    # an empty match would blanket-suppress its whole check
+    assert "non-empty `match`" in msgs
+
+
+def test_empty_suppress_match_never_suppresses():
+    prog = _prog(_promoting, jnp.zeros(4, jnp.float32))
+    blanket = {"suppress": [{"check": "dtype-flow", "match": "",
+                             "reason": "illegitimate blanket"}]}
+    # the finding survives (and the dead entry is itself reported unused)
+    f = _audit(prog, blanket, checks=["dtype-flow"])
+    assert sorted(x.check for x in f) == ["contract", "dtype-flow"]
+    assert any("float32->float64" in x.message for x in f)
+
+
+def test_unused_suppression_is_a_finding():
+    prog = _prog(lambda x: x + 1.0, jnp.zeros(2, jnp.float64))
+    contract = {"suppress": [{"check": "dtype-flow", "match": "never-hits",
+                             "reason": "stale"}]}
+    f = _audit(prog, contract)
+    assert len(f) == 1 and "unused suppression" in f[0].message
+    # a check-filtered run must not flag suppressions for skipped checks
+    assert _audit(prog, contract, checks=["host-sync"]) == []
+
+
+def test_dump_contract_roundtrips_through_toml():
+    prog = _prog(_promoting, jnp.zeros(4, jnp.float32), name="dumpme")
+    text = engine.dump_contract(prog)
+    data = toml_io.loads(text)  # the quoted "float32->float64" key must parse
+    assert data["program"]["name"] == "dumpme"
+    assert data["dtype"]["promotions"]["float32->float64"] == 1
+
+
+# ------------------------------------------------- the real program matrix
+
+def test_gmres_program_is_contract_clean_end_to_end():
+    """The solver-layer entry point through the real tree contract,
+    retrace probe included (cheap: a 64x64 f32 solve)."""
+    assert audit_main(["--program", "gmres_f32"]) == 0
+
+
+def test_perturbed_contract_fails_the_cli(tmp_path, monkeypatch):
+    """The acceptance property: perturbing a contract file flips the CLI
+    to a non-zero exit."""
+    real = engine.contract_path("gmres_f32")
+    perturbed = toml_io.load(real)
+    perturbed["collectives"] = {"all_gather": {"count": 1}}
+    (tmp_path / "gmres_f32.toml").write_text(toml_io.dumps(perturbed))
+    monkeypatch.setattr(engine, "CONTRACT_DIR", str(tmp_path))
+    assert audit_main(["--program", "gmres_f32", "--check",
+                       "collective-contract"]) == 1
+
+
+def test_cli_usage_paths():
+    assert audit_main(["--list-checks"]) == 0
+    assert audit_main(["--list-programs"]) == 0
+    assert audit_main(["--program", "bogus"]) == 2
+    assert audit_main(["--check", "bogus"]) == 2
+
+
+@pytest.mark.slow
+def test_spmd_ladder_is_contract_clean():
+    """d2/d4 lowering fixtures (d8 is pinned per-commit by test_spmd's
+    wrapper): the collective inventory scales exactly as contracted —
+    density-bounded all_gather at every mesh size, ppermute blocks halving
+    with D. Slow: two full coupled shard_map lowerings."""
+    from skellysim_tpu.audit.programs import get_program
+
+    for name in ("step_spmd_d2", "step_spmd_d4"):
+        prog = get_program(name)
+        assert engine.run_program_audit(prog) == [], name
+
+
+@pytest.mark.slow
+def test_full_matrix_is_contract_clean():
+    """`python -m skellysim_tpu.audit` over the whole registered matrix —
+    the CI gate's exact invocation, exit 0 on this tree."""
+    assert audit_main([]) == 0

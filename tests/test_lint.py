@@ -117,7 +117,8 @@ def test_trace_flags_float_and_np_in_jit_reachable(tmp_path):
         "    return helper(x)\n"
         "def helper(x):\n"
         "    return np.sum(x) + float(x[0])\n"))
-    assert sorted(_rules(lint_paths([p]))) == ["trace-hygiene"] * 2
+    # np.sum concretizes (trace-hygiene); float() is a host pull (host-sync)
+    assert sorted(_rules(lint_paths([p]))) == ["host-sync", "trace-hygiene"]
 
 
 def test_trace_passes_host_side_and_lru_cached(tmp_path):
@@ -155,6 +156,44 @@ def test_trace_flags_block_until_ready_anywhere_in_hot_path(tmp_path):
     assert _rules(lint_paths([hot])) == ["trace-hygiene"]
     cold = _write(tmp_path, "io/mod.py", src)
     assert lint_paths([cold]) == []
+
+
+# ------------------------------------------------------------ host-sync
+
+def test_host_sync_flags_item_and_np_asarray_on_traced(tmp_path):
+    p = _write(tmp_path, "system/mod.py", (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    y = np.asarray(x)\n"
+        "    return y, x.item()\n"))
+    assert _rules(lint_paths([p])) == ["host-sync"] * 2
+
+
+def test_host_sync_allows_literal_payloads_and_host_code(tmp_path):
+    p = _write(tmp_path, "system/mod.py", (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    mask = np.asarray([1.0, 0.0, 1.0])\n"  # frozen constant: not a sync
+        "    n = int(x.shape[0])\n"
+        "    return x * mask * n\n"
+        "def host_writer(state):\n"  # unreachable: host io may sync freely
+        "    return float(state.time), np.asarray(state.x), state.t.item()\n"))
+    # the literal np.asarray stays trace-hygiene's business (a frozen
+    # constant, not a transfer) — host-sync itself must stay silent
+    assert lint_paths([p], rules=["host-sync"]) == []
+
+
+def test_host_sync_suppressed_with_pragma(tmp_path):
+    p = _write(tmp_path, "system/mod.py", (
+        "import jax\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    return x.item()  # skelly-lint: ignore[host-sync] -- fixture reason\n"))
+    assert lint_paths([p]) == []
 
 
 # -------------------------------------------------- sharding-annotation
