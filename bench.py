@@ -89,6 +89,21 @@ _REAL_STDOUT_FD = None
 BENCH_JSON_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH.json")
 
+#: skelly-scope artifact-format stamp on every bench artifact (BENCH.json,
+#: the headline line, MULTICHIP_*.json). Deliberately a LITERAL, not an
+#: import: the parent process never imports skellysim_tpu (whose package
+#: __init__ imports jax — the axon plugin can wedge at init, the exact
+#: failure mode this process layout defends against).
+#: tests/test_obs.py pins it == skellysim_tpu.obs.tracer.TELEMETRY_VERSION.
+TELEMETRY_VERSION = 1
+
+#: span-event stream the group children append to (one tracer per child);
+#: the parent clears it at startup so each bench run leaves one stream
+BENCH_TRACE_PATH = os.environ.get(
+    "BENCH_TRACE_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".bench_trace.jsonl"))
+
 
 def _remaining() -> float:
     return BUDGET_S - (time.monotonic() - _T_START)
@@ -1107,6 +1122,7 @@ def _group_multichip(extra, ck, on_acc):
         doc = dict(out)
         doc["generated_by"] = "bench.py --group multichip"
         doc["backend"] = extra.get("backend")
+        doc["telemetry_version"] = TELEMETRY_VERSION
         try:
             with open(MULTICHIP_JSON_PATH, "w") as fh:
                 json.dump(doc, fh, indent=1)
@@ -1241,13 +1257,34 @@ def _child_main(group: str, out_path: str):
     ck()
 
     fn = next(f for name, f, _ in GROUPS if name == group)
-    fn(extra, ck, on_acc)
+    # skelly-scope: record the group through a span into the shared bench
+    # trace stream (`obs summarize .bench_trace.jsonl` renders the per-group
+    # wall breakdown); never let telemetry failures cost a measurement
+    try:
+        from skellysim_tpu.obs import tracer as obs_tracer
+
+        tracer = obs_tracer.Tracer(BENCH_TRACE_PATH)
+        scope = obs_tracer.use(tracer)
+    except Exception:
+        tracer, scope, obs_tracer = None, None, None
+    if scope is not None:
+        with scope:
+            with obs_tracer.span("bench_group", group=group,
+                                 backend=extra.get("backend")):
+                fn(extra, ck, on_acc)
+        tracer.close()
+    else:
+        fn(extra, ck, on_acc)
     extra["group_total_s"] = round(time.monotonic() - _T_START, 1)
     ck()
 
 
 def _parent_main():
     extra = {}
+    try:  # fresh span stream per bench run (children append per group)
+        os.remove(BENCH_TRACE_PATH)
+    except OSError:
+        pass
     t_probe = time.perf_counter()
     probed, attempts = _probe_backend()
     extra["probe"] = {"backend": probed, "attempts": attempts,
@@ -1369,6 +1406,7 @@ def _parent_main():
         line["downscaled"] = True
     line["total_s"] = round(time.monotonic() - _T_START, 1)
     line["backend"] = backend
+    line["telemetry_version"] = TELEMETRY_VERSION
     line["extra"] = extra
     _emit(line)
 
@@ -1398,5 +1436,6 @@ if __name__ == "__main__":
         main()
     except Exception as e:  # absolute backstop: the driver must see valid JSON
         _emit({"metric": "bench_failed", "value": 0.0, "unit": "",
-               "vs_baseline": 0.0, "error": _short_err(e)})
+               "vs_baseline": 0.0, "error": _short_err(e),
+               "telemetry_version": TELEMETRY_VERSION})
         sys.exit(0)

@@ -35,6 +35,36 @@ echo "== audit: skelly-audit lowered-program contracts (docs/audit.md) =="
 # unused suppression. (Bootstraps its own 8-device CPU + x64 backend.)
 python -m skellysim_tpu.audit
 
+echo "== obs: skelly-scope cost baselines (docs/observability.md) =="
+# the runtime twin of the audit gate, in EVERY tier: every registered
+# program is compiled and XLA's static cost/memory analyses are checked
+# against obs/baselines/*.toml — uncovered programs, stale baselines, and
+# >tol_pct drift (regression OR improvement) all fail. Deliberate changes
+# re-baseline via `obs cost --update`. (~35 s with a warm .jax_cache —
+# the compile cache is shared with bench.py; cold runs pay ~40 s more.)
+python -m skellysim_tpu.obs cost --check
+
+echo "== obs: skelly-scope telemetry smoke (2-step run -> summarize) =="
+# a real System.run with metrics+trace streams, rendered through the CLI:
+# pins the acceptance path end to end (span events, compile events,
+# convergence stats from one JSONL pair) in ~15 s
+OBS_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python -c "
+from skellysim_tpu.utils.bootstrap import force_cpu_devices
+force_cpu_devices(8)
+import jax
+jax.config.update('jax_enable_x64', True)
+from skellysim_tpu.audit import fixtures
+system = fixtures.make_system()
+system.run(fixtures.free_state(system), max_steps=2,
+           metrics_path='$OBS_TMP/metrics.jsonl',
+           trace_path='$OBS_TMP/trace.jsonl')
+"
+python -m skellysim_tpu.obs summarize "$OBS_TMP"/metrics.jsonl "$OBS_TMP"/trace.jsonl \
+  | grep -q "solver convergence" \
+  || { echo "obs summarize smoke failed" >&2; rm -rf "$OBS_TMP"; exit 1; }
+rm -rf "$OBS_TMP"
+
 echo "== docs: config reference in sync with the schema =="
 JAX_PLATFORMS=cpu python scripts/gen_config_reference.py --check
 

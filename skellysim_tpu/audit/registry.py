@@ -19,17 +19,21 @@ from typing import Callable
 
 @dataclass
 class BuiltProgram:
-    """The two lowering artifacts every check consumes.
+    """The lowering artifacts every check consumes.
 
     ``closed_jaxpr`` is the traced `jax.core.ClosedJaxpr` (dtype-flow and
     host-sync walk its equations recursively); ``lowered_text`` is the
     StableHLO module text (collective inventory and donation markers — the
     program XLA actually receives, including the shard_map lowering the
-    jaxpr only names symbolically).
+    jaxpr only names symbolically). ``lowered`` is the live `jax.stages
+    .Lowered` handle the text came from — skelly-scope's cost gate
+    (`obs.cost`) compiles it for XLA's cost/memory analyses; audit checks
+    never touch it (tests construct BuiltProgram without one).
     """
 
     closed_jaxpr: object
     lowered_text: str
+    lowered: object = None
 
 
 @dataclass
@@ -52,8 +56,10 @@ class AuditProgram:
 
 
 def built_from(jitted, *args, **kwargs) -> BuiltProgram:
-    """Trace + lower a `jax.jit`-wrapped callable once, capturing both
-    artifacts from the same trace (no double tracing)."""
+    """Trace + lower a `jax.jit`-wrapped callable once, capturing every
+    artifact from the same trace (no double tracing/lowering)."""
     traced = jitted.trace(*args, **kwargs)
+    lowered = traced.lower()
     return BuiltProgram(closed_jaxpr=traced.jaxpr,
-                        lowered_text=traced.lower().as_text())
+                        lowered_text=lowered.as_text(),
+                        lowered=lowered)

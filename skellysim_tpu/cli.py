@@ -33,7 +33,9 @@ def _snapshot_path(traj: str, suffix: str) -> str:
 
 def run(config_file: str, resume: bool = False, overwrite: bool = False,
         trajectory_path: str | None = None,
-        metrics_path: str | None = None) -> None:
+        metrics_path: str | None = None,
+        trace_path: str | None = None,
+        profile_dir: str | None = None) -> None:
     traj = trajectory_path or os.path.join(
         os.path.dirname(os.path.abspath(config_file)) or ".", TRAJECTORY_FILE)
 
@@ -70,7 +72,8 @@ def run(config_file: str, resume: bool = False, overwrite: bool = False,
 
     with writer:
         final = system.run(state, writer=writer.write_frame, rng=rng,
-                           metrics_path=metrics_path)
+                           metrics_path=metrics_path, trace_path=trace_path,
+                           profile_dir=profile_dir)
 
     shutil.copyfile(config_file, _snapshot_path(traj, "final_config"))
     print(f"Finished at t={float(final.time):.6g}")
@@ -89,6 +92,13 @@ def main(argv=None) -> None:
                     help="post-processing server: msgpack requests on stdin")
     ap.add_argument("--metrics-file", default=None,
                     help="append one JSON line of step metrics per trial step")
+    ap.add_argument("--trace-file", default=None,
+                    help="skelly-scope telemetry JSONL (span + compile "
+                         "events; render with `python -m skellysim_tpu.obs "
+                         "summarize`, docs/observability.md)")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="wrap the run in jax.profiler.trace(DIR) — "
+                         "perfetto/TensorBoard dumps of the whole loop")
     ap.add_argument("--log-level", default=os.environ.get("SKELLYSIM_LOG", "INFO"),
                     help="log level for the skellysim_tpu logger "
                          "(the reference reads SPDLOG_LEVEL similarly)")
@@ -126,7 +136,8 @@ def main(argv=None) -> None:
         serve(args.config_file)
         return
     run(args.config_file, resume=args.resume, overwrite=args.overwrite,
-        metrics_path=args.metrics_file)
+        metrics_path=args.metrics_file, trace_path=args.trace_file,
+        profile_dir=args.profile)
 
 
 if __name__ == "__main__":

@@ -72,9 +72,13 @@ def _members_from_sweep(sweep_file: str):
 
 def run(sweep_file: str, output_dir: str | None = None,
         batch: int | None = None, batch_impl: str | None = None,
-        overwrite: bool = False, metrics_path: str | None = None) -> list:
+        overwrite: bool = False, metrics_path: str | None = None,
+        trace_path: str | None = None) -> list:
     """Expand + drain a sweep; returns retired member ids."""
+    import contextlib
+
     from ..io.ensemble_io import EnsembleMetricsWriter, MemberTrajectoryWriters
+    from ..obs import tracer as obs_tracer
     from .scheduler import EnsembleScheduler
     from .runner import EnsembleRunner
 
@@ -93,12 +97,22 @@ def run(sweep_file: str, output_dir: str | None = None,
             sys.exit(f"member trajectories already exist ({clobbered[0]}.out"
                      f" + {len(clobbered) - 1} more); pass --overwrite")
     runner = EnsembleRunner(system, batch_impl=batch_impl or spec.batch_impl)
-    with writers, EnsembleMetricsWriter(metrics_path) as metrics:
-        sched = EnsembleScheduler(
-            runner, members, batch or spec.batch, writer=writers,
-            metrics=metrics, write_initial_frames=True,
-            on_dt_underflow="retire")
-        retired = sched.run()
+    # skelly-scope stream for the drain: lane admit/backfill/retire events,
+    # per-round batched-step spans (lane occupancy), compile events
+    tracer = obs_tracer.Tracer(trace_path) if trace_path else None
+    scope = (obs_tracer.use(tracer) if tracer is not None
+             else contextlib.nullcontext())
+    try:
+        with writers, EnsembleMetricsWriter(metrics_path) as metrics, scope:
+            sched = EnsembleScheduler(
+                runner, members, batch or spec.batch, writer=writers,
+                metrics=metrics, write_initial_frames=True,
+                on_dt_underflow="retire")
+            retired = sched.run()
+    finally:
+        # close even when the drain raises (System.run's tracer lifecycle)
+        if tracer is not None:
+            tracer.close()
     print(f"ensemble finished: {len(retired)}/{len(members)} members "
           f"retired over {sched.rounds} batched steps")
     return retired
@@ -124,6 +138,10 @@ def main(argv=None) -> None:
     ap.add_argument("--metrics-file", default=None,
                     help="aggregated ensemble metrics JSONL "
                          "(default: <output-dir>/ensemble_metrics.jsonl)")
+    ap.add_argument("--trace-file", default=None,
+                    help="skelly-scope telemetry JSONL (lane events + "
+                         "batched-step spans; `python -m skellysim_tpu.obs "
+                         "summarize` reports lane occupancy from it)")
     ap.add_argument("--log-level",
                     default=os.environ.get("SKELLYSIM_LOG", "INFO"))
     args = ap.parse_args(argv)
@@ -143,4 +161,4 @@ def main(argv=None) -> None:
 
     run(args.sweep_file, output_dir=args.output_dir, batch=args.batch,
         batch_impl=args.batch_impl, overwrite=args.overwrite,
-        metrics_path=args.metrics_file)
+        metrics_path=args.metrics_file, trace_path=args.trace_file)

@@ -74,6 +74,12 @@ class EnsembleStepInfo(NamedTuple):
     t: jnp.ndarray                # per-member time AFTER the step
     dt_next: jnp.ndarray          # per-member dt AFTER the step
     solutions: jnp.ndarray        # [B, n_solution]
+    #: [B] GMRES restart cycles (skelly-scope `gmres_cycles`; always the
+    #: per-member row count of ``history``)
+    cycles: jnp.ndarray = 0
+    #: [B, gmres_history, 3] per-member convergence ring buffers
+    #: (`solver.gmres` docstring), or None when Params.gmres_history == 0
+    history: jnp.ndarray | None = None
 
 
 def _check_member(i, template_leaves, state):
@@ -172,7 +178,13 @@ class EnsembleRunner:
                 "through System.run")
         self.system = system
         self.batch_impl = batch_impl
-        self._step_jit = jax.jit(self.step_impl)
+        # through the compile observer (obs.compile_log): with a tracer
+        # active, the scheduler's timeline shows exactly when (and with
+        # what member signature) the batched step compiled — the runtime
+        # twin of the backfill-never-retraces test pin
+        from ..obs.compile_log import observed_jit
+
+        self._step_jit = observed_jit(self.step_impl, name="ensemble_step")
 
     # ------------------------------------------------------------- assembly
 
@@ -265,7 +277,10 @@ class EnsembleRunner:
             loss_of_accuracy=jnp.broadcast_to(
                 jnp.asarray(infos.loss_of_accuracy), conv.shape),
             collided=coll, dt_underflow=dt_underflow, dt_used=states.dt,
-            t=merged.time, dt_next=merged.dt, solutions=solutions)
+            t=merged.time, dt_next=merged.dt, solutions=solutions,
+            cycles=jnp.broadcast_to(
+                jnp.asarray(infos.cycles, dtype=jnp.int32), conv.shape),
+            history=infos.history)
         return EnsembleState(states=merged, t_final=ens.t_final), info
 
     def step(self, ens: EnsembleState):

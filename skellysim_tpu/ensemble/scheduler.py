@@ -25,6 +25,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..obs import tracer as obs_tracer
+from ..solver.gmres import history_rows
 from ..system.system import SimState, crossed_write_boundary
 from ..utils.rng import SimRNG
 from .runner import EnsembleRunner, lane_state, set_lane
@@ -129,6 +131,12 @@ class EnsembleScheduler:
             t_final=self.ens.t_final.at[lane].set(spec.t_final))
         self.lanes[lane] = _Lane(spec=spec, t=float(spec.state.time),
                                  dt=float(spec.state.dt))
+        # skelly-scope lane churn: "admit" seats a member before the first
+        # batched step, "backfill" refills a lane freed mid-drain (the
+        # continuous-batching move; obs summarize reports occupancy)
+        obs_tracer.emit("lane",
+                        action="admit" if self.rounds == 0 else "backfill",
+                        lane=lane, member=spec.member_id)
         self._emit({"event": "start", "member": spec.member_id, "lane": lane,
                     "t": float(spec.state.time), "t_final": spec.t_final})
         if self.write_initial_frames and self.writer is not None:
@@ -139,6 +147,9 @@ class EnsembleScheduler:
 
     def _retire_member(self, lane: int, reason: str = "finished"):
         ln = self.lanes[lane]
+        obs_tracer.emit("lane", action="retire", lane=lane,
+                        member=ln.spec.member_id, reason=reason,
+                        steps=ln.steps)
         self._emit({"event": "retire" if reason == "finished" else reason,
                     "member": ln.spec.member_id, "lane": lane, "t": ln.t,
                     "steps": ln.steps, "frames": ln.frames})
@@ -163,15 +174,22 @@ class EnsembleScheduler:
         while any(ln is not None for ln in self.lanes):
             if self.max_rounds is not None and self.rounds >= self.max_rounds:
                 break
-            wall0 = _time.perf_counter()
-            self.ens, info = self.step_fn(self.ens)
-            # ONE device fetch for all [B] outcome vectors
-            fetched = {f: np.asarray(getattr(info, f))
-                       for f in ("running", "accepted", "iters", "residual",
-                                 "residual_true", "fiber_error", "refines",
-                                 "loss_of_accuracy", "dt_underflow",
-                                 "dt_used", "t", "dt_next")}
-            wall_s = _time.perf_counter() - wall0
+            live = sum(1 for ln in self.lanes if ln is not None)
+            with obs_tracer.span("ensemble_step", round=self.rounds,
+                                 live=live, lanes=self.batch):
+                wall0 = _time.perf_counter()
+                self.ens, info = self.step_fn(self.ens)
+                # ONE device fetch for all [B] outcome vectors (it doubles
+                # as the span's device-work barrier)
+                fetched = {f: np.asarray(getattr(info, f))
+                           for f in ("running", "accepted", "iters",
+                                     "residual", "residual_true",
+                                     "fiber_error", "refines",
+                                     "loss_of_accuracy", "dt_underflow",
+                                     "dt_used", "t", "dt_next", "cycles")}
+                hist = (np.asarray(info.history)
+                        if info.history is not None else None)
+                wall_s = _time.perf_counter() - wall0
             self.rounds += 1
 
             for lane, ln in enumerate(self.lanes):
@@ -201,8 +219,10 @@ class EnsembleScheduler:
                 ln.steps += 1
                 self._emit({
                     "event": "step", "member": ln.spec.member_id,
-                    "lane": lane, "step": ln.steps - 1, "t": ln.t,
+                    "lane": lane, "round": self.rounds - 1,
+                    "step": ln.steps - 1, "t": ln.t,
                     "dt": dt_used, "iters": int(fetched["iters"][lane]),
+                    "gmres_cycles": int(fetched["cycles"][lane]),
                     "residual": float(fetched["residual"][lane]),
                     "residual_true": float(fetched["residual_true"][lane]),
                     "fiber_error": float(fetched["fiber_error"][lane]),
@@ -210,7 +230,11 @@ class EnsembleScheduler:
                     "refines": int(fetched["refines"][lane]),
                     "loss_of_accuracy": bool(
                         fetched["loss_of_accuracy"][lane]),
-                    "wall_s": round(wall_s, 4)})
+                    "wall_s": round(wall_s, 4),
+                    "wall_ms": round(wall_s * 1e3, 3),
+                    "gmres_history": history_rows(
+                        hist[lane] if hist is not None else None,
+                        fetched["cycles"][lane])})
                 ln.t = t_new
                 ln.dt = float(fetched["dt_next"][lane])
                 if (accepted and self.writer is not None

@@ -19,10 +19,16 @@ from typing import Optional
 from .trajectory import TrajectoryWriter
 
 #: keys of an ``event == "step"`` record, one per member trial step — the
-#: sequential METRICS_FIELDS (system.system) plus the ensemble coordinates
-ENSEMBLE_STEP_FIELDS = ("event", "member", "lane", "step", "t", "dt", "iters",
-                        "residual", "residual_true", "fiber_error",
-                        "accepted", "refines", "loss_of_accuracy", "wall_s")
+#: sequential METRICS_FIELDS (system.system) plus the ensemble coordinates.
+#: `wall_s`/`wall_ms` are the BATCHED round's wall time, shared by every
+#: lane of that round — `round` is the shared-round id consumers must
+#: dedupe wall sums by (`obs.summarize` does); `gmres_cycles`/
+#: `gmres_history` are per member (docs/observability.md)
+ENSEMBLE_STEP_FIELDS = ("event", "member", "lane", "round", "step", "t",
+                        "dt", "iters", "gmres_cycles", "residual",
+                        "residual_true", "fiber_error", "accepted",
+                        "refines", "loss_of_accuracy", "wall_s", "wall_ms",
+                        "gmres_history")
 
 #: keys of an ``event == "start"`` record (member entered a lane)
 ENSEMBLE_START_FIELDS = ("event", "member", "lane", "t", "t_final")

@@ -219,14 +219,25 @@ def _is_cached_fn(fi: FunctionInfo) -> bool:
     return False
 
 
+#: callables that compile their first argument like `jax.jit` does — the
+#: repo's own jit twins count as reachability seeds too: skelly-scope's
+#: compile observer (`obs.compile_log.observed_jit`, what System/ensemble
+#: entry points route through since the telemetry PR) and the test/audit
+#: trace counter. Missing one of these would silently drop whole call
+#: trees out of the dtype/trace/host-sync gates (caught when the
+#: observed_jit migration orphaned two pragmas).
+_JIT_WRAPPER_NAMES = ("jit", "observed_jit", "trace_counting_jit")
+
+
 def _is_jit_expr(node, mod: ModuleInfo) -> bool:
     """True for expressions that (possibly via functools.partial) name
-    jax.jit: `jax.jit`, `jit` (from-imported), `partial(jax.jit, ...)`."""
-    if isinstance(node, ast.Attribute) and node.attr == "jit":
+    jax.jit or a repo jit twin: `jax.jit`, `jit` (from-imported),
+    `observed_jit`, `trace_counting_jit`, `partial(jax.jit, ...)`."""
+    if isinstance(node, ast.Attribute) and node.attr in _JIT_WRAPPER_NAMES:
         return True
     if isinstance(node, ast.Name):
         tgt = mod.from_imports.get(node.id)
-        if tgt is not None and tgt[1] == "jit":
+        if tgt is not None and tgt[1] in _JIT_WRAPPER_NAMES:
             return True
     if isinstance(node, ast.Call) and node.args:
         fn = node.func

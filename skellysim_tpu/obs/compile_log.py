@@ -1,0 +1,124 @@
+"""Compile observer: a jit wrapper that turns traces/compiles into events.
+
+skelly-scope's second leg. skelly-audit's retrace-budget check and
+`testing.trace_counting_jit` catch retraces in TESTS; this wrapper makes
+them visible at RUNTIME: every call that triggered a fresh trace of the
+wrapped function emits one ``compile`` event into the active tracer
+(`obs.tracer`) with the program name, the call's wall time (trace + XLA
+compile + first execution — the full first-call cost a user experiences),
+the donated argument positions, and the argument shape/dtype signature. A
+retrace on the hot path then shows up in the `obs summarize` timeline with
+the signature that caused it, instead of only failing a budget after the
+fact.
+
+`ObservedJit` is drop-in for `jax.jit` where the codebase already has a
+wrapper seam: `System.__init__`'s jits, `parallel.spmd.build_spmd_step`'s
+``jit_wrapper=`` parameter, and `ensemble.EnsembleRunner`'s step jit all
+route through it. `.trace()` / `.lower()` pass through to the underlying
+jit, so `audit.registry.built_from` keeps working on wrapped entry points.
+Overhead with no active tracer: one counter comparison per call.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+from . import tracer as _tracer
+
+#: dtype -> short signature token (fallback: the dtype's own name)
+_DTYPE_SHORT = {"float64": "f64", "float32": "f32", "bfloat16": "bf16",
+                "float16": "f16", "int64": "i64", "int32": "i32",
+                "uint32": "u32", "bool": "b1", "complex64": "c64",
+                "complex128": "c128"}
+
+#: signature leaves beyond this many are elided (huge pytrees — a SimState
+#: has dozens of leaves; the first ones carry the discriminating shapes)
+_SIG_MAX_LEAVES = 16
+
+
+def arg_signature(args, kwargs) -> str:
+    """Compact shape/dtype signature of a call's pytree leaves, e.g.
+    ``f64[16,16,3],f64[],i32[16]`` — the retrace-diagnosis payload."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    toks = []
+    for leaf in leaves[:_SIG_MAX_LEAVES]:
+        dt = getattr(leaf, "dtype", None)
+        shape = getattr(leaf, "shape", None)
+        if dt is not None and shape is not None:
+            short = _DTYPE_SHORT.get(str(dt), str(dt))
+            toks.append(f"{short}[{','.join(str(d) for d in shape)}]")
+        else:
+            toks.append(type(leaf).__name__)
+    if len(leaves) > _SIG_MAX_LEAVES:
+        toks.append(f"+{len(leaves) - _SIG_MAX_LEAVES} more")
+    return ",".join(toks)
+
+
+class ObservedJit:
+    """`jax.jit` twin that reports each fresh trace as a ``compile`` event.
+
+    Same trace-counting approach as `testing.trace_counting_jit` (the
+    wrapped Python body runs exactly once per trace); the counter doubles
+    as the runtime's own retrace detector via ``trace_count``.
+    """
+
+    def __init__(self, fn, *, name: str | None = None, **jit_kwargs):
+        import jax
+
+        self.name = name or getattr(fn, "__name__", "jit")
+        self._count = 0
+        self._trace_s = 0.0
+
+        @functools.wraps(fn)
+        def counting(*args, **kwargs):
+            t0 = time.perf_counter()
+            self._count += 1
+            out = fn(*args, **kwargs)
+            # tracing time only (compile happens after the trace returns)
+            self._trace_s = time.perf_counter() - t0
+            return out
+
+        self._jitted = jax.jit(counting, **jit_kwargs)
+        donated = jit_kwargs.get("donate_argnums", ())
+        self._donated = list(donated if isinstance(donated, (tuple, list))
+                             else (donated,))
+
+    def __call__(self, *args, **kwargs):
+        tr = _tracer.active()
+        if tr is None:
+            return self._jitted(*args, **kwargs)
+        before = self._count
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        if self._count > before:
+            tr.emit("compile", name=self.name,
+                    wall_s=round(time.perf_counter() - t0, 6),
+                    trace_s=round(self._trace_s, 6),
+                    traces=self._count, donated=self._donated,
+                    arg_sig=arg_signature(args, kwargs))
+        return out
+
+    # audit/cost seam: `built_from` traces/lowers through the wrapper
+    def trace(self, *args, **kwargs):
+        return self._jitted.trace(*args, **kwargs)
+
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    @property
+    def trace_count(self) -> int:
+        return self._count
+
+
+def observed_jit(fn, *, name: str | None = None, **jit_kwargs) -> ObservedJit:
+    """`jax.jit` replacement that logs compiles to the active tracer."""
+    return ObservedJit(fn, name=name, **jit_kwargs)
+
+
+def jit_wrapper(name: str):
+    """A `build_spmd_step(jit_wrapper=...)`-compatible factory: the seam
+    passes ``(fn, **jit_kwargs)``, we add the program name."""
+    return lambda fn, **jit_kwargs: ObservedJit(fn, name=name, **jit_kwargs)

@@ -1,0 +1,235 @@
+"""Cost accounting: FLOPs/bytes/memory per audited program, with baselines.
+
+skelly-scope's third leg. skelly-audit pins what the lowered programs *are*
+(collectives, dtype edges, callbacks); this module pins what they *cost*:
+for every entry in the SAME registry (`audit.programs.all_programs()` — the
+`auditable_programs()` seam is reused, nothing re-registers), the program
+is compiled and XLA's own static analyses are read out::
+
+    .lower().compile().cost_analysis()    -> flops, bytes accessed
+    .lower().compile().memory_analysis()  -> argument/output/temp bytes
+
+and compared against a checked-in baseline (`obs/baselines/<name>.toml`,
+written/updated via ``python -m skellysim_tpu.obs cost --update``). The
+drift gate mirrors the audit-contract discipline:
+
+* a registered program with no baseline file is a finding (new programs
+  must arrive with their cost pinned);
+* any gated metric drifting beyond the baseline's ``tol_pct`` (default
+  ``25.0``) is a finding — regressions AND improvements, so the baseline
+  always describes the current program (a stale "cheap" baseline would
+  hide the next regression inside its slack);
+* a baseline file whose program is no longer registered is a finding;
+* deliberate changes are recorded with ``[[suppress]]`` entries (``check``
+  + ``match`` + mandatory ``reason``; unused entries are findings) — the
+  same engine as audit contracts (`audit.engine.apply_suppressions`).
+
+The numbers are XLA *static* analyses of the compiled module — exact flop
+and traffic counts for the optimized program on the compiling backend, not
+wall-time samples — so they are deterministic run-to-run and honest about
+program-structure regressions (an accidental f64 promotion or a dropped
+fusion moves them immediately). `memory_analysis` is the compiled
+footprint: ``peak_bytes`` here is argument + output + temp — the resident
+proxy that tracks HBM pressure on accelerators (on CPU XLA, temp covers
+the scratch the schedule actually allocates).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..audit.engine import Finding, apply_suppressions
+
+BASELINE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "baselines")
+
+#: relative drift tolerance (percent) when a baseline pins no tol_pct
+DEFAULT_TOL_PCT = 25.0
+
+#: gated metrics, in table order. A baseline may pin a subset (only pinned
+#: keys gate), but `--update` always writes all of them.
+COST_KEYS = ("flops", "bytes_accessed", "argument_bytes", "output_bytes",
+             "temp_bytes", "peak_bytes")
+
+CHECK_ID = "cost-baseline"
+
+
+def baseline_path(name: str, baseline_dir: str | None = None) -> str:
+    return os.path.join(baseline_dir or BASELINE_DIR, f"{name}.toml")
+
+
+def measure_built(built) -> dict:
+    """Compile one `audit.registry.BuiltProgram` and read XLA's static cost
+    + memory analyses into a flat metrics dict."""
+    if getattr(built, "lowered", None) is None:
+        raise ValueError(
+            "BuiltProgram carries no lowered artifact (built_from now "
+            "retains it); cost accounting needs `.lowered.compile()`")
+    t0 = time.perf_counter()
+    compiled = built.lowered.compile()
+    compile_s = time.perf_counter() - t0
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    ma = compiled.memory_analysis()
+
+    def mem(attr):
+        return int(getattr(ma, attr, 0) or 0)
+
+    arg_b = mem("argument_size_in_bytes")
+    out_b = mem("output_size_in_bytes")
+    tmp_b = mem("temp_size_in_bytes")
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        "argument_bytes": arg_b,
+        "output_bytes": out_b,
+        "temp_bytes": tmp_b,
+        "peak_bytes": arg_b + out_b + tmp_b,
+        "compile_s": round(compile_s, 3),   # informational, never gated
+    }
+
+
+def load_baseline(name: str, baseline_dir: str | None = None):
+    """(baseline dict | None, [Finding]) — validation findings only."""
+    from ..config import toml_io
+
+    path = baseline_path(name, baseline_dir)
+    if not os.path.exists(path):
+        return None, [Finding(name, CHECK_ID, (
+            f"no cost baseline at obs/baselines/{name}.toml — every "
+            "registered program must pin its cost (run `python -m "
+            "skellysim_tpu.obs cost --update` and commit the result)"))]
+    data = toml_io.load(path)
+    out = []
+    declared = data.get("program", {}).get("name")
+    if declared is not None and declared != name:
+        out.append(Finding(name, CHECK_ID, (
+            f"baseline file {name}.toml declares program.name="
+            f"{declared!r} — copy-paste drift")))
+    for i, sup in enumerate(data.get("suppress", [])):
+        if not sup.get("check") or not sup.get("match"):
+            out.append(Finding(name, CHECK_ID, (
+                f"suppress entry #{i + 1} needs both `check` and a "
+                "non-empty `match`")))
+        if not sup.get("reason"):
+            out.append(Finding(name, CHECK_ID, (
+                f"suppress entry #{i + 1} is missing its reason: every "
+                "suppression must say why")))
+    return data, out
+
+
+def cost_findings(name: str, measured: dict, baseline: dict):
+    """Drift findings for one program against its (loaded) baseline."""
+    out = []
+    base = baseline.get("cost", {})
+    tol = float(base.get("tol_pct", DEFAULT_TOL_PCT))
+    for key in COST_KEYS:
+        if key not in base:
+            continue
+        b = float(base[key])
+        m = float(measured[key])
+        denom = max(abs(b), 1.0)
+        drift = (m - b) / denom * 100.0
+        if abs(drift) > tol:
+            kind = "regression" if m > b else "improvement"
+            out.append(Finding(name, CHECK_ID, (
+                f"{key} drifted {drift:+.1f}% ({kind}): baseline {b:g}, "
+                f"measured {m:g} (tol ±{tol:g}%) — fix the program or "
+                "re-baseline deliberately with `obs cost --update`")))
+    return out
+
+
+def write_baseline(name: str, measured: dict,
+                   baseline_dir: str | None = None) -> str:
+    """Write/refresh one baseline file, preserving an existing file's
+    ``tol_pct`` and ``[[suppress]]`` entries (the deliberate knobs)."""
+    from ..config import toml_io
+
+    path = baseline_path(name, baseline_dir)
+    prev = toml_io.load(path) if os.path.exists(path) else {}
+    cost = {k: measured[k] for k in COST_KEYS}
+    if "tol_pct" in prev.get("cost", {}):
+        cost["tol_pct"] = prev["cost"]["tol_pct"]
+    data = {"program": {"name": name}, "cost": cost}
+    if prev.get("suppress"):
+        data["suppress"] = prev["suppress"]
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    toml_io.dump(data, path)
+    return path
+
+
+def audit_costs(progs, baseline_dir: str | None = None,
+                update: bool = False, registry_names=None):
+    """Measure every program and gate against baselines.
+
+    Returns ``(rows, findings)``: one row dict per program (name + the
+    measured metrics; ``error`` instead when the build/compile failed) and
+    the unsuppressed findings. ``update=True`` rewrites baseline files from
+    the measurements instead of gating (validation findings still count).
+
+    ``registry_names`` is the FULL registered-program name set for the
+    stale-baseline scan; it defaults to ``progs``'s names, but a caller
+    auditing a filtered subset (``--program NAME``) must pass the full set
+    or every other program's perfectly valid baseline reads as stale.
+    """
+    rows = []
+    findings = []
+    seen = (set(registry_names) if registry_names is not None else
+            {p.name for p in progs})
+    for prog in progs:
+        baseline, f_load = load_baseline(prog.name, baseline_dir)
+        prog_findings = [] if (update and baseline is None) else list(f_load)
+        try:
+            measured = measure_built(prog.build())
+        except Exception as e:  # a program that no longer compiles IS a finding
+            rows.append({"name": prog.name,
+                         "error": f"{type(e).__name__}: {e}"})
+            prog_findings.append(Finding(prog.name, CHECK_ID, (
+                f"entry point failed to build/compile: "
+                f"{type(e).__name__}: {e}")))
+            findings.extend(apply_suppressions(prog.name, baseline,
+                                               prog_findings))
+            continue
+        rows.append(dict({"name": prog.name}, **measured))
+        if update:
+            write_baseline(prog.name, measured, baseline_dir)
+        elif baseline is not None:
+            prog_findings.extend(cost_findings(prog.name, measured, baseline))
+        findings.extend(apply_suppressions(prog.name, baseline,
+                                           prog_findings))
+    # stale baseline files: the registry no longer names them
+    bdir = baseline_dir or BASELINE_DIR
+    if os.path.isdir(bdir):
+        for fn in sorted(os.listdir(bdir)):
+            stem, ext = os.path.splitext(fn)
+            if ext == ".toml" and stem not in seen:
+                findings.append(Finding(stem, CHECK_ID, (
+                    f"stale baseline obs/baselines/{fn}: no registered "
+                    "program by that name — remove it (or the program "
+                    "lost its registration silently)")))
+    return rows, findings
+
+
+def render_table(rows) -> str:
+    """Fixed-width cost table (the CLI's report body)."""
+    cols = ("name", "flops", "bytes_accessed", "peak_bytes", "argument_bytes",
+            "temp_bytes", "compile_s")
+    heads = ("program", "flops", "bytes", "peak_B", "arg_B", "temp_B",
+             "compile_s")
+
+    def fmt(row, key):
+        if "error" in row and key != "name":
+            return "build error" if key == "flops" else ""
+        v = row.get(key, "")
+        if isinstance(v, float) and key in ("flops", "bytes_accessed"):
+            return f"{v:.3e}"
+        return str(v)
+
+    table = [heads] + [tuple(fmt(r, c) for c in cols) for r in rows]
+    widths = [max(len(line[i]) for line in table) for i in range(len(cols))]
+    return "\n".join("  ".join(cell.ljust(w) for cell, w in
+                               zip(line, widths)).rstrip()
+                     for line in table)

@@ -1,0 +1,219 @@
+"""Render telemetry/metrics JSONL streams into a human-readable report.
+
+One parser for every line shape the repo emits (docs/observability.md):
+
+* tracer events (``ev`` key): ``span`` / ``compile`` / ``lane`` /
+  ``telemetry`` headers — from `obs.tracer` (run loop, ensemble scheduler,
+  bench groups);
+* run-loop step records (`system.METRICS_FIELDS` — no ``ev``/``event``
+  key) and ensemble metrics records (``event`` = start/step/retire/...,
+  `io.ensemble_io`);
+* resume markers (``{"resume": true, ...}``).
+
+The report has four sections — per-span timings, compile events, lane
+occupancy, solver convergence — each omitted when its inputs are absent,
+so the same command serves a single-run metrics file, a trace file, an
+ensemble metrics file, or all of them at once.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:.4f}"
+
+
+class Summary:
+    """Accumulator over parsed JSONL records."""
+
+    def __init__(self):
+        self.spans: dict[str, list[float]] = {}
+        self.compiles: list[dict] = []
+        self.lane_events: dict[str, int] = {}
+        self.lane_rounds: list[dict] = []
+        self.steps: list[dict] = []
+        self.resumes = 0
+        self.versions: set[int] = set()
+        self.unparsed = 0
+        #: source-stream id stamped on ingested step records: `round` ids
+        #: restart at 0 per ensemble run, so wall dedupe must never merge
+        #: round 0 of file A with round 0 of file B
+        self._stream = 0
+
+    # ------------------------------------------------------------- ingest
+
+    def add_line(self, line: str):
+        line = line.strip()
+        if not line:
+            return
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            self.unparsed += 1
+            return
+        if not isinstance(rec, dict):
+            self.unparsed += 1
+            return
+        ev = rec.get("ev")
+        if ev == "telemetry":
+            self.versions.add(rec.get("version"))
+        elif ev == "span":
+            self.spans.setdefault(rec.get("path") or rec.get("name", "?"),
+                                  []).append(float(rec.get("dur_s", 0.0)))
+            # ensemble batched-step spans carry lane-occupancy fields
+            if "live" in rec and "lanes" in rec:
+                self.lane_rounds.append(rec)
+        elif ev == "compile":
+            self.compiles.append(rec)
+        elif ev == "lane":
+            action = rec.get("action", "?")
+            self.lane_events[action] = self.lane_events.get(action, 0) + 1
+        elif ev is None:
+            if rec.get("resume"):
+                self.resumes += 1
+            elif "iters" in rec and rec.get("event", "step") == "step":
+                # run-loop METRICS_FIELDS record, or an ensemble step record
+                self.steps.append(dict(rec, _stream=self._stream))
+
+    def add_file(self, path: str):
+        self._stream += 1
+        with open(path) as fh:
+            for line in fh:
+                self.add_line(line)
+
+    # ------------------------------------------------------------- render
+
+    def _span_section(self, out: list[str]):
+        if not self.spans:
+            return
+        out.append("== spans ==")
+        rows = [("span", "count", "total_s", "mean_s", "max_s")]
+        for path in sorted(self.spans):
+            durs = self.spans[path]
+            rows.append((path, str(len(durs)), _fmt_s(sum(durs)),
+                         _fmt_s(sum(durs) / len(durs)), _fmt_s(max(durs))))
+        widths = [max(len(r[i]) for r in rows) for i in range(5)]
+        out.extend("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                   for r in rows)
+        out.append("")
+
+    def _compile_section(self, out: list[str]):
+        if not self.compiles:
+            return
+        out.append("== compile events ==")
+        for rec in self.compiles:
+            out.append(
+                f"{rec.get('name', '?')}: trace #{rec.get('traces', '?')} "
+                f"wall={rec.get('wall_s', '?')}s "
+                f"trace={rec.get('trace_s', '?')}s "
+                f"donated={rec.get('donated', [])} "
+                f"sig={str(rec.get('arg_sig', ''))[:120]}")
+        by_name: dict[str, int] = {}
+        for rec in self.compiles:
+            by_name[rec.get("name", "?")] = by_name.get(
+                rec.get("name", "?"), 0) + 1
+        retraced = {n: c for n, c in by_name.items() if c > 1}
+        if retraced:
+            out.append("RETRACES: " + ", ".join(
+                f"{n} x{c}" for n, c in sorted(retraced.items())))
+        out.append("")
+
+    def _lane_section(self, out: list[str]):
+        if not self.lane_events and not self.lane_rounds:
+            return
+        out.append("== ensemble lanes ==")
+        if self.lane_events:
+            out.append("events: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(self.lane_events.items())))
+        if self.lane_rounds:
+            live = [float(r["live"]) for r in self.lane_rounds]
+            lanes = max(float(r["lanes"]) for r in self.lane_rounds)
+            occ = sum(live) / (len(live) * lanes) if lanes else 0.0
+            out.append(f"rounds: {len(self.lane_rounds)}  lanes: "
+                       f"{int(lanes)}  mean occupancy: {occ:.1%}")
+        out.append("")
+
+    def _convergence_section(self, out: list[str]):
+        if not self.steps:
+            return
+        out.append("== solver convergence ==")
+        n = len(self.steps)
+        accepted = sum(1 for s in self.steps if s.get("accepted"))
+        iters = [int(s.get("iters", 0)) for s in self.steps]
+        out.append(f"trial steps: {n}  accepted: {accepted}  "
+                   f"rejected: {n - accepted}"
+                   + (f"  (resume markers: {self.resumes})"
+                      if self.resumes else ""))
+        out.append(f"gmres iters: min {min(iters)}  "
+                   f"mean {sum(iters) / n:.1f}  max {max(iters)}")
+        cycles = [int(s["gmres_cycles"]) for s in self.steps
+                  if "gmres_cycles" in s]
+        if cycles:
+            out.append(f"gmres restart cycles: mean "
+                       f"{sum(cycles) / len(cycles):.1f}  max {max(cycles)}")
+        rt = [float(s["residual_true"]) for s in self.steps
+              if s.get("residual_true") is not None]
+        if rt:
+            out.append(f"explicit residual: max {max(rt):.3e}  "
+                       f"last {rt[-1]:.3e}")
+        refines = [int(s.get("refines", 0)) for s in self.steps]
+        if any(refines):
+            out.append(f"refinement sweeps: total {sum(refines)}  "
+                       f"max {max(refines)}")
+        loa = sum(1 for s in self.steps if s.get("loss_of_accuracy"))
+        if loa:
+            out.append(f"LOSS-OF-ACCURACY steps: {loa}")
+        # ensemble step records share one batched round's wall across every
+        # live lane (io.ensemble_io schema) — dedupe by (stream, round) so
+        # the total is the drain's wall, not lanes x wall, while rounds
+        # from DIFFERENT input files (ids restart at 0 per run) still
+        # count separately
+        walls: dict = {}
+        for i, s in enumerate(self.steps):
+            if "wall_ms" not in s:
+                continue
+            key = (("round", s.get("_stream", 0), s["round"])
+                   if "round" in s else ("step", 0, i))
+            walls[key] = float(s["wall_ms"])
+        if walls:
+            vals = list(walls.values())
+            label = ("batched-round wall"
+                     if any(k[0] == "round" for k in walls) else "step wall")
+            out.append(f"{label}: total {sum(vals) / 1e3:.3f}s  mean "
+                       f"{sum(vals) / len(vals):.1f}ms  "
+                       f"max {max(vals):.1f}ms")
+        hists = [s["gmres_history"] for s in self.steps
+                 if s.get("gmres_history")]
+        if hists:
+            last = hists[-1]
+            rows = ", ".join(f"({int(it)}it {im:.1e}/{ex:.1e})"
+                             for it, im, ex in last[-4:])
+            out.append(f"last step's restart history "
+                       f"(iters implicit/explicit): {rows}")
+        out.append("")
+
+    def render(self) -> str:
+        out: list[str] = []
+        if self.versions:
+            vs = ", ".join(str(v) for v in sorted(self.versions,
+                                                  key=lambda v: str(v)))
+            out.append(f"telemetry version(s): {vs}")
+            out.append("")
+        self._span_section(out)
+        self._compile_section(out)
+        self._lane_section(out)
+        self._convergence_section(out)
+        if self.unparsed:
+            out.append(f"({self.unparsed} unparseable line(s) skipped)")
+        if not out:
+            out.append("no telemetry or metrics records found")
+        return "\n".join(out).rstrip() + "\n"
+
+
+def summarize_files(paths) -> str:
+    s = Summary()
+    for p in paths:
+        s.add_file(p)
+    return s.render()

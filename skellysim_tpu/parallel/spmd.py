@@ -629,7 +629,11 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
             residual_true=result.residual_true,
             loss_of_accuracy=(result.converged
                               & (result.residual_true > 10.0 * p.gmres_tol)),
-            refines=jnp.asarray(result.refines, dtype=jnp.int32))
+            refines=jnp.asarray(result.refines, dtype=jnp.int32),
+            # skelly-scope gmres_cycles ride along; the convergence ring
+            # buffer stays None in the mesh program (a replicated [N,3]
+            # carry per shard buys nothing over the single-chip history)
+            cycles=jnp.asarray(result.cycles, dtype=jnp.int32))
         return new_state, (tuple(sol_fibs), sol_shell, sol_body), info
 
     # -------------------------------------------------------------- assembly
@@ -643,7 +647,8 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
     info_specs = jax.tree_util.tree_map(
         lambda _: P(), StepInfo(converged=0, iters=0, residual=0.0,
                                 fiber_error=0.0, residual_true=0.0,
-                                loss_of_accuracy=False, refines=0))
+                                loss_of_accuracy=False, refines=0,
+                                cycles=0, history=None))
     # check_vma off: the 0.4.x replication checker has no while-loop rule
     # (every solver loop is lax.while_loop), and replicated-output
     # correctness is guaranteed by construction here (psum-or-replicated

@@ -58,6 +58,13 @@ class Params:
     gmres_tol: float = 1e-10
     gmres_restart: int = 100
     gmres_maxiter: int = 1000
+    # skelly-scope convergence history: ring-buffer capacity (rows) of
+    # per-restart (iters, implicit, explicit) residuals carried device-side
+    # through the solve and surfaced as the metrics JSONL's `gmres_history`
+    # field (docs/observability.md). Pure masked writes — no host sync in
+    # the loop, so audit's host-sync contract stays empty. 0 disables (the
+    # [N,3] carry vanishes from the lowered program entirely).
+    gmres_history: int = 16
     fiber_error_tol: float = 1e-1
     seed: int = 1
     # pairwise-kernel backend, mirroring the reference's params.pair_evaluator

@@ -57,6 +57,20 @@ class GmresResult(NamedTuple):
     #: jnp scalar here would initialize the JAX backend at import time —
     #: a hang when the TPU tunnel is wedged).
     refines: int | jnp.ndarray = 0
+    #: int32, restart cycles taken (`gmres`: outer Arnoldi restart cycles;
+    #: `gmres_ir`: refinement sweeps, == refines) — the skelly-scope
+    #: `gmres_cycles` metric, and ALWAYS the number of rows written into
+    #: ``history`` (the `history_rows` decode invariant)
+    cycles: int | jnp.ndarray = 0
+    #: optional [history, 3] device-side ring buffer of per-restart
+    #: (cumulative iters, implicit residual, explicit residual) rows —
+    #: `gmres(history=N)`. Written with pure `.at[].set` updates inside the
+    #: solver loop (NO host callback: skelly-audit's host-sync contract
+    #: stays empty), read out host-side via `history_rows`. None when
+    #: disabled. `gmres_ir` records one row per refinement SWEEP
+    #: (cumulative inner iters, the sweep's inner implicit exit residual,
+    #: the f64 explicit residual after the update).
+    history: jnp.ndarray | None = None
 
 
 def _icgs(V, w, k, n_restart, rdot):
@@ -89,10 +103,11 @@ def _reductions(rdot):
 
 
 @partial(jax.jit, static_argnames=("matvec", "precond", "restart", "maxiter",
-                                   "debug", "rdot"))
+                                   "debug", "rdot", "history"))
 def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
           tol: float = 1e-10, restart: int = 100, maxiter: int = 1000,
-          debug: bool = False, rdot: Callable | None = None) -> GmresResult:
+          debug: bool = False, rdot: Callable | None = None,
+          history: int = 0) -> GmresResult:
     """Solve ``matvec(x) = b`` with right-preconditioned restarted GMRES.
 
     ``precond`` approximates A^-1 (applied on the right). Initial guess is zero,
@@ -109,6 +124,14 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
     at every restart boundary (one extra matvec per cycle), so the returned
     ``converged``/``residual_true`` can never disagree the way Belos'
     implicit test can (`solver_hydro.cpp:85-92`).
+
+    ``history=N`` (static) additionally carries an [N, 3] device-side ring
+    buffer of per-restart (cumulative iters, implicit, explicit) residual
+    rows through the outer loop — the skelly-scope convergence history
+    (docs/observability.md). Pure masked ``.at[].set`` writes, so the loop
+    stays free of host callbacks (audit's host-sync contract) and batches
+    under `vmap` like every other carry; unwritten rows stay NaN. Read it
+    out with `history_rows(result.history, result.cycles)`.
     """
     n = b.shape[0]
     dtype = b.dtype
@@ -185,8 +208,9 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
         return x0 + dx, resid, k
 
     def outer_cond(state):
-        x, r, resid_true, prev_true, resid_impl, total_iters, cycles = state
-        del x, r, cycles
+        (x, r, resid_true, prev_true, resid_impl, total_iters, cycles,
+         hist) = state
+        del x, r, cycles, hist
         # acceptance on the EXPLICIT residual: with restarts + a right
         # preconditioner the implicit (Givens) residual drifts from the true
         # one, and Belos' loss-of-accuracy warning (`solver_hydro.cpp:85-92`)
@@ -199,7 +223,7 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
         return (resid_true > tol) & (total_iters < maxiter) & ~stalled
 
     def outer_body(state):
-        x, r, resid_true, _, _, total_iters, cycles = state
+        x, r, resid_true, _, _, total_iters, cycles, hist = state
         x, resid_impl, k = arnoldi_cycle(x, r)
         r = b - matvec(x)
         prev_true = resid_true
@@ -209,27 +233,36 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
                 "gmres restart {c}: iters={i} implicit={ri:.3e} "
                 "explicit={re:.3e}",
                 c=cycles + 1, i=total_iters + k, ri=resid_impl, re=resid_true)
-        return x, r, resid_true, prev_true, resid_impl, total_iters + k, cycles + 1
+        if history > 0:
+            row = jnp.stack([(total_iters + k).astype(dtype), resid_impl,
+                             resid_true])
+            hist = hist.at[lax.rem(cycles, jnp.int32(history))].set(row)
+        return (x, r, resid_true, prev_true, resid_impl, total_iters + k,
+                cycles + 1, hist)
 
     x0 = jnp.zeros_like(b)
     init_resid = jnp.where(b_norm > 0.0, jnp.array(jnp.inf, dtype=dtype), jnp.array(0.0, dtype=dtype))
-    x, _, resid_true, _, resid_impl, iters, _ = lax.while_loop(
+    hist0 = jnp.full((max(history, 0), 3), jnp.nan, dtype=dtype)
+    x, _, resid_true, _, resid_impl, iters, cycles, hist = lax.while_loop(
         outer_cond, outer_body,
-        (x0, b, init_resid, init_resid, init_resid, jnp.int32(0), jnp.int32(0)))
+        (x0, b, init_resid, init_resid, init_resid, jnp.int32(0),
+         jnp.int32(0), hist0))
     # converged like Belos (either measure passed); residual_true lets the
     # caller's loss-of-accuracy gate flag implicit-only convergence
     return GmresResult(x=x, iters=iters, residual=resid_impl,
                        converged=(resid_true <= tol) | (resid_impl <= tol),
-                       residual_true=resid_true)
+                       residual_true=resid_true, cycles=cycles,
+                       history=hist if history > 0 else None)
 
 
 @partial(jax.jit, static_argnames=("matvec_hi", "matvec_lo", "precond_lo",
                                    "restart", "maxiter", "max_refine",
-                                   "rdot"))
+                                   "rdot", "history"))
 def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
              precond_lo: Callable | None = None, tol: float = 1e-10,
              inner_tol: float = 1e-5, restart: int = 100, maxiter: int = 1000,
-             max_refine: int = 8, rdot: Callable | None = None) -> GmresResult:
+             max_refine: int = 8, rdot: Callable | None = None,
+             history: int = 0) -> GmresResult:
     """Mixed-precision GMRES with iterative refinement.
 
     The TPU-native answer to the reference's f64 accuracy gates (GMRES tol
@@ -253,7 +286,11 @@ def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
 
     Returns a `GmresResult` whose ``residual`` IS the explicit f64 relative
     residual (no implicit/explicit drift possible, unlike plain restarted
-    GMRES).
+    GMRES). ``history=N`` records one ring-buffer row per refinement SWEEP
+    — (cumulative inner iters, the sweep's inner implicit exit residual,
+    the f64 explicit residual after the correction) — all in ``b.dtype``
+    (no narrow->wide promotion edges: the inner solve's vectors already
+    carry ``b.dtype``, only its interior is f32).
     """
     M = precond_lo if precond_lo is not None else (lambda v: v)
     _norm = _reductions(rdot)[1]
@@ -261,27 +298,62 @@ def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
     safe_b_norm = jnp.where(b_norm > 0.0, b_norm, 1.0)
 
     def cond(state):
-        x, r, r_rel, outer, total = state
-        del x, r
+        x, r, r_rel, outer, total, hist = state
+        del x, r, hist
         return (r_rel > tol) & (outer < max_refine)
 
     def body(state):
-        x, r, _, outer, total = state
+        x, r, _, outer, total, hist = state
         d = gmres(matvec_lo, r, precond=M, tol=inner_tol,
                   restart=restart, maxiter=maxiter, rdot=rdot)
         x = x + d.x
         r = b - matvec_hi(x)
         r_rel = _norm(r) / safe_b_norm
-        return x, r, r_rel, outer + 1, total + d.iters
+        if history > 0:
+            row = jnp.stack([(total + d.iters).astype(b.dtype), d.residual,
+                             r_rel])
+            hist = hist.at[lax.rem(outer, jnp.int32(history))].set(row)
+        return x, r, r_rel, outer + 1, total + d.iters, hist
 
     x0 = jnp.zeros_like(b)
     init_rel = jnp.where(b_norm > 0.0, jnp.asarray(jnp.inf, dtype=b.dtype),
                          jnp.asarray(0.0, dtype=b.dtype))
-    x, _, r_rel, outers, iters = lax.while_loop(
-        cond, body, (x0, b, init_rel, jnp.int32(0), jnp.int32(0)))
+    hist0 = jnp.full((max(history, 0), 3), jnp.nan, dtype=b.dtype)
+    x, _, r_rel, outers, iters, hist = lax.while_loop(
+        cond, body, (x0, b, init_rel, jnp.int32(0), jnp.int32(0), hist0))
+    # `cycles` == ring rows written, for BOTH solvers (`history_rows`
+    # decodes on that invariant): here each refinement sweep writes one row
     return GmresResult(x=x, iters=iters, residual=r_rel,
                        converged=r_rel <= tol, residual_true=r_rel,
-                       refines=outers)
+                       refines=outers, cycles=outers,
+                       history=hist if history > 0 else None)
+
+
+def history_rows(history, cycles) -> list:
+    """Chronological ``[iters, implicit, explicit]`` rows actually written
+    into a convergence ring buffer — the host-side decode for the
+    ``gmres_history`` metrics field (docs/observability.md).
+
+    Handles ring wrap: with ``cycles > len(history)`` the buffer holds the
+    LAST ``len(history)`` cycles, rotated so the oldest surviving row comes
+    first. Host-only (called from the run loop / scheduler after the device
+    fetch — never inside jitted code).
+    """
+    import numpy as np
+
+    if history is None:
+        return []
+    h = np.asarray(history)
+    c = int(cycles)
+    cap = h.shape[0]
+    if cap == 0 or c == 0:
+        return []
+    if c <= cap:
+        rows = h[:c]
+    else:
+        start = c % cap
+        rows = np.concatenate([h[start:], h[:start]], axis=0)
+    return [[int(r[0]), float(r[1]), float(r[2])] for r in rows]
 
 
 # ---------------------------------------------------------------- skelly-audit

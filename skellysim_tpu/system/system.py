@@ -26,10 +26,13 @@ logger = logging.getLogger("skellysim_tpu")
 
 from ..bodies import bodies as bd
 from ..fibers import container as fc
+from ..obs import tracer as obs_tracer
+from ..obs.compile_log import observed_jit
 from ..params import Params, REFINE_PAIR_IMPLS
 from ..periphery import periphery as peri
 from ..periphery.periphery import PeripheryShape, PeripheryState
 from ..solver import gmres, gmres_ir
+from ..solver.gmres import history_rows
 from .sources import BackgroundFlow, PointSources
 
 
@@ -85,9 +88,9 @@ def _rewrap_fibers(fibers, new_buckets: tuple):
 #: docs/performance.md "Run-loop metrics JSONL"; schema-pinned by
 #: tests/test_cli_pipeline.py). Resumed runs are segmented by a marker line
 #: {"resume": true, "t": ...} that `cli.run(resume=True)` appends first.
-METRICS_FIELDS = ("step", "t", "dt", "iters", "residual", "residual_true",
-                  "fiber_error", "accepted", "refines", "loss_of_accuracy",
-                  "wall_s")
+METRICS_FIELDS = ("step", "t", "dt", "iters", "gmres_cycles", "residual",
+                  "residual_true", "fiber_error", "accepted", "refines",
+                  "loss_of_accuracy", "wall_s", "wall_ms", "gmres_history")
 
 
 def crossed_write_boundary(t_new: float, dt: float, dt_write: float) -> bool:
@@ -123,6 +126,12 @@ class StepInfo(NamedTuple):
     loss_of_accuracy: jnp.ndarray = False
     #: mixed-mode refinement sweeps (`solver.gmres_ir`); 0 for full precision
     refines: int | jnp.ndarray = 0
+    #: GMRES restart cycles (skelly-scope `gmres_cycles`)
+    cycles: int | jnp.ndarray = 0
+    #: per-restart convergence ring buffer ([gmres_history, 3] rows of
+    #: cumulative iters / implicit / explicit; `solver.gmres` docstring) or
+    #: None when Params.gmres_history == 0
+    history: jnp.ndarray | None = None
 
 
 def solution_from_state(state: SimState):
@@ -178,8 +187,14 @@ class System:
         if params.precond not in ("gs", "jacobi"):
             raise ValueError(
                 f"unknown precond {params.precond!r}; use 'gs' or 'jacobi'")
-        self._solve_jit = jax.jit(self._solve_impl,
-                                  static_argnames=("ewald_plan",))
+        # all entry-point jits route through `obs.compile_log.observed_jit`
+        # (a `jax.jit` twin): with a tracer active (System.run(trace_path=),
+        # the ensemble/bench paths) every fresh trace/compile lands in the
+        # telemetry stream as a `compile` event; without one the wrapper is
+        # a counter bump per call. `.trace()` passes through, so the audit
+        # registry's `built_from` keeps consuming these directly.
+        self._solve_jit = observed_jit(self._solve_impl, name="system.solve",
+                                       static_argnames=("ewald_plan",))
         # donating twin for the run loop: the input state's buffers (the
         # dense shell operators above all) alias into the unchanged output
         # leaves instead of double-buffering per step. Only safe where a
@@ -187,15 +202,18 @@ class System:
         # selects it exactly when the adaptive gate is off; CPU XLA has no
         # donation (it would warn per call), so there it is never selected
         # (tests pin the aliasing at lowering time instead).
-        self._solve_jit_donated = jax.jit(self._solve_impl,
-                                          static_argnames=("ewald_plan",),
-                                          donate_argnums=(0,))
+        self._solve_jit_donated = observed_jit(self._solve_impl,
+                                               name="system.solve_donated",
+                                               static_argnames=("ewald_plan",),
+                                               donate_argnums=(0,))
         #: built SPMD step programs keyed by (mesh, state structure) —
         #: see `step_spmd`
         self._spmd_steps = {}
-        self._collision_jit = jax.jit(self._check_collision)
-        self._vel_jit = jax.jit(self._velocity_at_targets_impl,
-                                static_argnames=("ewald_plan",))
+        self._collision_jit = observed_jit(self._check_collision,
+                                           name="system.collision")
+        self._vel_jit = observed_jit(self._velocity_at_targets_impl,
+                                     name="system.velocity_at_targets",
+                                     static_argnames=("ewald_plan",))
 
     @property
     def _refine_impl(self) -> str:
@@ -787,7 +805,7 @@ class System:
                     ewald_anchors=ewald_anchors),
                 tol=p.gmres_tol, inner_tol=p.inner_tol,
                 restart=p.gmres_restart, maxiter=p.gmres_maxiter,
-                max_refine=p.max_refine)
+                max_refine=p.max_refine, history=p.gmres_history)
         else:
             result = gmres(
                 lambda v: self._apply_matvec(state, caches, body_caches, v,
@@ -797,7 +815,8 @@ class System:
                 precond=lambda v: self._apply_precond(
                     state, caches, body_caches, v, ewald_plan=ewald_plan,
                     ewald_anchors=ewald_anchors),
-                tol=p.gmres_tol, restart=p.gmres_restart, maxiter=p.gmres_maxiter)
+                tol=p.gmres_tol, restart=p.gmres_restart,
+                maxiter=p.gmres_maxiter, history=p.gmres_history)
 
         fib_size, shell_size, body_size = self._sizes(state)
         new_state = state
@@ -853,7 +872,8 @@ class System:
                         loss_of_accuracy=(result.converged
                                           & (result.residual_true
                                              > 10.0 * p.gmres_tol)),
-                        refines=result.refines)
+                        refines=result.refines, cycles=result.cycles,
+                        history=result.history)
         return new_state, result.x, info
 
     # -------------------------------------------------------- velocity field
@@ -1082,10 +1102,13 @@ class System:
                state.shell.n_nodes if state.shell is not None else 0)
         fn = self._spmd_steps.get(key)
         if fn is None:
+            from ..obs.compile_log import jit_wrapper
+
             fn = build_spmd_step(
                 self, mesh, state,
                 allow_replicated_shell=allow_replicated_shell,
-                flat_solution=flat_solution, donate=donate)
+                flat_solution=flat_solution, donate=donate,
+                jit_wrapper=jit_wrapper(f"step_spmd_d{mesh.size}"))
             self._spmd_steps[key] = fn
         return fn(state)
 
@@ -1108,7 +1131,7 @@ class System:
 
     def run(self, state: SimState, *, writer=None, max_steps: int | None = None,
             rng=None, metrics_path: str | None = None,
-            profile_dir: str | None = None):
+            profile_dir: str | None = None, trace_path: str | None = None):
         """Adaptive time loop (`run`, `system.cpp:516-571`).
 
         Host-side control flow around the jit'd step: accept/reject on fiber
@@ -1122,8 +1145,13 @@ class System:
 
         Each trial step is logged (the reference's per-step spdlog lines,
         `system.cpp:474,567`); ``metrics_path`` additionally appends one JSON
-        line per step {t, dt, iters, residual, fiber_error, accepted, wall_s}
-        — the structured-metrics upgrade SURVEY.md §5.1 calls for.
+        line per step (key set == `METRICS_FIELDS`) — the structured-metrics
+        upgrade SURVEY.md §5.1 calls for. ``trace_path`` opens a skelly-scope
+        telemetry stream for the loop (span events per trial step, compile
+        events from every jit entry point — docs/observability.md; render
+        with `python -m skellysim_tpu.obs summarize`). An externally
+        installed tracer (`obs.tracer.use`) is honored when ``trace_path``
+        is None, so callers can aggregate several runs into one stream.
         """
         import contextlib
 
@@ -1133,12 +1161,18 @@ class System:
         # with TensorBoard or xprof
         prof = (jax.profiler.trace(profile_dir) if profile_dir is not None
                 else contextlib.nullcontext())
+        tracer = obs_tracer.Tracer(trace_path) if trace_path else None
+        scope = (obs_tracer.use(tracer) if tracer is not None
+                 else contextlib.nullcontext())
         try:
-            with prof:
-                state = self._run_loop(state, writer=writer,
-                                       max_steps=max_steps, rng=rng,
-                                       metrics_fh=metrics_fh)
+            with prof, scope:
+                with obs_tracer.span("run", t_final=self.params.t_final):
+                    state = self._run_loop(state, writer=writer,
+                                           max_steps=max_steps, rng=rng,
+                                           metrics_fh=metrics_fh)
         finally:
+            if tracer is not None:
+                tracer.close()
             if metrics_fh is not None:
                 metrics_fh.close()
         return state
@@ -1165,19 +1199,23 @@ class System:
                 # a ring mesh constrains nucleation's capacity growth to
                 # mesh-divisible node counts (grow_capacity invariant)
                 nm = self.mesh.size if self._ring_active() else 1
-                state = apply_dynamic_instability(state, p, rng,
-                                                  node_multiple=nm)
+                with obs_tracer.span("dynamic_instability"):
+                    state = apply_dynamic_instability(state, p, rng,
+                                                      node_multiple=nm)
             # snapshot the time scalars BEFORE the step: with donation on,
             # the step consumes the input state's buffers
             t_cur = float(state.time)
             dt = float(state.dt)
-            wall0 = _time.perf_counter()
-            new_state, solution, info = step_fn(state)
-            # host fetch, not block_until_ready: blocking on one leaf was
-            # observed returning before the program finished, undermeasuring
-            # wall_s by >100x
-            residual = float(info.residual)
-            wall_s = _time.perf_counter() - wall0
+            with obs_tracer.span("step", step=n_steps) as sp:
+                wall0 = _time.perf_counter()
+                new_state, solution, info = step_fn(state)
+                # host fetch, not block_until_ready: blocking on one leaf
+                # was observed returning before the program finished,
+                # undermeasuring wall_s by >100x — the fetch doubles as the
+                # span's device-work sync
+                residual = float(info.residual)
+                wall_s = _time.perf_counter() - wall0
+                sp.note(iters=int(info.iters), residual=residual)
             n_steps += 1
             converged = bool(info.converged)
             fiber_error = float(info.fiber_error)
@@ -1230,12 +1268,16 @@ class System:
                 metrics_fh.write(json.dumps({
                     "step": n_steps - 1,
                     "t": t_cur, "dt": dt, "iters": int(info.iters),
+                    "gmres_cycles": int(info.cycles),
                     "residual": residual,
                     "residual_true": float(info.residual_true),
                     "fiber_error": fiber_error, "accepted": accept,
                     "refines": int(info.refines),
                     "loss_of_accuracy": bool(info.loss_of_accuracy),
-                    "wall_s": round(wall_s, 4)}) + "\n")
+                    "wall_s": round(wall_s, 4),
+                    "wall_ms": round(wall_s * 1e3, 3),
+                    "gmres_history": history_rows(info.history,
+                                                  info.cycles)}) + "\n")
                 metrics_fh.flush()
 
             if accept:
@@ -1245,10 +1287,12 @@ class System:
                     dt=jnp.asarray(dt_new, dtype=state.dt.dtype))
                 if writer is not None and crossed_write_boundary(
                         t_new, dt, p.dt_write):
-                    if rng is not None:
-                        writer(state, solution, rng_state=rng.dump_state())
-                    else:
-                        writer(state, solution)
+                    with obs_tracer.span("write_frame", t=t_new):
+                        if rng is not None:
+                            writer(state, solution,
+                                   rng_state=rng.dump_state())
+                        else:
+                            writer(state, solution)
             else:
                 state = backup._replace(dt=jnp.asarray(dt_new, dtype=state.dt.dtype))
         return state

@@ -28,8 +28,11 @@ def test_bench_prints_one_parseable_json_line(tmp_path):
     env.update({"BENCH_FORCE_CPU": "1", "BENCH_BUDGET_S": "120",
                 "BENCH_PROBE_S": "1",
                 # keep this smoke run's partial ladder out of the real
-                # MULTICHIP_r06.json artifact
-                "BENCH_MULTICHIP_PATH": str(tmp_path / "MULTICHIP.json")})
+                # MULTICHIP_r06.json artifact, and its span stream out of
+                # the real .bench_trace.jsonl (the parent DELETES the
+                # trace path at startup)
+                "BENCH_MULTICHIP_PATH": str(tmp_path / "MULTICHIP.json"),
+                "BENCH_TRACE_PATH": str(tmp_path / "bench_trace.jsonl")})
     env.pop("JAX_PLATFORMS", None)
     # scrub the conftest's 8-virtual-device pin too: a real `python bench.py`
     # run sees the host's devices, not cores split 8 ways (which slows every
@@ -49,10 +52,15 @@ def test_bench_prints_one_parseable_json_line(tmp_path):
         assert len(lines) == 1, f"stdout must be ONE json line, got: {lines!r}"
         doc = json.loads(lines[0])
         for key in ("metric", "value", "unit", "vs_baseline", "backend",
-                    "extra"):
+                    "telemetry_version", "extra"):
             assert key in doc, f"missing {key!r}"
         assert doc["metric"] != "bench_failed", doc
         assert isinstance(doc["value"], (int, float))
+        # artifacts share the skelly-scope format stamp (one-format pin;
+        # test_obs.py asserts the literal tracks obs.tracer's)
+        from skellysim_tpu.obs.tracer import TELEMETRY_VERSION
+
+        assert doc["telemetry_version"] == TELEMETRY_VERSION
         # CPU-forced run must be flagged, never silently downscaled
         assert doc["extra"].get("downscaled") is True
         # the mirror artifact parses identically

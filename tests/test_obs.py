@@ -1,0 +1,530 @@
+"""skelly-scope: span tracing, compile events, cost baselines, convergence
+history (docs/observability.md).
+
+Covers every leg of the telemetry subsystem: span nesting/attribution in
+the tracer, compile events firing exactly once per compiled program
+(cross-checked against `testing.trace_counting_jit`), the cost-baseline
+drift gate's flag/pass/suppress/drift ladder (synthetic programs + the real
+CLI on the cheapest registered program), and the GMRES convergence ring
+buffer against the solver's own debug-print residuals. Multi-device
+fixture compiles stay out of this module (the cost CLI test restricts to
+``gmres_f32``) to protect the not-slow tier's 870 s budget.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from skellysim_tpu.obs import tracer as obs_tracer
+from skellysim_tpu.obs.compile_log import observed_jit
+from skellysim_tpu.obs.tracer import TELEMETRY_VERSION, Tracer
+
+
+# ------------------------------------------------------------------ tracer
+
+def test_span_nesting_and_attribution():
+    tr = Tracer()  # in-memory
+    with obs_tracer.use(tr):
+        with obs_tracer.span("outer", kind="test"):
+            with obs_tracer.span("inner") as sp:
+                sp.note(iters=3)
+            with obs_tracer.span("inner"):
+                pass
+    evs = tr.events
+    assert evs[0]["ev"] == "telemetry"
+    assert evs[0]["version"] == TELEMETRY_VERSION
+    spans = [e for e in evs if e["ev"] == "span"]
+    # children close before their parent; paths carry the open stack
+    assert [s["path"] for s in spans] == ["outer/inner", "outer/inner",
+                                         "outer"]
+    assert spans[0]["iters"] == 3
+    assert spans[2]["kind"] == "test"
+    assert all(s["dur_s"] >= 0.0 and "pid" in s and "host" in s
+               for s in spans)
+    # the parent's duration covers its children
+    assert spans[2]["dur_s"] >= spans[0]["dur_s"] + spans[1]["dur_s"]
+
+
+def test_span_sync_blocks_on_device_work():
+    tr = Tracer()
+    with obs_tracer.use(tr):
+        with obs_tracer.span("work") as sp:
+            sp.sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    (span,) = [e for e in tr.events if e["ev"] == "span"]
+    assert span["name"] == "work"
+
+
+def test_span_and_emit_are_noops_without_tracer():
+    assert obs_tracer.active() is None
+    with obs_tracer.span("nobody-listening") as sp:
+        sp.note(x=1)
+        sp.sync(jnp.zeros(3))
+    obs_tracer.emit("lane", action="admit")  # must not raise
+
+
+def test_tracer_jsonl_roundtrip(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tr = Tracer(path)
+    with tr.span("a"):
+        tr.emit("custom", value=7)
+    tr.close()
+    recs = [json.loads(ln) for ln in open(path)]
+    assert [r["ev"] for r in recs] == ["telemetry", "custom", "span"]
+    assert recs[1]["value"] == 7
+
+
+# ----------------------------------------------------------- compile events
+
+def test_compile_events_fire_exactly_once_per_program():
+    """One compile event per (program x signature) — cross-checked against
+    trace_counting_jit semantics via the shared trace counter."""
+    from skellysim_tpu.testing import trace_counting_jit
+
+    def f(x):
+        return (x * 2.0).sum()
+
+    obs = observed_jit(f, name="toy")
+    ref = trace_counting_jit(f)
+    tr = Tracer()
+    with obs_tracer.use(tr):
+        x = jnp.ones(8)
+        obs(x), ref(x)
+        obs(x + 1.0), ref(x + 1.0)      # same signature: no event
+        obs(jnp.ones(16)), ref(jnp.ones(16))  # new shape: one more event
+    compiles = [e for e in tr.events if e["ev"] == "compile"]
+    assert len(compiles) == 2
+    assert obs.trace_count == ref.trace_count == 2
+    assert [c["name"] for c in compiles] == ["toy", "toy"]
+    assert compiles[0]["arg_sig"].startswith("f64[8]")
+    assert compiles[1]["arg_sig"].startswith("f64[16]")
+    assert all(c["wall_s"] >= c["trace_s"] >= 0.0 for c in compiles)
+
+
+def test_compile_event_skipped_when_warm():
+    """A tracer installed AFTER the program compiled sees no event — only
+    genuine compiles land in the timeline."""
+    g = observed_jit(lambda x: x + 1.0, name="warm")
+    g(jnp.ones(4))
+    tr = Tracer()
+    with obs_tracer.use(tr):
+        g(jnp.ones(4))
+    assert [e for e in tr.events if e["ev"] == "compile"] == []
+
+
+def test_observed_jit_trace_passthrough_and_donation_field():
+    """`built_from` consumes ObservedJit directly (the audit/cost seam) and
+    the compile event carries the donated argument positions."""
+    from skellysim_tpu.audit.registry import built_from
+
+    h = observed_jit(lambda x: x * 3.0, name="donating", donate_argnums=(0,))
+    built = built_from(h, jnp.ones(4))
+    assert built.lowered is not None
+    assert "stablehlo" in built.lowered_text or "func.func" in built.lowered_text
+    tr = Tracer()
+    with obs_tracer.use(tr):
+        h(jnp.ones(8))
+    (ev,) = [e for e in tr.events if e["ev"] == "compile"]
+    assert ev["donated"] == [0]
+
+
+# ------------------------------------------------------------ cost baselines
+
+def _toy_program(name="toy_prog", scale=1.0):
+    from skellysim_tpu.audit.registry import AuditProgram, built_from
+
+    def build():
+        a = jnp.ones((32, 32)) * scale
+        return built_from(jax.jit(lambda x: (x @ x).sum()), a)
+
+    return AuditProgram(name=name, layer="solver", summary="toy", build=build)
+
+
+def test_cost_uncovered_then_update_then_pass(tmp_path):
+    from skellysim_tpu.obs import cost
+
+    prog = _toy_program()
+    bdir = str(tmp_path)
+    rows, findings = cost.audit_costs([prog], baseline_dir=bdir)
+    assert any("no cost baseline" in f.message for f in findings)
+    assert rows[0]["flops"] > 0 and rows[0]["peak_bytes"] > 0
+
+    rows, findings = cost.audit_costs([prog], baseline_dir=bdir, update=True)
+    assert findings == []
+    rows, findings = cost.audit_costs([prog], baseline_dir=bdir)
+    assert findings == []  # measured == baseline: deterministic static analysis
+
+
+def test_cost_drift_flagged_and_suppressible(tmp_path):
+    from skellysim_tpu.config import toml_io
+    from skellysim_tpu.obs import cost
+
+    prog = _toy_program()
+    bdir = str(tmp_path)
+    cost.audit_costs([prog], baseline_dir=bdir, update=True)
+    path = cost.baseline_path(prog.name, bdir)
+    data = toml_io.load(path)
+    data["cost"]["flops"] = data["cost"]["flops"] * 2.0  # fake a regression
+    toml_io.dump(data, path)
+    _, findings = cost.audit_costs([prog], baseline_dir=bdir)
+    assert any("flops drifted" in f.message and "improvement" in f.message
+               for f in findings)
+
+    # suppression with a reason absorbs it; an unused one is itself a finding
+    data["suppress"] = [{"check": "cost-baseline", "match": "flops drifted",
+                         "reason": "testing the suppress path"}]
+    toml_io.dump(data, path)
+    _, findings = cost.audit_costs([prog], baseline_dir=bdir)
+    assert findings == []
+    data["cost"]["flops"] = data["cost"]["flops"] / 2.0  # back to truth
+    toml_io.dump(data, path)
+    _, findings = cost.audit_costs([prog], baseline_dir=bdir)
+    assert any("unused suppression" in f.message for f in findings)
+
+
+def test_cost_suppress_requires_reason_and_match(tmp_path):
+    from skellysim_tpu.config import toml_io
+    from skellysim_tpu.obs import cost
+
+    prog = _toy_program()
+    bdir = str(tmp_path)
+    cost.audit_costs([prog], baseline_dir=bdir, update=True)
+    path = cost.baseline_path(prog.name, bdir)
+    data = toml_io.load(path)
+    data["suppress"] = [{"check": "cost-baseline", "match": "flops"}]
+    toml_io.dump(data, path)
+    _, findings = cost.audit_costs([prog], baseline_dir=bdir)
+    assert any("missing its reason" in f.message for f in findings)
+
+
+def test_cost_stale_baseline_and_tol_pct(tmp_path):
+    from skellysim_tpu.config import toml_io
+    from skellysim_tpu.obs import cost
+
+    prog = _toy_program()
+    bdir = str(tmp_path)
+    cost.audit_costs([prog], baseline_dir=bdir, update=True)
+    # a generous tol_pct absorbs a small nudge (and --update preserves it)
+    path = cost.baseline_path(prog.name, bdir)
+    data = toml_io.load(path)
+    data["cost"]["tol_pct"] = 90.0
+    data["cost"]["flops"] = data["cost"]["flops"] * 1.5
+    toml_io.dump(data, path)
+    _, findings = cost.audit_costs([prog], baseline_dir=bdir)
+    assert findings == []
+    cost.audit_costs([prog], baseline_dir=bdir, update=True)
+    assert toml_io.load(path)["cost"]["tol_pct"] == 90.0
+    # a baseline whose program vanished is a finding
+    _, findings = cost.audit_costs([_toy_program(name="other")],
+                                   baseline_dir=bdir)
+    assert any("stale baseline" in f.message for f in findings)
+    assert any("no cost baseline" in f.message for f in findings)
+
+
+def test_cost_cli_exit_codes(tmp_path):
+    """`obs cost --check` exits 1 on drift/uncovered, 0 once baselined —
+    on the real registry restricted to its cheapest program (gmres_f32;
+    the multi-device programs stay in the CI gate, not the test tier)."""
+    from skellysim_tpu.obs.cli import main
+
+    bdir = str(tmp_path)
+    assert main(["cost", "--check", "--program", "gmres_f32",
+                 "--baseline-dir", bdir]) == 1  # uncovered
+    # findings exit 1 with or without --check (mirrors lint/audit)
+    assert main(["cost", "--program", "gmres_f32",
+                 "--baseline-dir", bdir]) == 1
+    assert main(["cost", "--update", "--program", "gmres_f32",
+                 "--baseline-dir", bdir]) == 0
+    assert main(["cost", "--check", "--program", "gmres_f32",
+                 "--baseline-dir", bdir]) == 0
+    assert main(["cost", "--check", "--update"]) == 2  # usage error
+    assert main(["cost", "--check", "--program", "nope",
+                 "--baseline-dir", bdir]) == 2
+    # against the REAL baseline dir, a single-program run must not read
+    # the other programs' baselines as stale (the --program workflow)
+    assert main(["cost", "--check", "--program", "gmres_f32"]) == 0
+
+
+def test_cost_stale_scan_uses_full_registry_names(tmp_path):
+    from skellysim_tpu.obs import cost
+
+    a, b = _toy_program(name="prog_a"), _toy_program(name="prog_b")
+    bdir = str(tmp_path)
+    cost.audit_costs([a, b], baseline_dir=bdir, update=True)
+    # auditing only prog_a with the full name set: prog_b's baseline is fine
+    _, findings = cost.audit_costs([a], baseline_dir=bdir,
+                                   registry_names={"prog_a", "prog_b"})
+    assert findings == []
+    # without the full set (a caller that filtered and forgot): stale
+    _, findings = cost.audit_costs([a], baseline_dir=bdir)
+    assert any("stale baseline" in f.message for f in findings)
+
+
+def test_every_registered_program_has_a_checked_in_baseline():
+    """Acceptance pin: the registry and obs/baselines/ agree exactly (the
+    full drift check runs in CI; here only the cheap file<->name match)."""
+    import os
+
+    from skellysim_tpu.audit.programs import all_programs
+    from skellysim_tpu.obs.cost import BASELINE_DIR
+
+    names = {p.name for p in all_programs()}
+    files = {os.path.splitext(f)[0] for f in os.listdir(BASELINE_DIR)
+             if f.endswith(".toml")}
+    assert names == files
+
+
+# ------------------------------------------------- gmres convergence history
+
+def _dense_problem(n=80, seed=3, dtype=jnp.float64):
+    rng = np.random.default_rng(seed)
+    A = jnp.asarray(np.eye(n) + 0.3 * rng.standard_normal((n, n)) / np.sqrt(n),
+                    dtype=dtype)
+    b = jnp.asarray(rng.standard_normal(n), dtype=dtype)
+    return A, b
+
+
+def test_gmres_history_matches_debug_print(capsys):
+    """The device-side ring buffer records the SAME per-restart residuals
+    the solver's debug path prints — without any host callback in the
+    compiled program (the debug path adds one; history must not)."""
+    from skellysim_tpu.solver.gmres import gmres, history_rows
+
+    A, b = _dense_problem()
+    r = gmres(lambda x: A @ x, b, tol=1e-12, restart=5, maxiter=200,
+              history=16, debug=True)
+    jax.effects_barrier()
+    printed = []
+    for ln in capsys.readouterr().out.splitlines():
+        if "gmres restart" in ln:
+            printed.append((int(ln.split("iters=")[1].split(" ")[0]),
+                            float(ln.split("implicit=")[1].split(" ")[0]),
+                            float(ln.split("explicit=")[1])))
+    rows = history_rows(r.history, r.cycles)
+    assert len(rows) == len(printed) == int(r.cycles) >= 3
+    for (it_h, imp_h, exp_h), (it_p, imp_p, exp_p) in zip(rows, printed):
+        assert it_h == it_p
+        assert imp_h == pytest.approx(imp_p, rel=2e-3)  # print is %.3e
+        assert exp_h == pytest.approx(exp_p, rel=2e-3)
+    assert rows[-1][2] == float(r.residual_true)
+
+
+def test_gmres_history_ring_wraps_chronologically():
+    from skellysim_tpu.solver.gmres import gmres, history_rows
+
+    A, b = _dense_problem()
+    full = gmres(lambda x: A @ x, b, tol=1e-12, restart=5, maxiter=200,
+                 history=32)
+    wrapped = gmres(lambda x: A @ x, b, tol=1e-12, restart=5, maxiter=200,
+                    history=3)
+    all_rows = history_rows(full.history, full.cycles)
+    last3 = history_rows(wrapped.history, wrapped.cycles)
+    assert int(full.cycles) > 3  # the wrap actually happened
+    assert len(last3) == 3
+    assert last3 == all_rows[-3:]  # ring holds the LAST cycles, oldest first
+    # disabled history costs nothing and changes nothing
+    off = gmres(lambda x: A @ x, b, tol=1e-12, restart=5, maxiter=200)
+    assert off.history is None
+    np.testing.assert_array_equal(np.asarray(off.x), np.asarray(full.x))
+
+
+def test_gmres_ir_history_one_row_per_sweep():
+    from skellysim_tpu.solver.gmres import gmres_ir, history_rows
+
+    A, b = _dense_problem()
+    r = gmres_ir(lambda x: A @ x, lambda x: A @ x, b, tol=1e-12,
+                 inner_tol=1e-4, restart=30, maxiter=200, history=8)
+    rows = history_rows(r.history, r.cycles)
+    assert len(rows) == int(r.refines) == int(r.cycles) >= 2
+    assert rows[-1][2] == float(r.residual_true)
+    exps = [row[2] for row in rows]
+    assert exps == sorted(exps, reverse=True)  # sweeps contract the residual
+
+
+def test_history_rows_handles_empty_and_none():
+    from skellysim_tpu.solver.gmres import history_rows
+
+    assert history_rows(None, 5) == []
+    assert history_rows(np.zeros((4, 3)), 0) == []
+    assert history_rows(np.zeros((0, 3)), 3) == []
+
+
+def test_vmapped_gmres_history_is_per_member():
+    """The ring buffer is an ordinary carry: vmap gives each member its own
+    buffer (the ensemble runner's per-lane convergence history)."""
+    from skellysim_tpu.solver.gmres import gmres, history_rows
+
+    A, b = _dense_problem()
+    bb = jnp.stack([b, 2.0 * b])
+    vr = jax.vmap(lambda bi: gmres(lambda x: A @ x, bi, tol=1e-12,
+                                   restart=5, maxiter=200, history=8))(bb)
+    assert vr.history.shape[0] == 2
+    r0 = history_rows(vr.history[0], vr.cycles[0])
+    r1 = history_rows(vr.history[1], vr.cycles[1])
+    # scaled RHS: same relative trajectory, per-member buffers decode alone
+    assert len(r0) == len(r1) == int(vr.cycles[0])
+    assert r0[-1][2] == pytest.approx(float(vr.residual_true[0]))
+
+
+# ---------------------------------------------------- run-loop + ensemble
+
+def test_run_metrics_and_trace_render_through_summarize(tmp_path):
+    """Acceptance criterion: System.run(metrics_path, trace_path) -> `obs
+    summarize` renders per-span timings, compile events, and convergence
+    stats from the pair."""
+    from skellysim_tpu.audit import fixtures
+    from skellysim_tpu.obs.summarize import summarize_files
+    from skellysim_tpu.system.system import METRICS_FIELDS
+
+    system = fixtures.make_system()
+    state = fixtures.free_state(system)
+    m = str(tmp_path / "metrics.jsonl")
+    t = str(tmp_path / "trace.jsonl")
+    system.run(state, max_steps=2, metrics_path=m, trace_path=t)
+
+    recs = [json.loads(ln) for ln in open(m)]
+    assert len(recs) == 2
+    for rec in recs:
+        assert set(rec) == set(METRICS_FIELDS)
+        assert rec["gmres_cycles"] >= 1
+        assert rec["wall_ms"] == pytest.approx(rec["wall_s"] * 1e3, rel=0.1)
+        hist = rec["gmres_history"]
+        assert len(hist) == rec["gmres_cycles"]
+        # last ring row's explicit residual is the step's residual_true
+        assert hist[-1][2] == pytest.approx(rec["residual_true"])
+        assert hist[-1][0] == rec["iters"]
+
+    evs = [json.loads(ln) for ln in open(t)]
+    kinds = [e["ev"] for e in evs]
+    assert kinds[0] == "telemetry"
+    assert "compile" in kinds and "span" in kinds
+    (compile_ev,) = [e for e in evs if e["ev"] == "compile"]
+    assert compile_ev["name"] == "system.solve"  # compiled exactly once
+    step_spans = [e for e in evs if e["ev"] == "span"
+                  and e["name"] == "step"]
+    assert len(step_spans) == 2
+    assert all(s["path"] == "run/step" for s in step_spans)
+
+    report = summarize_files([m, t])
+    for section in ("== spans ==", "== compile events ==",
+                    "== solver convergence =="):
+        assert section in report
+    assert "run/step" in report and "system.solve" in report
+
+
+@pytest.mark.slow
+def test_scheduler_lane_events_and_no_backfill_retrace(tmp_path):
+    """Lane admit/backfill/retire events flow through the tracer, occupancy
+    renders in summarize, and the telemetry does not break the
+    backfill-never-retraces invariant (trace_counting_jit cross-check).
+
+    Slow-marked (a 4-member batched-step compile) to keep the not-slow
+    tier inside the driver's 870 s budget; the full tier runs it."""
+    from skellysim_tpu.audit import fixtures
+    from skellysim_tpu.ensemble import (EnsembleRunner, EnsembleScheduler,
+                                        MemberSpec)
+    from skellysim_tpu.io.ensemble_io import ENSEMBLE_STEP_FIELDS
+    from skellysim_tpu.obs.summarize import summarize_files
+    from skellysim_tpu.system import BackgroundFlow
+    from skellysim_tpu.testing import trace_counting_jit
+
+    system = fixtures.make_system()
+    states = [system.make_state(
+        fibers=fixtures.make_fibers(n_fibers=2, n_nodes=8, seed=i),
+        background=BackgroundFlow.make(uniform=(1.0, 0.0, 0.0),
+                                       dtype=jnp.float64))
+        for i in range(4)]
+    members = [MemberSpec(member_id=f"m{i}", state=s, t_final=2e-3)
+               for i, s in enumerate(states)]
+    runner = EnsembleRunner(system)
+    counting = trace_counting_jit(runner.step_impl)
+
+    metrics_records = []
+    t = str(tmp_path / "trace.jsonl")
+    tr = Tracer(t)
+    with obs_tracer.use(tr):
+        sched = EnsembleScheduler(runner, members, 2,
+                                  metrics=metrics_records.append,
+                                  step_fn=counting)
+        retired = sched.run()
+    tr.close()
+    assert sorted(retired) == ["m0", "m1", "m2", "m3"]
+    # lane events: 2 admits (initial seats), 2 backfills, 4 retires — and
+    # backfill swapped member leaves without a retrace
+    evs = [json.loads(ln) for ln in open(t)]
+    lanes = [e for e in evs if e["ev"] == "lane"]
+    actions = [e["action"] for e in lanes]
+    assert actions.count("admit") == 2
+    assert actions.count("backfill") == 2
+    assert actions.count("retire") == 4
+    assert counting.trace_count == 1
+    steps = [r for r in metrics_records if r["event"] == "step"]
+    assert steps and all(set(r) == set(ENSEMBLE_STEP_FIELDS) for r in steps)
+    assert all(len(r["gmres_history"]) == r["gmres_cycles"] for r in steps)
+
+    report = summarize_files([t])
+    assert "== ensemble lanes ==" in report
+    assert "mean occupancy" in report
+    assert "admit=2" in report and "backfill=2" in report
+
+
+# ------------------------------------------------------------- bench format
+
+def test_bench_telemetry_version_pinned():
+    """bench.py's jax-free parent pins its own TELEMETRY_VERSION literal;
+    it must track obs.tracer's (the one-format contract)."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_version_pin", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert bench.TELEMETRY_VERSION == TELEMETRY_VERSION
+
+
+def test_summarize_tolerates_mixed_and_garbage_lines(tmp_path):
+    from skellysim_tpu.obs.summarize import summarize_files
+
+    p = str(tmp_path / "mixed.jsonl")
+    with open(p, "w") as fh:
+        fh.write("not json at all\n")
+        fh.write(json.dumps({"resume": True, "t": 0.5}) + "\n")
+        fh.write(json.dumps({"ev": "span", "name": "a", "path": "a",
+                             "dur_s": 0.5}) + "\n")
+        fh.write(json.dumps({"step": 0, "iters": 4, "accepted": True,
+                             "residual_true": 1e-11}) + "\n")
+    report = summarize_files([p])
+    assert "== spans ==" in report
+    assert "trial steps: 1" in report
+    assert "resume markers: 1" in report
+    assert "1 unparseable line(s) skipped" in report
+
+
+def test_summarize_dedupes_shared_round_wall(tmp_path):
+    """Ensemble step records share one batched round's wall across lanes;
+    the wall total must count each round once, not lanes x wall."""
+    from skellysim_tpu.obs.summarize import summarize_files
+
+    p = str(tmp_path / "ens.jsonl")
+    with open(p, "w") as fh:
+        for rnd in range(2):
+            for lane in range(4):
+                fh.write(json.dumps({
+                    "event": "step", "member": f"m{lane}", "lane": lane,
+                    "round": rnd, "step": rnd, "iters": 3, "accepted": True,
+                    "wall_ms": 10.0}) + "\n")
+    report = summarize_files([p])
+    # 2 rounds x 10 ms = 0.020 s — NOT 8 records x 10 ms = 0.080 s
+    assert "batched-round wall: total 0.020s" in report
+    # two runs' files summarized together: per-run round ids both start at
+    # 0, so the dedupe must key per stream — totals ADD across files
+    import shutil
+
+    p2 = str(tmp_path / "ens2.jsonl")
+    shutil.copy(p, p2)
+    assert "batched-round wall: total 0.040s" in summarize_files([p, p2])
