@@ -1203,12 +1203,107 @@ def _group_multichip(extra, ck, on_acc):
     publish()  # always leave an artifact, even if every rung was skipped
 
 
+#: repo-root artifact the treecode group writes (ISSUE 6: the measured
+#: O(N^2) -> O(N log N) crossover for the treecode pair evaluator).
+#: BENCH_TREECODE_PATH redirects it (the bench contract test points it at
+#: a tmp file so a budget-starved smoke run never clobbers the real curve)
+TREECODE_JSON_PATH = os.environ.get(
+    "BENCH_TREECODE_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "TREECODE_r06.json"))
+
+
+def _group_treecode(extra, ck, on_acc):
+    """ISSUE 6: wall + pairs/sec for the dense Stokeslet tile vs the
+    barycentric treecode (`ops.treecode`) at N in {1k, 4k, 16k, 64k}
+    fiber-like source nodes in f32 at tol 1e-4 — the f32 Krylov-interior
+    role the evaluator serves in the implicit solve. The tree's rate is
+    EQUIVALENT dense pairs/sec (N^2 / wall), so tree_vs_direct > 1 means
+    the treecode beats the O(N^2) tile outright; the smallest such N is
+    the measured crossover, recorded in TREECODE_r06.json
+    (downscale-flagged on CPU like MULTICHIP_r06)."""
+    import jax.numpy as jnp
+
+    from skellysim_tpu.ops import kernels
+    from skellysim_tpu.ops import treecode as tcode
+
+    tol = 1e-4
+    out = {"tol": tol, "dtype": "float32",
+           "ladder": [1024, 4096, 16384, 65536]}
+    if not on_acc:
+        _mark_downscaled(out, _CPU_FALLBACK)
+    extra["treecode"] = out
+    ck()
+
+    def publish():
+        doc = dict(out)
+        doc["generated_by"] = "bench.py --group treecode"
+        doc["backend"] = extra.get("backend")
+        doc["telemetry_version"] = TELEMETRY_VERSION
+        try:
+            with open(TREECODE_JSON_PATH, "w") as fh:
+                json.dump(doc, fh, indent=1)
+                fh.write("\n")
+            out.pop("artifact_error", None)
+        except Exception as e:
+            # never crash the measurement over an unwritable artifact path,
+            # but never hide it either — the marker rides into BENCH.json
+            out["artifact_error"] = _short_err(e)
+
+    rng = np.random.default_rng(61)
+    crossover = None
+    for n in out["ladder"]:
+        if _remaining() < 45:
+            out[f"n{n}"] = {"skipped_budget": int(_remaining())}
+            ck()
+            continue
+        row = {}
+        out[f"n{n}"] = row  # attached up front so error markers survive
+        try:
+            # constant-density fiber cloud (32-node fibers): the geometry
+            # whose O(N^2) matvec wall this evaluator exists to break
+            n_fib = max(n // 32, 1)
+            box = 4.0 * (n / 1024.0) ** (1.0 / 3.0)
+            origins = rng.uniform(-box / 2, box / 2, (n_fib, 3))
+            dirs = rng.normal(size=(n_fib, 3))
+            dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+            t = np.linspace(0.0, 1.0, 32)
+            pts = (origins[:, None, :] + t[None, :, None] * dirs[:, None, :]
+                   ).reshape(-1, 3)
+            r = jnp.asarray(pts, dtype=jnp.float32)
+            f = jnp.asarray(rng.standard_normal((n, 3)), dtype=jnp.float32)
+            plan = tcode.plan_tree(pts, tol=tol)
+            row["plan"] = {"depth": plan.depth, "order": plan.order,
+                           "max_occ": plan.max_occ}
+            rate_d = _rate(lambda: kernels.stokeslet_direct(r, r, f, 1.0),
+                           n * n, trials=2)
+            row["direct"] = {"gpairs_per_s": round(rate_d / 1e9, 4),
+                             "wall_s": round(n * n / rate_d, 4)}
+            rate_t = _rate(lambda: tcode.stokeslet_tree(plan, r, r, f, 1.0),
+                           n * n, trials=2)
+            row["tree"] = {"equiv_gpairs_per_s": round(rate_t / 1e9, 4),
+                           "wall_s": round(n * n / rate_t, 4)}
+            row["tree_vs_direct"] = round(rate_t / rate_d, 3)
+            if crossover is None and rate_t > rate_d:
+                crossover = n
+                out["crossover_n"] = crossover
+        except Exception as e:
+            row["error"] = _short_err(e)
+        ck()
+        publish()
+    out["crossover"] = (f"tree beats direct at N>={crossover}" if crossover
+                        else "no crossover within the benched ladder")
+    ck()
+    publish()  # always leave an artifact, even if every rung was skipped
+
+
 #: (name, budget weight) — children run in this order, each in its own
 #: subprocess; weights split the remaining wall budget
 GROUPS = [
     ("kernels", _group_kernels, 1.0),
     ("scale", _group_scale, 2.6),
     ("multichip", _group_multichip, 1.3),
+    ("treecode", _group_treecode, 1.0),
     ("solves", _group_solves, 1.0),
     ("coupled", _group_coupled, 2.6),
     ("cells", _group_cells, 1.8),

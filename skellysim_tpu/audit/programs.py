@@ -5,20 +5,21 @@ from __future__ import annotations
 
 def all_programs():
     """Every registered `AuditProgram`, in layer order (system → solver →
-    parallel → ensemble). Import is lazy per layer: registration must not
-    force the whole simulation stack (or a jax backend) into memory before
-    the CLI decides what to build."""
+    ops → parallel → ensemble). Import is lazy per layer: registration must
+    not force the whole simulation stack (or a jax backend) into memory
+    before the CLI decides what to build."""
     # import the module path directly: package __init__s re-export same-named
     # FUNCTIONS (`solver.gmres`), which would shadow `from ..solver import
     # gmres`-style module lookups
     from ..ensemble.runner import auditable_programs as ensemble_programs
+    from ..ops.treecode import auditable_programs as ops_programs
     from ..parallel.spmd import auditable_programs as parallel_programs
     from ..solver.gmres import auditable_programs as solver_programs
     from ..system.system import auditable_programs as system_programs
 
     progs = []
-    for layer in (system_programs, solver_programs, parallel_programs,
-                  ensemble_programs):
+    for layer in (system_programs, solver_programs, ops_programs,
+                  parallel_programs, ensemble_programs):
         progs.extend(layer())
     names = [p.name for p in progs]
     dupes = {n for n in names if names.count(n) > 1}

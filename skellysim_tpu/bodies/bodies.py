@@ -267,7 +267,8 @@ def update_RHS(group: BodyGroup, v_on_bodies):
 
 
 def flow(group: BodyGroup, caches: BodyCaches, r_trg, x_bodies, forces_torques,
-         eta, impl: str = "exact", ewald_plan=None, ewald_anchors=None):
+         eta, impl: str = "exact", ewald_plan=None, ewald_anchors=None,
+         pair=None, pair_anchors=None):
     """Body -> target velocities (`flow_spherical`, `body_container.cpp:269-339`):
     double-layer stresslet from node densities + Stokeslet from COM forces +
     rotlet from COM torques. ``forces_torques`` is [nb, 6]. Pass
@@ -278,16 +279,30 @@ def flow(group: BodyGroup, caches: BodyCaches, r_trg, x_bodies, forces_torques,
     With an ``ewald_plan`` (covering body nodes + targets) the node-density
     double layer sums through the spectral-Ewald stresslet — the
     one-evaluator-serves-all seam (`body_container.cpp:552-573` routes body
-    flows through the FMM). Coincident body-node targets drop in both modes
+    flows through the FMM); a ``pair`` spec (`ops.evaluator.PairEvaluator`)
+    carrying a `TreePlan` routes it through the barycentric-treecode
+    stresslet instead. Coincident body-node targets drop in every mode
     (no stresslet self term)."""
+    from ..ops.evaluator import resolve
+
     nb, n = group.n_bodies, group.n_nodes
+    _, impl, ewald_plan, ewald_anchors, pair_anchors = resolve(
+        pair, pair_anchors, r_trg.dtype, impl=impl, ewald_plan=ewald_plan,
+        ewald_anchors=ewald_anchors)
     if x_bodies is None:
         v = jnp.zeros_like(r_trg)
     else:
         densities = x_bodies[:, :3 * n].reshape(nb * n, 3)
         normals = caches.normals.reshape(nb * n, 3)
         f_dl = 2.0 * eta * normals[:, :, None] * densities[:, None, :]
-        if ewald_plan is not None:
+        if (pair is not None and pair.evaluator == "tree"
+                and pair.plan is not None and pair.plan.depth > 0):
+            from ..ops import treecode as tcode
+
+            v = tcode._stresslet_tree_impl(
+                pair.plan, pair_anchors, caches.nodes.reshape(nb * n, 3),
+                r_trg, f_dl, eta)
+        elif ewald_plan is not None:
             from ..ops import ewald as ew
 
             if ewald_anchors is None:

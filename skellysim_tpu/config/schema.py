@@ -183,10 +183,12 @@ class Params:
     fiber_type: str = "FiniteDifference"
     # TPU-specific extensions (no reference analogue; see runtime Params):
     # solver precision tier ("full"/"mixed"/"auto" — auto = mixed on
-    # accelerators for f64 states, full elsewhere), Ewald evaluator
-    # tolerance, pairwise tile, and the mixed solver's refinement tile
+    # accelerators for f64 states, full elsewhere), Ewald/treecode
+    # evaluator tolerances, pairwise tile, and the mixed solver's
+    # refinement tile
     solver_precision: str = "auto"
     ewald_tol: float = 1e-6
+    tree_tol: float = 1e-4
     kernel_impl: str = "exact"
     refine_pair_impl: str = "auto"
     ewald_min_sources: int = 2048
@@ -610,9 +612,9 @@ def load_config(path: str):
     return cfg
 
 
-_EVALUATOR_NAMES = {"cpu": "direct", "gpu": "direct", "tpu": "direct",
-                    "direct": "direct", "ring": "ring", "fmm": "ewald",
-                    "ewald": "ewald"}
+# one alias table shared with the listener protocol — see
+# ops.evaluator.EVALUATOR_ALIASES for the name semantics
+from skellysim_tpu.ops.evaluator import EVALUATOR_ALIASES as _EVALUATOR_NAMES
 
 
 def _runtime_evaluator(name: str) -> str:
@@ -640,12 +642,14 @@ def to_runtime_params(p: Params) -> runtime_params.Params:
         implicit_motor_activation_delay=p.implicit_motor_activation_delay,
         periphery_interaction_flag=p.periphery_interaction_flag,
         # reference evaluator names: "FMM" (the reference's fast evaluator)
-        # maps to the spectral-Ewald fast path, "ring" opts into the
-        # collective-permute ring kernels, CPU/GPU/TPU map to dense direct;
-        # anything else is a typo the user must see, not a silent fallback
+        # maps to the spectral-Ewald fast path, "tree" to the barycentric
+        # treecode, "ring" opts into the collective-permute ring kernels,
+        # CPU/GPU/TPU map to dense direct; anything else is a typo the user
+        # must see, not a silent fallback
         pair_evaluator=_runtime_evaluator(p.pair_evaluator),
         solver_precision=p.solver_precision,
         ewald_tol=p.ewald_tol,
+        tree_tol=p.tree_tol,
         ewald_min_sources=p.ewald_min_sources,
         kernel_impl=p.kernel_impl,
         refine_pair_impl=p.refine_pair_impl,

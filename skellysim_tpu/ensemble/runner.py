@@ -158,12 +158,13 @@ class EnsembleRunner:
                 f"unknown batch_impl {batch_impl!r}; use 'vmap' (throughput; "
                 "shardable member axis) or 'unroll' (bit-reproducible lanes)")
         p = system.params
-        if p.pair_evaluator == "ewald":
+        if p.pair_evaluator in ("ewald", "tree"):
             raise ValueError(
-                "ensemble batching does not support pair_evaluator='ewald': "
-                "the Ewald plan is rebuilt host-side per step and cannot "
-                "live inside the closed batched trace; use 'direct' (small-N "
-                "members are below the Ewald crossover anyway)")
+                "ensemble batching does not support pair_evaluator="
+                f"{p.pair_evaluator!r}: the fast-summation plan is rebuilt "
+                "host-side per step and cannot live inside the closed "
+                "batched trace; use 'direct' (small-N members are below the "
+                "fast-evaluator crossover anyway)")
         if p.pair_evaluator == "ring" and system.mesh is not None:
             raise ValueError(
                 "ensemble batching does not support the ring pair evaluator "

@@ -209,38 +209,72 @@ def weighted_forces(group: FiberGroup, forces) -> jnp.ndarray:
 def flow(group: FiberGroup, caches: FiberCaches, r_trg, forces, eta,
          subtract_self: bool = True, evaluator: str = "direct",
          mesh=None, impl: str = "exact", ewald_plan=None,
-         ewald_anchors=None) -> jnp.ndarray:
+         ewald_anchors=None, pair=None, pair_anchors=None) -> jnp.ndarray:
     """Velocity at targets from all fiber nodes (`flow`, `:172-214`).
 
     ``forces`` is [nf, n, 3]; when ``subtract_self`` the first nf*n targets are
     assumed to be the fiber nodes themselves and each fiber's dense
     self-interaction is subtracted (it is handled by the SBT mobility instead).
+    Evaluator selection rides a `ops.evaluator.PairEvaluator` spec
+    (``pair`` + traced ``pair_anchors``) — the reference's pair_evaluator
+    seam (`fiber_container_base.cpp:20-33`); a spec carrying a
+    `ops.treecode.TreePlan` sums through the barycentric treecode. The
+    legacy loose kwargs remain for direct callers of the older paths only:
     ``evaluator="ring"`` (with a mesh) rotates source blocks around the ICI
-    ring instead of the GSPMD all-gather; ``evaluator="ewald"`` (with an
-    `ops.ewald.EwaldPlan`) sums in O(N log N) — the reference's
-    pair_evaluator seam (`fiber_container_base.cpp:20-33`).
+    ring instead of the GSPMD all-gather, ``evaluator="ewald"`` (with an
+    `ops.ewald.EwaldPlan`) sums on the spectral grid; the treecode has no
+    loose spelling — it is reachable only via the spec.
     """
     return flow_multi((group,), (caches,), r_trg, (forces,), eta,
                       subtract_self=subtract_self, evaluator=evaluator,
                       mesh=mesh, impl=impl, ewald_plan=ewald_plan,
-                      ewald_anchors=ewald_anchors)
+                      ewald_anchors=ewald_anchors, pair=pair,
+                      pair_anchors=pair_anchors)
+
+
+def _spread_inactive(buckets, pos, fills):
+    """Replace inactive slots' (replicated) node rows with the planner's
+    spread fill sequence: inactive slots replicate slot 0 (`grow_capacity`),
+    which would pile their nodes into one cell/leaf and blow up the fast
+    plans' static bucket capacity; their weighted forces are zero, so only
+    occupancy changes. Indexed by compacted rank among the inactive slots
+    so the runtime fill set is exactly the first-n_fill sequence prefix the
+    planner counted occupancy for — raw slot indices would select an
+    arbitrary subsequence whose phases can locally align and overflow the
+    planned capacity (silent point eviction)."""
+    act = jnp.concatenate([jnp.repeat(g.active, g.n_nodes) for g in buckets])
+    rank = jnp.clip(jnp.cumsum(~act) - 1, 0, None)
+    return jnp.where(act[:, None], pos, fills[rank])
 
 
 def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
                subtract_self: bool = True, evaluator: str = "direct",
                mesh=None, impl: str = "exact", ewald_plan=None,
-               ewald_anchors=None) -> jnp.ndarray:
+               ewald_anchors=None, pair=None,
+               pair_anchors=None) -> jnp.ndarray:
     """`flow` over a tuple of resolution buckets in ONE evaluator pass.
 
     The TPU answer to the reference's mixed-resolution `std::list` container
     (`fiber_container_finite_difference.cpp:519-562`): each resolution is a
     dense vmapped bucket, and the all-to-all flow concatenates every
-    bucket's sources so the pair evaluator (dense tile, ICI ring, or one
-    Ewald grid) runs once over the union instead of once per bucket. When
-    ``subtract_self`` the leading targets must be the concatenated fiber
-    nodes in bucket order; each bucket's dense self-interaction is
-    subtracted at its own slice.
+    bucket's sources so the pair evaluator (dense tile, ICI ring, Ewald
+    grid, or treecode) runs once over the union instead of once per
+    bucket. When ``subtract_self`` the leading targets must be the
+    concatenated fiber nodes in bucket order; each bucket's dense
+    self-interaction is subtracted at its own slice.
+
+    ``pair`` (a `ops.evaluator.PairEvaluator`) supersedes the loose
+    ``evaluator``/``impl``/``ewald_plan`` kwargs, which remain for direct
+    callers; when ``pair_anchors`` is None the plan's own stored anchors
+    are materialized (so pass anchors explicitly for stripped plans).
     """
+    from ..ops.evaluator import resolve
+
+    evaluator, impl, ewald_plan, ewald_anchors, pair_anchors = resolve(
+        pair, pair_anchors, r_trg.dtype, evaluator, impl, ewald_plan,
+        ewald_anchors)
+    tree_plan = pair.plan if (pair is not None
+                              and pair.evaluator == "tree") else None
     pos = jnp.concatenate([node_positions(g) for g in buckets], axis=0)
     wf = jnp.concatenate([weighted_forces(g, f).reshape(-1, 3)
                           for g, f in zip(buckets, forces_list)], axis=0)
@@ -265,22 +299,11 @@ def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
         if ewald_anchors is None:
             ewald_anchors = ew.plan_anchors(ewald_plan, r_trg.dtype)
             ewald_plan = ew.strip_anchors(ewald_plan)
-        # inactive slots replicate slot 0 (`grow_capacity`), which would
-        # pile their nodes into one cell and blow up the plan's bucket
-        # capacity; spread them over the cell region instead — their
-        # weighted forces are zero, so only occupancy changes. The plan
-        # reserved room for them (`plan_ewald(n_fill=...)`).
-        act = jnp.concatenate([jnp.repeat(g.active, g.n_nodes)
-                               for g in buckets])
+        # the plan reserved fill room for inactive slots
+        # (`plan_ewald(n_fill=...)`; see `_spread_inactive`)
         fills = ew.fill_positions(ewald_plan, ewald_anchors[1],
                                   n_fib_nodes, pos.dtype)
-        # index fills by compacted rank among the inactive slots so the
-        # runtime fill set is exactly the first-n_fill sequence prefix the
-        # planner counted occupancy for — raw slot indices would select an
-        # arbitrary subsequence whose phases can locally align and overflow
-        # the planned per-cell bucket capacity (silent point eviction)
-        rank = jnp.clip(jnp.cumsum(~act) - 1, 0, None)
-        pos = jnp.where(act[:, None], pos, fills[rank])
+        pos = _spread_inactive(buckets, pos, fills)
         n_self = n_fib_nodes if subtract_self else 0
         if n_self:
             # the leading targets are the fiber nodes: keep them consistent
@@ -291,6 +314,22 @@ def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
         # the kernel scales as 1/eta and the plan baked plan.eta in; honor
         # this call's eta like the direct/ring branches do
         vel = vel * (ewald_plan.eta / eta)
+    elif evaluator == "tree" and tree_plan is not None:
+        from ..ops import treecode as tcode
+
+        fills = tcode.fill_positions(tree_plan, pair_anchors[0],
+                                     n_fib_nodes, pos.dtype)
+        pos = _spread_inactive(buckets, pos, fills)
+        if subtract_self:
+            # keep the leading (fiber-node) targets consistent with the
+            # spread source positions so self pairs stay exactly coincident
+            # (the treecode's near tile drops them like the dense kernel)
+            r_trg = jnp.concatenate([pos, r_trg[n_fib_nodes:]], axis=0)
+        if tree_plan.depth == 0:
+            vel = kernels.stokeslet_direct(pos, r_trg, wf, eta, impl=impl)
+        else:
+            vel = tcode._stokeslet_tree_impl(tree_plan, pair_anchors, pos,
+                                             r_trg, wf, eta)
     else:
         vel = kernels.stokeslet_direct(pos, r_trg, wf, eta, impl=impl)
     if subtract_self:
@@ -306,7 +345,7 @@ def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
 
 def flow_multi_local(buckets, caches_list, forces_list, r_loc, r_rep, eta, *,
                      axis_name, n_dev: int, subtract_self: bool = True,
-                     impl: str = "exact"):
+                     impl: str = "exact", pair=None, pair_anchors=None):
     """`flow_multi` for callers ALREADY INSIDE a `shard_map` over the fiber
     axis (the SPMD implicit step, `parallel.spmd`).
 
@@ -330,6 +369,17 @@ def flow_multi_local(buckets, caches_list, forces_list, r_loc, r_rep, eta, *,
     concatenated fiber nodes in bucket order. DF impls ("df"/"pallas_df")
     accumulate in float64 and cast back to the target dtype at the seam,
     like `flow_multi`'s ring branch.
+
+    A ``pair`` spec with ``evaluator="tree"`` composes the treecode with
+    the SPMD decomposition: every shard buckets the all-gathered source
+    set into the SHARED global `TreePlan` (the plan covers the whole
+    cloud, a subset just lowers occupancy) and evaluates its own resident
+    targets — one all-gather of [N, 3] sources replaces the n_dev-1 ring
+    hops of the same total bytes, and per-shard compute drops from
+    O(N^2/D) dense tiles to the treecode's near+cluster work. Replicated
+    targets keep the partial-sum contract (each shard sums its LOCAL
+    sources through the tree; the caller's psum keeps replicated rows
+    bitwise identical across shards, same as the ring path).
     """
     from ..parallel.ring import ring_flow_local
 
@@ -337,11 +387,26 @@ def flow_multi_local(buckets, caches_list, forces_list, r_loc, r_rep, eta, *,
     wf = jnp.concatenate([weighted_forces(g, f).reshape(-1, 3)
                           for g, f in zip(buckets, forces_list)], axis=0)
 
-    v_loc = ring_flow_local("stokeslet", impl, r_loc, pos, wf, eta,
-                            axis_name=axis_name, n_dev=n_dev, ring=True)
-    v_rep = (ring_flow_local("stokeslet", impl, r_rep, pos, wf, eta,
-                             axis_name=axis_name, n_dev=n_dev, ring=False)
-             if r_rep is not None else None)
+    if (pair is not None and pair.evaluator == "tree"
+            and pair.plan is not None and pair.plan.depth > 0):
+        from jax import lax
+
+        from ..ops import treecode as tcode
+
+        pos_all = lax.all_gather(pos, axis_name, axis=0, tiled=True)
+        wf_all = lax.all_gather(wf, axis_name, axis=0, tiled=True)
+        v_loc = tcode._stokeslet_tree_impl(pair.plan, pair_anchors, pos_all,
+                                           r_loc, wf_all, eta)
+        v_rep = (tcode._stokeslet_tree_impl(pair.plan, pair_anchors, pos,
+                                            r_rep, wf, eta)
+                 if r_rep is not None else None)
+    else:
+        v_loc = ring_flow_local("stokeslet", impl, r_loc, pos, wf, eta,
+                                axis_name=axis_name, n_dev=n_dev, ring=True)
+        v_rep = (ring_flow_local("stokeslet", impl, r_rep, pos, wf, eta,
+                                 axis_name=axis_name, n_dev=n_dev,
+                                 ring=False)
+                 if r_rep is not None else None)
 
     if subtract_self:
         off = 0

@@ -11,9 +11,11 @@ to stdout. A zero-length message terminates the server.
 The `evaluator` field selected CPU/GPU/FMM backends in the reference
 (`listener.cpp:117`, `System::set_evaluator`, `system.cpp:389-393`); it maps
 onto our pair-evaluator seam (case-insensitive): "FMM" -> "ewald" (the
-spectral-Ewald fast evaluator filling the reference's FMM slot),
+spectral-Ewald fast evaluator filling the reference's FMM slot; "tree", the
+hierarchical answer to the same slot, is reachable by its native name),
 "CPU"/"GPU" -> "direct" (dense XLA kernels — the device is whatever backend
-JAX runs on); our native names ("direct"/"ring"/"ewald") are also accepted.
+JAX runs on); our native names ("direct"/"ring"/"ewald"/"tree") are also
+accepted.
 Scope: the switch covers `velocity_field` requests AND streamline /
 vortex-line integration, matching the reference's whole-request evaluator
 switch (`listener.cpp:117` + `system.cpp:389-393`): each request plans over
@@ -38,6 +40,7 @@ import msgpack
 import numpy as np
 
 from .builder import build_simulation
+from .ops.evaluator import EVALUATOR_ALIASES
 from .io import eigen
 from .io.trajectory import TrajectoryReader, frame_to_state
 from .postprocess import streamlines as compute_streamlines
@@ -47,12 +50,10 @@ from .system.system import solution_from_state
 _LINE_DEFAULTS = dict(dt_init=0.1, t_final=1.0, abs_err=1e-10, rel_err=1e-6,
                       back_integrate=True)
 
-#: reference evaluator names (`listener.cpp:117`) -> runtime pair evaluators
-#: lowercase reference/native names -> runtime pair evaluators (lookup is
-#: case-insensitive, matching the TOML mapping in `config.schema`)
-EVALUATOR_MAP = {"cpu": "direct", "gpu": "direct", "tpu": "direct",
-                 "fmm": "ewald",
-                 "direct": "direct", "ring": "ring", "ewald": "ewald"}
+#: reference evaluator names (`listener.cpp:117`) -> runtime pair evaluators;
+#: the one alias table shared with the TOML mapping in `config.schema`
+#: (lookup is case-insensitive at both sites)
+EVALUATOR_MAP = EVALUATOR_ALIASES
 
 
 def switch_evaluator(system, evaluator: str | None):
@@ -125,19 +126,19 @@ def _extended_corners(state, system, seeds: np.ndarray) -> np.ndarray:
 _VEL_FNS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
-def _vel_fn_for(system, plan):
+def _vel_fn_for(system, pair):
     per = _VEL_FNS.setdefault(system, {})
-    fn = per.get(plan)
+    fn = per.get(pair)
     if fn is None:
-        if plan is None:
+        if pair is None:
             def fn(pts, state, solution, _sys=system):
                 return _sys._velocity_at_targets_impl(state, solution, pts)
         else:
-            def fn(pts, state, solution, anchors, _sys=system, _plan=plan):
+            def fn(pts, state, solution, anchors, _sys=system, _pair=pair):
                 return _sys._velocity_at_targets_impl(
-                    state, solution, pts, ewald_plan=_plan,
-                    ewald_anchors=anchors)
-        per[plan] = fn
+                    state, solution, pts, pair=_pair,
+                    pair_anchors=anchors)
+        per[pair] = fn
     return fn
 
 
@@ -163,15 +164,15 @@ def process_request(system, template_state, reader: TrajectoryReader,
 
     seeds_sl = _seeds(sl_req)
     seeds_vl = _seeds(vl_req)
-    if (system.params.pair_evaluator == "ewald"
+    if (system.params.pair_evaluator in ("ewald", "tree")
             and (seeds_sl.size or seeds_vl.size)):
         # per-request extended-box plan: line integration goes through the
         # fast evaluator too, like the reference's whole-request switch
         # (`listener.cpp:117`); the quantized plan keys a reused jit program
         corners = _extended_corners(state, system,
                                     np.vstack([seeds_sl, seeds_vl]))
-        plan, anchors = system._ewald_args(state, extra_targets=corners)
-        vel_fn = _vel_fn_for(system, plan)
+        pair, anchors = system._pair_args(state, extra_targets=corners)
+        vel_fn = _vel_fn_for(system, pair)
         field_args = (state, solution, anchors)
     else:
         if vel_fn is None:

@@ -1,0 +1,117 @@
+"""PairEvaluator: the one spec object for the pair-evaluator seam.
+
+Before this module, every flow call site carried the evaluator selection
+as loose kwargs (``evaluator=``, ``impl=``, ``ewald_plan=``,
+``ewald_anchors=``) and each new evaluator grew every signature in
+`fibers.container`, `periphery.periphery`, `bodies.bodies`, and the whole
+`System` pipeline. The spec hoists that selection into ONE hashable value
+(`PairEvaluator`) built once per solve and passed through unchanged — the
+runtime mirror of the reference's single `Evaluator` slot
+(`fiber_container_base.cpp:20-33`, `include/kernels.hpp:56-134`).
+
+The spec is a frozen dataclass so it can ride jit ``static_argnames``
+(it selects compiled programs, exactly like the plans it carries); the
+plan's traced anchors travel NEXT to it as a regular operand
+(``pair_anchors``) so anchor hops under drift reuse compiled programs.
+
+``plan`` is polymorphic over the fast-summation planners — an
+`ops.ewald.EwaldPlan` or an `ops.treecode.TreePlan`; `plan_module`
+dispatches to the owning module's ``strip_anchors``/``plan_anchors``.
+"""
+
+from __future__ import annotations
+
+import types
+from dataclasses import dataclass
+
+#: runtime evaluator names, the single source for validation everywhere
+#: (System.__init__, the config schema, the listener's evaluator map)
+EVALUATORS = ("direct", "ring", "ewald", "tree")
+
+#: accepted spellings -> runtime evaluator names, shared by the TOML schema
+#: (`config.schema`) and the listener protocol (`listener.cpp:117` semantics)
+#: so config files and runtime requests can never disagree about which names
+#: are valid: reference names ("CPU"/"GPU"/"TPU" = dense direct, "FMM" = the
+#: fast-evaluator slot -> spectral Ewald) plus our native names. Lookups are
+#: case-insensitive at both call sites. Read-only view: both importers bind
+#: the SAME object, so a mutation at one site would silently change what
+#: names the other accepts.
+EVALUATOR_ALIASES = types.MappingProxyType(
+    {"cpu": "direct", "gpu": "direct", "tpu": "direct",
+     "fmm": "ewald",
+     "direct": "direct", "ring": "ring", "ewald": "ewald",
+     "tree": "tree"})
+
+
+def plan_module(plan):
+    """The ops module owning ``plan`` (lazy imports: the spec itself must
+    stay importable without pulling both planners in)."""
+    from . import ewald, treecode
+
+    if isinstance(plan, ewald.EwaldPlan):
+        return ewald
+    if isinstance(plan, treecode.TreePlan):
+        return treecode
+    raise TypeError(f"unknown pair-evaluator plan type {type(plan)!r}")
+
+
+@dataclass(frozen=True)
+class PairEvaluator:
+    """Hashable pair-evaluator selection (a jit-static value).
+
+    ``evaluator`` is one of `EVALUATORS`; ``impl`` the pairwise tile
+    (`Params.kernel_impl` semantics); ``plan`` the anchor-STRIPPED fast
+    plan for "ewald"/"tree" (None for the dense/ring paths — and passing
+    ``plan=None`` with a fast evaluator name is how role-gated callers,
+    e.g. the mixed solver's f64 refinement flows, force the dense tile
+    without renaming the evaluator).
+    """
+
+    evaluator: str = "direct"
+    impl: str = "exact"
+    plan: object = None
+
+    def __post_init__(self):
+        if self.evaluator not in EVALUATORS:
+            raise ValueError(
+                f"unknown pair evaluator {self.evaluator!r}; "
+                f"runtime values are {', '.join(EVALUATORS)}")
+
+    @property
+    def is_fast(self) -> bool:
+        """True when this spec routes through a fast-summation plan."""
+        return self.plan is not None and self.evaluator in ("ewald", "tree")
+
+
+def resolve(pair, pair_anchors, dtype, evaluator: str = "direct",
+            impl: str = "exact", ewald_plan=None, ewald_anchors=None):
+    """Collapse the spec/loose-kwarg duality at a flow entry point.
+
+    Returns ``(evaluator, impl, ewald_plan, ewald_anchors, pair_anchors)``:
+    the spec (when given) supersedes the loose kwargs, missing anchors are
+    materialized from the plan's own stored anchor (so stripped plans need
+    anchors passed explicitly), and an "ewald" spec is re-aliased onto the
+    legacy ewald kwargs its branch consumes. The one unpack shared by
+    `fibers.container.flow_multi`, `periphery.flow`, and `bodies.flow` —
+    keeping the anchor-materialization rule from drifting per call site."""
+    if pair is None:
+        return evaluator, impl, ewald_plan, ewald_anchors, pair_anchors
+    if pair.plan is not None and pair_anchors is None:
+        pair_anchors = plan_module(pair.plan).plan_anchors(pair.plan, dtype)
+    if pair.evaluator == "ewald":
+        ewald_plan, ewald_anchors = pair.plan, pair_anchors
+    return pair.evaluator, pair.impl, ewald_plan, ewald_anchors, pair_anchors
+
+
+def make_pair(evaluator: str, impl: str, plan=None, anchors=None,
+              dtype=None):
+    """(spec, anchors) with the plan anchor-stripped and its traced anchors
+    materialized — the one constructor System and tests share so the
+    strip/anchor discipline cannot drift per call site."""
+    if plan is None:
+        return PairEvaluator(evaluator=evaluator, impl=impl), None
+    mod = plan_module(plan)
+    if anchors is None:
+        anchors = mod.plan_anchors(plan, dtype)
+    return (PairEvaluator(evaluator=evaluator, impl=impl,
+                          plan=mod.strip_anchors(plan)), anchors)

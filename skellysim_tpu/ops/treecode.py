@@ -1,0 +1,739 @@
+"""Barycentric Lagrange treecode: hierarchical O(N log N) kernel summation.
+
+The second fast pair-evaluator filling the reference's FMM slot
+(`/root/reference/include/kernels.hpp:56-134` wraps STKFMM/PVFMM), next to
+`ops.ewald`. Where the Ewald split is grid-based (FFTs over the whole box),
+this is the hierarchical answer (Wang, Krasny & Tlupova, arXiv:1811.12498;
+kernel-aggregated FMM arXiv:2010.15155 is the accuracy/cost reference
+point): source clusters are compressed onto tensor-product Chebyshev grids
+by barycentric Lagrange anterpolation, and well-separated cluster fields
+are evaluated through the *same* pairwise kernel tiles as the dense path —
+a kernel-independent far field that serves the Stokeslet, the stresslet
+double layer, and the regularized Oseen kernel with one traversal.
+
+Classic treecodes are hostile to XLA (recursive adaptive trees, per-target
+multipole-acceptance tests = data-dependent control flow). The TPU-native
+shape used here is fully static:
+
+* a FIXED-DEPTH uniform octree over a cubic box; leaves are padded,
+  power-of-two-laddered buckets (`max_occ`) with masked empty lanes —
+  the ensemble masked-lane trick applied to space instead of batch;
+* the multipole acceptance criterion is INDEX-based (the standard FMM
+  well-separatedness: cells at one level interact iff their parents are
+  neighbors but they are not), so every interaction list is a host-side
+  integer constant baked at trace time — no `jnp.where` ever decides
+  *whether* to evaluate a cluster, only masks what empty lanes contribute;
+* the upward pass (leaf anterpolation + child->parent transfers) is a
+  stack of batched [occ, p^3] / [8 p^3, p^3] matmuls — the MXU-friendly
+  batched-matmul layout `stokeslet_block_mxu` established;
+* near and far fields are evaluated TARGET-ROW-MAJOR: targets are sorted
+  by leaf and processed in compact fixed-size chunks, each row gathering
+  its own 27 neighbor buckets (near: dense exact tile, coincident pairs
+  drop — so no analytic self term exists anywhere, unlike the Ewald far
+  field's Gaussian correction) or its leaf's interaction-list proxies
+  (far). Row-major evaluation is what keeps the padded-lane waste linear:
+  a cell-major traversal would pay 27 * max_occ^2 per cell INCLUDING the
+  empty cells, which for clustered clouds costs more than the dense
+  O(N^2) tile it is meant to beat.
+
+Accuracy is controlled by the interpolation order p (`TreePlan.order`):
+with the one-cell-buffer acceptance criterion the measured relative error
+contracts ~5x per order (see `plan_tree`'s calibrated rule, pinned by
+`tests/test_treecode.py`). Cost per target ~ 27*occ (near) +
+sum_levels |ilist| * p^3 (far) vs N for the dense tile, so the treecode
+pays off for large N at moderate tolerance — the f32 Krylov interior of
+the mixed solver, exactly like `ewald_tol` (the f64 refinement residual
+stays dense either way; see `System._prep`'s role gating).
+
+Plan/anchor discipline mirrors `ops.ewald`: every derived quantity is a
+deterministic function of ladder-quantized inputs so the plan (the jit
+compilation key) is stable under geometric drift, and the box anchor
+enters traced (`strip_anchors`/`plan_anchors`) so a quantized anchor hop
+reuses the compiled program.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import lru_cache, partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import kernels
+from .ewald import _R2_ALPHAS, _ladder
+
+__all__ = ["TreePlan", "plan_tree", "stokeslet_tree", "stresslet_tree",
+           "oseen_tree", "strip_anchors", "plan_anchors", "fill_positions"]
+
+
+# ---------------------------------------------------------------------- plan
+
+@dataclass(frozen=True)
+class TreePlan:
+    """Static tree geometry/resolution (hashable; selects compiled programs).
+
+    Built host-side by `plan_tree` — the analogue of the reference FMM's
+    per-step tree rebuild (`kernels.hpp:78-122`). ``box_lo`` is carried for
+    convenience but enters the computation as a *traced* operand: callers
+    that jit on the plan strip it (`strip_anchors`) so a quantized-anchor
+    hop under drift reuses the compiled program. ``depth == 0`` is the
+    degenerate single-cell tree: the evaluators dispatch straight to the
+    dense kernels (bitwise-identical results — pinned by tests).
+    """
+
+    depth: int       # leaf level L; 8^L leaves (0 = dense fallback)
+    order: int       # p: Chebyshev points per dimension (p^3 per cluster)
+    box_lo: tuple    # root-box lower corner (traced at run time; None once
+                     # anchor-stripped — see `strip_anchors`)
+    box_L: float     # root-box edge (ladder-quantized)
+    max_occ: int     # static per-leaf bucket capacity
+    tol: float       # target relative accuracy (field-normalized: the
+                     # bound is on max_i |du_i| / max_i |u_i| — per-point
+                     # relative error is unbounded at near-zero-velocity
+                     # targets for ANY summation scheme)
+
+    @property
+    def n_leaves(self) -> int:
+        return 8 ** self.depth
+
+    @property
+    def leaf_size(self) -> float:
+        return self.box_L / (2 ** self.depth)
+
+
+def strip_anchors(plan: TreePlan) -> TreePlan:
+    """Drop the traced anchor field — the hashable jit key for this plan.
+
+    The stripped plan carries ``box_lo=None`` (not a zero tuple): anchors
+    for a stripped plan MUST come in as the explicit traced operand, and
+    `plan_anchors` refuses to fabricate them — a silently-zeroed anchor
+    would bucket every point relative to the origin, clip the cloud into
+    boundary leaves, and evict sources past ``max_occ`` with no error.
+    """
+    import dataclasses
+
+    return dataclasses.replace(plan, box_lo=None)
+
+
+def plan_anchors(plan: TreePlan, dtype=None):
+    """[1, 3] traced-operand anchor (box_lo)."""
+    if plan.box_lo is None:
+        raise ValueError(
+            "anchor-stripped TreePlan has no anchors to materialize; pass "
+            "the traced anchors explicitly (pair_anchors= / the anchors "
+            "value make_pair returned next to the spec)")
+    return jnp.asarray([plan.box_lo], dtype=dtype or jnp.float64)
+
+
+def fill_positions(plan: TreePlan, box_lo, n, dtype):
+    """[n, 3] well-spread positions inside the root box (R2 lattice).
+
+    Same role as `ewald.fill_positions`: inactive/padding source nodes with
+    zero strengths must live *somewhere* with static shapes, and replicated
+    padding would pile them into one leaf and blow up `max_occ`.
+    """
+    t = (jnp.arange(n, dtype=dtype) + 0.5)[:, None]
+    alphas = jnp.asarray(_R2_ALPHAS, dtype=dtype)[None, :]
+    frac = (t * alphas) % 1.0
+    return jnp.asarray(box_lo, dtype=dtype) + frac * (0.999 * plan.box_L)
+
+
+def _fill_positions_np(box_lo, box_L, n):
+    """NumPy mirror of `fill_positions` for host-side occupancy counting."""
+    t = (np.arange(n, dtype=np.float64) + 0.5)[:, None]
+    frac = (t * np.asarray(_R2_ALPHAS)[None, :]) % 1.0
+    return np.asarray(box_lo) + frac * (0.999 * box_L)
+
+
+#: measured error contraction per interpolation order for the 1/r-family
+#: kernels under the one-cell-buffer acceptance criterion (random and
+#: line-clustered clouds, `tests/test_treecode.py` pins the rule end to
+#: end). Measured (uniform cloud, depths 2-3): p=3 -> 4.3e-3, p=4 ->
+#: 7.9e-4, p=5 -> 1.4e-4, p=6 -> 2.8e-5, p=8 -> 8e-7 — a ~5.3x
+#: contraction per order; the rule err(p) ~ 0.05 * 5^-(p-2) upper-bounds
+#: every measured point with >= 2x margin.
+_ACC_BASE = 5.0
+_ACC_C0 = 0.05
+
+
+def order_for_tol(tol: float, max_order: int = 12) -> int:
+    """Interpolation order p for a target relative accuracy (calibrated)."""
+    p = 2 + math.ceil(math.log(max(_ACC_C0 / tol, 1.0)) / math.log(_ACC_BASE))
+    return int(min(max(p, 2), max_order))
+
+
+def plan_tree(points, tol=1e-4, target_occ=32.0, max_depth=5, n_fill=0,
+              max_order=12):
+    """Choose (depth, order, box, leaf capacity) for a target relative
+    accuracy. Host-side (NumPy), once per step/geometry, like `plan_ewald`.
+
+    Rules (each pinned by `tests/test_treecode.py`):
+      * depth from the point count: leaves sized for ~``target_occ`` points
+        -> depth = ceil(log8(N_q / target_occ)) on the pow2-laddered count
+        N_q, clamped to [2, max_depth]; below the 2-level minimum (the
+        first level with well-separated cells) the plan degenerates to
+        depth 0 = the dense kernels.
+      * order from tol via the measured contraction rule (`order_for_tol`).
+      * box edge from the cloud extent, laddered, with margin
+        1/(1 - 2^-depth) so the leaf-lattice-quantized anchor still covers
+        the cloud; the anchor hops only on the leaf lattice.
+      * leaf capacity from measured occupancy (fills included) on the
+        geometric x1.5 / 8-aligned rung ladder with 15% headroom, like
+        `plan_ewald` — a recompile should need a ~30% occupancy swing.
+
+    ``n_fill`` reserves occupancy for that many zero-strength padding nodes
+    placed by `fill_positions` (inactive fiber slots).
+    """
+    pts = np.asarray(points, dtype=np.float64)
+    lo = pts.min(axis=0)
+    hi = pts.max(axis=0)
+    extent = max(float((hi - lo).max()), 1e-3)
+    N = len(pts) + int(n_fill)
+    N_q = max(1, 2 ** math.ceil(math.log2(max(N, 1))))
+
+    depth = math.ceil(math.log(max(N_q / target_occ, 1.0)) / math.log(8.0))
+    depth = min(depth, max_depth)
+    if depth < 2:
+        # no well-separated cells exist above the 2-level minimum: the
+        # tree would be pure near field with bucketing overhead — dense
+        # is strictly better and bitwise-identical
+        return TreePlan(depth=0, order=order_for_tol(tol, max_order),
+                        box_lo=(float(lo[0]), float(lo[1]), float(lo[2])),
+                        box_L=_ladder(extent, 1e-3), max_occ=1,
+                        tol=float(tol))
+
+    order = order_for_tol(tol, max_order)
+    L_box = _ladder(extent / (1.0 - 2.0 ** -depth) + 1e-9, 1e-3)
+    cell = L_box / (2 ** depth)
+    box_lo = tuple(float(cell * math.floor(a / cell)) for a in lo)
+
+    C = 2 ** depth
+    ci = np.clip(((pts - np.asarray(box_lo)) / cell).astype(int), 0, C - 1)
+    if n_fill:
+        fp = _fill_positions_np(box_lo, L_box, int(n_fill))
+        cif = np.clip(((fp - np.asarray(box_lo)) / cell).astype(int), 0,
+                      C - 1)
+        ci = np.vstack([ci, cif])
+    flat = (ci[:, 0] * C + ci[:, 1]) * C + ci[:, 2]
+    occ = int(np.bincount(flat, minlength=C ** 3).max()) if len(flat) else 1
+    need = occ * 1.15
+    rung = 8.0
+    while rung < need:
+        rung *= 1.5
+    occ = int(-8 * (-rung // 8))
+
+    return TreePlan(depth=int(depth), order=int(order), box_lo=box_lo,
+                    box_L=float(L_box), max_occ=occ, tol=float(tol))
+
+
+# -------------------------------------------- host-side static tree geometry
+
+_OCTS = np.array([(i, j, k) for i in (0, 1) for j in (0, 1) for k in (0, 1)],
+                 dtype=np.int64)                      # [8, 3] child octants
+_NBR_OFFSETS = np.array([(i, j, k) for i in (-1, 0, 1)
+                         for j in (-1, 0, 1) for k in (-1, 0, 1)],
+                        dtype=np.int64)               # [27, 3]
+
+
+def _coords(level: int) -> np.ndarray:  # skelly-lint: ignore-function[trace-hygiene] — host-side tree geometry from the STATIC plan level only (never traced values); freezing into the program as constants is the treecode's static-interaction-list design (module docstring)
+    """[8^level, 3] integer cell coords in flat order (i*C + j)*C + k."""
+    C = 2 ** level
+    g = np.arange(C, dtype=np.int64)
+    return np.stack(np.meshgrid(g, g, g, indexing="ij"),
+                    axis=-1).reshape(-1, 3)
+
+
+@lru_cache(maxsize=None)
+def _vlists(level: int) -> tuple:
+    """Per-cell V-lists at one level: children of the parent's 27 neighbor
+    cells (itself included) that are NOT neighbors of the cell — the
+    standard index-based well-separatedness criterion. Returns a tuple of
+    per-cell int64 arrays of flat same-level cell ids."""
+    C = 2 ** level
+    co = _coords(level)
+    parent = co >> 1
+    cand = ((parent[:, None, None, :] + _NBR_OFFSETS[None, :, None, :]) * 2
+            + _OCTS[None, None, :, :])                # [C3, 27, 8, 3]
+    valid = np.all((cand >= 0) & (cand < C), axis=-1)
+    cheb = np.abs(cand - co[:, None, None, :]).max(axis=-1)
+    keep = valid & (cheb > 1)
+    flat = (cand[..., 0] * C + cand[..., 1]) * C + cand[..., 2]
+    return tuple(flat[i][keep[i]] for i in range(C ** 3))
+
+
+@lru_cache(maxsize=None)
+def _interaction_lists(depth: int):
+    """Per-LEAF far-field interaction lists over levels 2..depth.
+
+    Each leaf's far set is the union over levels of its ancestor's V-list
+    at that level; entries index the flat cross-level proxy array (level
+    offsets applied). Returns (ilist [n_leaves, maxI] int32 padded with
+    ``total_cells`` — the zero-strength sentinel slot — , total_cells,
+    level_offsets dict, child_index arrays per level for the upward pass).
+    """
+    assert depth >= 2
+    offsets = {}
+    total = 0
+    for lev in range(2, depth + 1):
+        offsets[lev] = total
+        total += 8 ** lev
+
+    leaf_co = _coords(depth)
+    n_leaves = 8 ** depth
+    per_leaf = []
+    vl = {lev: _vlists(lev) for lev in range(2, depth + 1)}
+    for b in range(n_leaves):
+        parts = []
+        for lev in range(2, depth + 1):
+            anc = leaf_co[b] >> (depth - lev)
+            Cl = 2 ** lev
+            anc_flat = (anc[0] * Cl + anc[1]) * Cl + anc[2]
+            parts.append(vl[lev][anc_flat] + offsets[lev])
+        per_leaf.append(np.concatenate(parts) if parts
+                        else np.zeros(0, dtype=np.int64))
+    maxI = max(1, max(len(p) for p in per_leaf))
+    ilist = np.full((n_leaves, maxI), total, dtype=np.int32)
+    for b, p in enumerate(per_leaf):
+        ilist[b, :len(p)] = p
+
+    child_idx = {}
+    for lev in range(2, depth):
+        co = _coords(lev)
+        Cc = 2 ** (lev + 1)
+        ch = co[:, None, :] * 2 + _OCTS[None, :, :]   # [8^lev, 8, 3]
+        child_idx[lev] = ((ch[..., 0] * Cc + ch[..., 1]) * Cc
+                          + ch[..., 2]).astype(np.int32)
+    return ilist, total, offsets, child_idx
+
+
+# ------------------------------------------------- barycentric interpolation
+
+def _cheb_nodes_np(p: int) -> np.ndarray:  # skelly-lint: ignore-function[trace-hygiene] — host-side interpolation nodes from the STATIC plan order only; frozen trace-time constants by design (module docstring)
+    """Chebyshev points of the 2nd kind on [-1, 1] (endpoints included)."""
+    if p == 1:
+        return np.zeros(1)
+    return np.cos(np.pi * np.arange(p) / (p - 1))
+
+
+def _bary_w_np(p: int) -> np.ndarray:  # skelly-lint: ignore-function[trace-hygiene] — host-side barycentric weights from the STATIC plan order only; frozen trace-time constants by design (module docstring)
+    """Barycentric weights for 2nd-kind Chebyshev points."""
+    w = np.ones(p) * np.where(np.arange(p) % 2 == 0, 1.0, -1.0)
+    w[0] *= 0.5
+    w[-1] *= 0.5
+    return w
+
+
+def _bary_1d(y, nodes, w):
+    """Barycentric Lagrange basis values L_k(y): [..., n] -> [..., n, p].
+
+    Near-node evaluations snap to the one-hot basis row: the raw formula's
+    c = w/(y - t) overflows in f32 for |y - t| ~ 1e-38, and masked-lane
+    sentinel points may sit exactly on a node.
+    """
+    diff = y[..., None] - nodes
+    eps = jnp.finfo(diff.dtype).eps
+    hit = jnp.abs(diff) < 64.0 * eps
+    c = w / jnp.where(hit, 1.0, diff)
+    L = c / jnp.sum(c, axis=-1, keepdims=True)
+    any_hit = jnp.any(hit, axis=-1, keepdims=True)
+    return jnp.where(any_hit, hit.astype(diff.dtype), L)
+
+
+def _bary_1d_np(y, p):
+    """NumPy mirror of `_bary_1d` for the trace-time transfer matrices."""
+    t = _cheb_nodes_np(p)
+    w = _bary_w_np(p)
+    diff = y[:, None] - t[None, :]
+    hit = np.abs(diff) < 1e-13
+    c = w[None, :] / np.where(hit, 1.0, diff)
+    L = c / c.sum(axis=1, keepdims=True)
+    return np.where(hit.any(axis=1, keepdims=True), hit.astype(float), L)
+
+
+@lru_cache(maxsize=None)
+def _transfer_np(p: int) -> np.ndarray:
+    """Child->parent anterpolation transfer: U[oct, n, m] = parent basis
+    L_m evaluated at child proxy point n (octant-indexed like `_OCTS`).
+
+    Scale-invariant: the same [8, p^3, p^3] matrix serves every level.
+    """
+    t = _cheb_nodes_np(p)
+    # child half h (0 = low, 1 = high) maps child-local t to parent coords
+    U1 = {h: _bary_1d_np((t + (2 * h - 1)) / 2.0, p) for h in (0, 1)}
+    U = np.zeros((8, p ** 3, p ** 3))
+    for o, (hx, hy, hz) in enumerate(_OCTS):
+        U[o] = np.einsum("ax,by,cz->abcxyz", U1[hx], U1[hy], U1[hz]
+                         ).reshape(p ** 3, p ** 3)
+    return U
+
+
+@lru_cache(maxsize=None)
+def _nodes3_np(p: int) -> np.ndarray:
+    """[p^3, 3] tensor-product Chebyshev offsets (unit half-width)."""
+    t = _cheb_nodes_np(p)
+    return np.stack(np.meshgrid(t, t, t, indexing="ij"),
+                    axis=-1).reshape(-1, 3)
+
+
+# --------------------------------------------------------------- device side
+
+#: elements per chunked tile — bounds the materialized per-chunk
+#: intermediates (near tiles, far gathers, anterpolation weights)
+_TILE_BUDGET = 3_000_000
+
+
+def _chunked_map(fn, args, n, budget_per_item):
+    """lax.map of a BATCHED ``fn`` over leading-axis chunks sized to the
+    budget: ``fn`` receives [chunk, ...] slices of every arg (padded rows
+    compute garbage that is sliced off)."""
+    chunk = max(1, min(n, _TILE_BUDGET // max(budget_per_item, 1)))
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+
+    def padded(a):
+        widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+        return jnp.pad(a, widths).reshape((n_chunks, chunk) + a.shape[1:])
+
+    out = lax.map(lambda xs: fn(*xs), tuple(padded(a) for a in args))
+    return out.reshape((n_chunks * chunk,) + out.shape[2:])[:n]
+
+
+def _cell_centers(plan: TreePlan, lo, level: int, dtype):
+    """[8^level, 3] cell centers at one level (from the traced anchor)."""
+    C = 2 ** level
+    cell = plan.box_L / C
+    idx = jnp.arange(C ** 3, dtype=jnp.int32)
+    ix, rem = idx // (C * C), idx % (C * C)
+    iy, iz = rem // C, rem % C
+    ijk = jnp.stack([ix, iy, iz], axis=-1).astype(dtype)
+    return lo[None, :] + (ijk + 0.5) * cell
+
+
+def _leaf_ids(plan: TreePlan, lo, pts):
+    """Flat leaf index per point (boundary-clipped into the grid)."""
+    C = 2 ** plan.depth
+    cell = plan.box_L / C
+    ci = jnp.clip(((pts - lo) / cell).astype(jnp.int32), 0, C - 1)
+    return (ci[:, 0] * C + ci[:, 1]) * C + ci[:, 2]
+
+
+def _bucket(plan: TreePlan, lo, centers, pts, payload):
+    """Sort sources into [n_leaves, max_occ] buckets (padded, masked).
+
+    Padded lanes carry their cell's CENTER (barycentric-safe: a far
+    sentinel would make the anterpolation denominators catastrophically
+    cancel in f32) and zero payload (so they contribute nothing anywhere).
+    """
+    C3 = plan.n_leaves
+    mo = plan.max_occ
+    flat = _leaf_ids(plan, lo, pts)
+    order = jnp.argsort(flat)
+    flat_s = flat[order]
+    pts_s = pts[order]
+    pay_s = payload[order]
+    counts = jnp.zeros(C3, dtype=jnp.int32).at[flat_s].add(1)
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                              jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    rank = jnp.arange(flat_s.shape[0], dtype=jnp.int32) - starts[flat_s]
+    rank = jnp.minimum(rank, mo - 1)  # clamp overflow (plan sized it)
+    slot = flat_s * mo + rank
+    bpts = jnp.repeat(centers, mo, axis=0).at[slot].set(pts_s)
+    bpay = jnp.zeros((C3 * mo,) + payload.shape[1:],
+                     dtype=payload.dtype).at[slot].set(pay_s)
+    return (bpts.reshape(C3, mo, 3),
+            bpay.reshape((C3, mo) + payload.shape[1:]))
+
+
+def _upward(plan: TreePlan, lo, src_b, pay_b, dtype):
+    """Leaf anterpolation + child->parent transfers.
+
+    Returns the flat cross-level proxy arrays (levels 2..depth in
+    `_interaction_lists` order, plus one zero sentinel cell):
+    positions [T+1, p^3, 3] and strengths [T+1, p^3, C].
+    """
+    p = plan.order
+    p3 = p ** 3
+    C = pay_b.shape[-1]
+    nodes1 = jnp.asarray(_cheb_nodes_np(p), dtype=dtype)
+    bw = jnp.asarray(_bary_w_np(p), dtype=dtype)
+    half_leaf = plan.leaf_size / 2.0
+    centers_leaf = _cell_centers(plan, lo, plan.depth, dtype)
+
+    def anterp(pts_l, pay_l, cen_l):
+        y = (pts_l - cen_l[:, None, :]) / half_leaf       # [B, mo, 3]
+        Lx = _bary_1d(y[..., 0], nodes1, bw)              # [B, mo, p]
+        Ly = _bary_1d(y[..., 1], nodes1, bw)
+        Lz = _bary_1d(y[..., 2], nodes1, bw)
+        W = (Lx[:, :, :, None, None] * Ly[:, :, None, :, None]
+             * Lz[:, :, None, None, :]).reshape(
+                 pts_l.shape[0], -1, p3)                  # [B, mo, p^3]
+        return jnp.einsum("bcm,bck->bmk", W, pay_l)       # [B, p^3, C]
+
+    fh = _chunked_map(anterp, (src_b, pay_b, centers_leaf),
+                      plan.n_leaves, plan.max_occ * p3)
+
+    _, total, offsets, child_idx = _interaction_lists(plan.depth)
+    U = jnp.asarray(_transfer_np(p), dtype=dtype)          # [8, p^3, p^3]
+    by_level = {plan.depth: fh}
+    for lev in range(plan.depth - 1, 1, -1):
+        g = by_level[lev + 1][jnp.asarray(child_idx[lev])]  # [8^lev,8,p^3,C]
+        by_level[lev] = jnp.einsum("onm,qonk->qmk", U, g)
+
+    nodes3 = jnp.asarray(_nodes3_np(p), dtype=dtype)       # [p^3, 3]
+    pts_parts = []
+    f_parts = []
+    for lev in range(2, plan.depth + 1):
+        half = plan.box_L / (2 ** lev) / 2.0
+        cen = _cell_centers(plan, lo, lev, dtype)
+        pts_parts.append(cen[:, None, :] + half * nodes3[None, :, :])
+        f_parts.append(by_level[lev])
+    proxy_pts = jnp.concatenate(
+        pts_parts + [jnp.zeros((1, p3, 3), dtype=dtype)], axis=0)
+    proxy_f = jnp.concatenate(
+        f_parts + [jnp.zeros((1, p3, C), dtype=dtype)], axis=0)
+    return proxy_pts, proxy_f
+
+
+def _neighbor_table(depth: int):  # skelly-lint: ignore-function[trace-hygiene] — host-side neighbor table from the STATIC plan depth only; frozen trace-time constants by design (module docstring)
+    """[C3, 27] boundary-clipped neighbor cell ids + [C3, 27] first-
+    occurrence mask (clipped duplicates would double-count sources)."""
+    C = 2 ** depth
+    co = _coords(depth)
+    nb = np.clip(co[:, None, :] + _NBR_OFFSETS[None, :, :], 0, C - 1)
+    nid = ((nb[..., 0] * C + nb[..., 1]) * C + nb[..., 2])    # [C3, 27]
+    eq = nid[:, :, None] == nid[:, None, :]
+    uniq = ~np.any(eq & np.tril(np.ones((27, 27), dtype=bool), k=-1)[None],
+                   axis=2)
+    return nid.astype(np.int32), uniq
+
+
+def _tree_eval(plan: TreePlan, lo, r_src, payload, r_trg, near_fn, far_fn,
+               scale_near, scale_far):
+    """Shared traversal: bucket sources, upward pass, then target-row-major
+    near tiles + far cluster evaluations over leaf-sorted target chunks.
+
+    ``payload`` is [n_src, C] flat channels; ``near_fn(trg, src, pay)`` /
+    ``far_fn(trg, pts, pay)`` take [B, 3] target rows against PER-ROW
+    source sets [B, S, 3] / [B, S, C] and return [B, 3] raw row sums,
+    scaled by ``scale_near`` / ``scale_far`` (the regularized-Oseen near
+    tile is pre-scaled, the bare kernels are not).
+    """
+    dtype = r_trg.dtype
+    mo = plan.max_occ
+    p3 = plan.order ** 3
+    C = payload.shape[-1]
+    centers = _cell_centers(plan, lo, plan.depth, dtype)
+    src_b, pay_b = _bucket(plan, lo, centers, r_src, payload)
+    proxy_pts, proxy_f = _upward(plan, lo, src_b, pay_b, dtype)
+
+    nid_np, uniq_np = _neighbor_table(plan.depth)
+    nid = jnp.asarray(nid_np)
+    uniq = jnp.asarray(uniq_np)
+    ilist_np, _, _, _ = _interaction_lists(plan.depth)
+    ilist = jnp.asarray(ilist_np)                      # [C3, maxI]
+    maxI = ilist.shape[1]
+
+    # leaf-sorted targets: consecutive rows share (and cache) the same
+    # neighbor buckets / interaction lists; the inverse permutation
+    # restores caller order at the end
+    n_trg = r_trg.shape[0]
+    flat_t = _leaf_ids(plan, lo, r_trg)
+    order = jnp.argsort(flat_t)
+    trg_s = r_trg[order]
+    leaf_s = flat_t[order]
+
+    def near_rows(t_pts, leaf):
+        ids = nid[leaf]                                # [B, 27]
+        s_pts = src_b[ids].reshape(t_pts.shape[0], 27 * mo, 3)
+        pay = jnp.where(uniq[leaf][:, :, None, None], pay_b[ids], 0.0)
+        return near_fn(t_pts, s_pts,
+                       pay.reshape(t_pts.shape[0], 27 * mo, C))
+
+    u = _chunked_map(near_rows, (trg_s, leaf_s), n_trg,
+                     27 * mo * (3 + C)) * scale_near
+
+    def far_rows(t_pts, leaf):
+        ids = ilist[leaf]                              # [B, maxI]
+        s_pts = proxy_pts[ids].reshape(t_pts.shape[0], maxI * p3, 3)
+        s_f = proxy_f[ids].reshape(t_pts.shape[0], maxI * p3, C)
+        return far_fn(t_pts, s_pts, s_f)
+
+    u = u + _chunked_map(far_rows, (trg_s, leaf_s), n_trg,
+                         maxI * p3 * (3 + C)) * scale_far
+
+    out = jnp.zeros((n_trg, 3), dtype=dtype)
+    return out.at[order].set(u)
+
+
+# ------------------------------------------------------------------ kernels
+
+def _stokeslet_rows(trg, src, f):
+    """Row-major Stokeslet partial sum: [B, 3] targets, each against its
+    OWN [B, S, 3] source set — the same masking/regularization semantics
+    as `kernels.stokeslet_block` (which shares one source block across
+    target rows and so cannot serve the per-row gathers here)."""
+    d = trg[:, None, :] - src
+    r2 = jnp.sum(d * d, axis=-1)
+    mask = r2 > 0.0
+    rinv = jnp.where(mask, lax.rsqrt(jnp.where(mask, r2, 1.0)), 0.0)
+    rinv3 = rinv * rinv * rinv
+    df = jnp.sum(d * f, axis=-1)
+    return (jnp.einsum("bs,bsk->bk", rinv, f)
+            + jnp.einsum("bs,bsk->bk", df * rinv3, d))
+
+
+def _stresslet_rows(trg, src, pay):
+    """Row-major stresslet partial sum (`kernels.stresslet_block` semantics;
+    ``pay`` carries the 9 flat S components per source)."""
+    S = pay.reshape(pay.shape[0], pay.shape[1], 3, 3)
+    d = trg[:, None, :] - src
+    r2 = jnp.sum(d * d, axis=-1)
+    mask = r2 > 0.0
+    rinv = jnp.where(mask, lax.rsqrt(jnp.where(mask, r2, 1.0)), 0.0)
+    rinv5 = rinv * rinv * rinv * rinv * rinv
+    dSd = jnp.einsum("bsi,bsij,bsj->bs", d, S, d)
+    return jnp.einsum("bs,bsk->bk", -3.0 * dSd * rinv5, d)
+
+
+def _oseen_rows(trg, src, density, eta, reg, epsilon_distance):
+    """Row-major regularized-Oseen partial sum (`kernels.oseen_block`
+    semantics, already eta-scaled via fr/gr)."""
+    d = trg[:, None, :] - src
+    r2 = jnp.sum(d * d, axis=-1)
+    fr, gr = kernels._regularized_frgr(r2, eta, reg, epsilon_distance)
+    df = jnp.sum(d * density, axis=-1)
+    return (jnp.einsum("bs,bsk->bk", fr, density)
+            + jnp.einsum("bs,bsk->bk", gr * df, d))
+
+
+@partial(jax.jit, static_argnames=("plan",))
+def _stokeslet_tree_impl(plan: TreePlan, anchors, r_src, r_trg, f_src, eta):
+    """Jitted core; ``plan`` must be anchor-stripped and ``anchors`` is the
+    [1, 3] traced box_lo operand."""
+    lo = anchors[0].astype(r_src.dtype)
+    factor = 1.0 / (8.0 * math.pi)
+    return _tree_eval(plan, lo, r_src, f_src, r_trg,
+                      _stokeslet_rows, _stokeslet_rows,
+                      factor / eta, factor / eta)
+
+
+@partial(jax.jit, static_argnames=("plan",))
+def _stresslet_tree_impl(plan: TreePlan, anchors, r_dl, r_trg, f_dl, eta):
+    lo = anchors[0].astype(r_dl.dtype)
+    factor = 1.0 / (8.0 * math.pi)
+    return _tree_eval(plan, lo, r_dl, f_dl.reshape(-1, 9), r_trg,
+                      _stresslet_rows, _stresslet_rows,
+                      factor / eta, factor / eta)
+
+
+@partial(jax.jit, static_argnames=("plan",))
+def _oseen_tree_impl(plan: TreePlan, anchors, r_src, r_trg, density, eta,
+                     reg, epsilon_distance):
+    lo = anchors[0].astype(r_src.dtype)
+
+    def near(trg, src, pay):
+        # already 1/(8 pi eta)-scaled via fr/gr; regularization only acts
+        # within epsilon_distance, far below the cell size, so the far
+        # field is the plain Stokeslet cluster evaluation
+        return _oseen_rows(trg, src, pay, eta, reg, epsilon_distance)
+
+    return _tree_eval(plan, lo, r_src, density, r_trg,
+                      near, _stokeslet_rows,
+                      1.0, 1.0 / (8.0 * math.pi) / eta)
+
+
+def stokeslet_tree(plan: TreePlan, r_src, r_trg, f_src, eta):
+    """Singular Stokeslet sum via the treecode: same semantics as
+    `kernels.stokeslet_direct` (coincident pairs drop — they always land in
+    the exact near tile, so no analytic self term exists anywhere).
+
+    ``depth == 0`` plans dispatch to the dense kernel itself (bitwise
+    identical). The box anchor enters traced: a drifting cloud whose
+    quantized anchor hops one leaf-lattice step reuses the compiled
+    program.
+    """
+    if plan.depth == 0:
+        return kernels.stokeslet_direct(r_src, r_trg, f_src, eta)
+    return _stokeslet_tree_impl(strip_anchors(plan),
+                                plan_anchors(plan, r_src.dtype),
+                                r_src, r_trg, f_src, eta)
+
+
+def stresslet_tree(plan: TreePlan, r_dl, r_trg, f_dl, eta):
+    """Singular stresslet (double-layer) sum via the treecode; ``f_dl`` is
+    [n_src, 3, 3] like `kernels.stresslet_direct`. The double-layer kernel
+    carries one extra derivative, so achieved error runs a few x the
+    Stokeslet-calibrated tol — plan a tighter tol for double-layer targets
+    (same guidance as `stresslet_ewald`)."""
+    if plan.depth == 0:
+        return kernels.stresslet_direct(r_dl, r_trg, f_dl, eta)
+    return _stresslet_tree_impl(strip_anchors(plan),
+                                plan_anchors(plan, r_dl.dtype),
+                                r_dl, r_trg, f_dl, eta)
+
+
+def oseen_tree(plan: TreePlan, r_src, r_trg, density, eta,
+               reg=kernels.DEFAULT_REG,
+               epsilon_distance=kernels.DEFAULT_EPS):
+    """Regularized-Oseen contraction via the treecode: same semantics as
+    `kernels.oseen_contract` (near-field regularization below
+    ``epsilon_distance``, coincident pairs drop)."""
+    if plan.depth == 0:
+        return kernels.oseen_contract(r_src, r_trg, density, eta, reg,
+                                      epsilon_distance)
+    return _oseen_tree_impl(strip_anchors(plan),
+                            plan_anchors(plan, r_src.dtype),
+                            r_src, r_trg, density, eta, reg,
+                            epsilon_distance)
+
+
+# ---------------------------------------------------------------- skelly-audit
+
+def auditable_programs():
+    """The ops layer's audit entry: the jitted treecode Stokeslet evaluator
+    on a fiber-like clustered cloud. Its contract pins that the hot fast
+    path is collective-free single-chip, callback-free, carries the state
+    dtype end to end (no promotions), and compiles once across anchor hops
+    (the drift-stability invariant `plan_tree` exists to provide)."""
+    from ..audit.registry import AuditProgram, built_from
+
+    def make_scene():
+        rng = np.random.default_rng(61)
+        nf, nn = 32, 16
+        origins = rng.uniform(-2, 2, (nf, 3))
+        dirs = rng.normal(size=(nf, 3))
+        dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+        t = np.linspace(0, 1.0, nn)
+        pts = (origins[:, None, :]
+               + t[None, :, None] * dirs[:, None, :]).reshape(-1, 3)
+        f = rng.standard_normal((len(pts), 3))
+        plan = plan_tree(pts, tol=1e-4)
+        return plan, jnp.asarray(pts), jnp.asarray(f)
+
+    def build():
+        plan, pts, f = make_scene()
+        return built_from(_stokeslet_tree_impl, strip_anchors(plan),
+                          plan_anchors(plan), pts, pts, f, 1.0)
+
+    def retrace_probe():
+        from ..testing import trace_counting_jit
+
+        plan, pts, f = make_scene()
+        step = trace_counting_jit(_stokeslet_tree_impl.__wrapped__,
+                                  static_argnames=("plan",))
+        step(strip_anchors(plan), plan_anchors(plan), pts, pts, f, 1.0)
+        # anchor hop + drifted values: same program, must not retrace
+        step(strip_anchors(plan), plan_anchors(plan) + plan.leaf_size,
+             pts + 0.01, pts + 0.01, f, 1.0)
+        return step.trace_count
+
+    return [AuditProgram(
+        name="stokeslet_tree", layer="ops",
+        summary="treecode Stokeslet evaluator (depth-2 octree, clustered "
+                "fiber cloud, f64)",
+        build=build, retrace_probe=retrace_probe)]

@@ -152,10 +152,22 @@ def _make_rdot(axis: str, nonrep_end: int) -> Callable:
 def build_spmd_step(system, mesh: Mesh, state: SimState, *,
                     allow_replicated_shell: bool = False,
                     flat_solution: bool = True, donate: str | bool = "auto",
-                    jit_wrapper=None):
+                    pair=None, jit_wrapper=None):
     """Build the jitted explicitly-sharded full step for states shaped like
     ``state``. Returns ``step(state) -> (new_state, solution, info)`` with
     ``new_state`` still sharded on ``mesh``.
+
+    ``pair`` (an anchor-stripped `ops.evaluator.PairEvaluator` carrying a
+    `TreePlan`) routes the Krylov-interior fiber Stokeslet flows through
+    the treecode instead of the ring (`fibers.container.flow_multi_local`'s
+    tree branch: one tiled source all-gather + per-shard tree evaluation at
+    resident targets). The built ``step`` then takes the plan's traced
+    anchors as a second argument — `System.step_spmd` supplies both. The
+    f64 refinement-residual matvec and prep flows keep the same role gating
+    as the single-chip solve (dense — tree_tol must not cap the refined
+    residual in mixed mode); the Gauss-Seidel shell correction stays on the
+    ring path (the shell double layer is not the O(N^2) wall this evaluator
+    exists to break).
 
     ``flat_solution=True`` assembles the reference-layout flat solution
     vector outside the mesh program (one explicit gather — skip it at scale
@@ -185,6 +197,38 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
     refine = precision == "mixed" and is_f64
     prep_impl = hi_impl = (system._refine_impl if refine else p.kernel_impl)
     precond_dtype = jnp.float32 if precision == "mixed" else None
+    has_pair = pair is not None and getattr(pair, "is_fast", False)
+    if has_pair and pair.evaluator != "tree":
+        # flow_multi_local's fast branch serves ONLY the tree: an ewald
+        # spec would pass validation, thread a dead anchors operand, and
+        # silently run the O(N^2/D) ring flows the caller thinks it
+        # replaced (the FFT-grid evaluator has no per-shard decomposition
+        # here — docs/parallel.md)
+        raise ValueError(
+            f"build_spmd_step(pair=...) composes only the 'tree' "
+            f"evaluator with the SPMD step, got {pair.evaluator!r}; "
+            "pass pair=None for the ring flows")
+    if has_pair:
+        # the SPMD layout has no global inactive-slot spread (flow_multi's
+        # _spread_inactive needs the full concatenated active mask, which
+        # no single shard holds): padding nodes replicating slot 0 would
+        # pile into one leaf and overflow the plan's static bucket
+        # capacity, silently evicting real sources (_bucket's rank clamp).
+        # System.step_spmd falls back to the ring flows for such states;
+        # direct callers of this seam get a build-time error, not wrong
+        # physics.
+        import numpy as np
+        if not all(bool(np.all(np.asarray(g.active)))
+                   for g in fiber_buckets(state.fibers)):
+            raise ValueError(
+                "build_spmd_step(pair=...) requires every fiber slot "
+                "active: the SPMD layout cannot spread inactive padding "
+                "nodes, which would overflow the fast plan's static leaf "
+                "buckets; pass pair=None (ring flows) for states with "
+                "inactive capacity")
+    # mixed-mode prep flows stay dense through the refinement tile — the
+    # same role gating as System._prep (tree_tol must not cap RHS accuracy)
+    prep_pair = None if (refine or not has_pair) else pair
 
     def node_targets(st, body_caches):
         """(r_loc, r_rep, nf_nodes_local): shard-resident target rows
@@ -214,7 +258,7 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
 
     # ----------------------------------------------------------------- prep
 
-    def prep(st):
+    def prep(st, anchors=None):
         """Port of `System._prep` to the SPMD layout: all per-fiber work
         (caches, BC/RHS assembly, LU factorization) on the owning shard;
         explicit flows ring at resident rows, psum onto replicated rows."""
@@ -243,7 +287,8 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
                  for g, c in zip(buckets, caches)]
         fl, fp = fc.flow_multi_local(buckets, caches, external, r_loc, r_rep,
                                      p.eta, axis_name=axis, n_dev=n_dev,
-                                     subtract_self=True, impl=prep_impl)
+                                     subtract_self=True, impl=prep_impl,
+                                     pair=prep_pair, pair_anchors=anchors)
         v_loc = v_loc + fl
         v_rep_part = fp
 
@@ -298,10 +343,12 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
 
     # --------------------------------------------------------- the operator
 
-    def make_matvec(st, caches, body_caches, lo=None, flow_impl=None):
+    def make_matvec(st, caches, body_caches, lo=None, flow_impl=None,
+                    pair_spec=None, pair_anchors=None):
         """Port of `System._apply_matvec` to the SPMD layout (same lo-seam
         semantics: all flows/dense ops through the f32 copies, stiff
-        fiber-local rows in the solve dtype)."""
+        fiber-local rows in the solve dtype). ``pair_spec`` routes the
+        fiber Stokeslet flow through `flow_multi_local`'s tree branch."""
         impl = p.kernel_impl if flow_impl is None else flow_impl
         buckets = fiber_buckets(st.fibers)
         b_list = body_buckets(st.bodies)
@@ -337,7 +384,8 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
             fl, fp = fc.flow_multi_local(
                 f_buckets, f_caches, [fw.astype(lo_dtype) for fw in fws],
                 r_loc, r_rep, p.eta, axis_name=axis, n_dev=n_dev,
-                subtract_self=True, impl=impl)
+                subtract_self=True, impl=impl, pair=pair_spec,
+                pair_anchors=pair_anchors)
             v_loc = v_loc + fl
             if fp is not None:
                 v_rep_part = v_rep_part + fp
@@ -544,8 +592,8 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
 
     # ------------------------------------------------------------ local step
 
-    def local_step(st):
-        st, caches, body_caches, shell_rhs, body_rhs = prep(st)
+    def local_step(st, anchors=None):
+        st, caches, body_caches, shell_rhs, body_rhs = prep(st, anchors)
         buckets = fiber_buckets(st.fibers)
         b_list = body_buckets(st.bodies)
         fib_size, shell_size, _ = system._sizes(st)
@@ -560,11 +608,15 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
         nonrep_end = fib_size + (shell_size if sharded_shell else 0)
         rdot = _make_rdot(axis, nonrep_end)
 
+        krylov_pair = pair if has_pair else None
         if precision == "mixed":
             lo = _cast_floats((st, caches, body_caches), jnp.float32)
             result = gmres_ir(
+                # hi residual matvec: dense regardless of the spec — the
+                # fast evaluator's tol must not cap residual_true
                 make_matvec(st, caches, body_caches, flow_impl=hi_impl),
-                make_matvec(st, caches, body_caches, lo=lo),
+                make_matvec(st, caches, body_caches, lo=lo,
+                            pair_spec=krylov_pair, pair_anchors=anchors),
                 rhs,
                 precond_lo=make_precond(lo[0], lo[1], lo[2]),
                 tol=p.gmres_tol, inner_tol=p.inner_tol,
@@ -572,7 +624,8 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
                 max_refine=p.max_refine, rdot=rdot)
         else:
             result = gmres(
-                make_matvec(st, caches, body_caches), rhs,
+                make_matvec(st, caches, body_caches, pair_spec=krylov_pair,
+                            pair_anchors=anchors), rhs,
                 precond=make_precond(st, caches, body_caches),
                 tol=p.gmres_tol, restart=p.gmres_restart,
                 maxiter=p.gmres_maxiter, rdot=rdot)
@@ -653,12 +706,25 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
     # (every solver loop is lax.while_loop), and replicated-output
     # correctness is guaranteed by construction here (psum-or-replicated
     # inputs only — see the module docstring) and pinned by the parity tests
-    sharded = shard_map(local_step, mesh=mesh, in_specs=(state_specs,),
-                        out_specs=(state_specs, sol_specs, info_specs),
-                        check_vma=False)
+    if has_pair:
+        # the plan's traced anchors enter as one replicated operand so a
+        # quantized anchor hop under drift reuses the compiled program
+        sharded = shard_map(local_step, mesh=mesh,
+                            in_specs=(state_specs, P()),
+                            out_specs=(state_specs, sol_specs, info_specs),
+                            check_vma=False)
+    else:
+        sharded = shard_map(lambda st: local_step(st), mesh=mesh,
+                            in_specs=(state_specs,),
+                            out_specs=(state_specs, sol_specs, info_specs),
+                            check_vma=False)
 
-    def step(st):
-        new_state, (sol_fibs, sol_shell, sol_body), info = sharded(st)
+    def step(st, pair_anchors=None):
+        if has_pair:
+            new_state, (sol_fibs, sol_shell, sol_body), info = sharded(
+                st, pair_anchors)
+        else:
+            new_state, (sol_fibs, sol_shell, sol_body), info = sharded(st)
         if flat_solution:
             parts = [s.reshape(-1) for s in sol_fibs]
             if sol_shell is not None:

@@ -74,13 +74,25 @@ class Params:
     # a mesh; falls back to direct when a shell/bodies are present); "ewald" =
     # O(N log N) spectral Ewald (`ops.ewald` — the slot the reference fills
     # with STKFMM) for the fiber Stokeslet flows, re-planned host-side each
-    # step like the reference's FMM tree rebuild
+    # step like the reference's FMM tree rebuild; "tree" = the O(N log N)
+    # barycentric Lagrange treecode (`ops.treecode` — the hierarchical
+    # answer to the same FMM slot: fixed-depth octree, static interaction
+    # lists, MXU-batched cluster matmuls), composing with both the
+    # single-chip solve and the SPMD step (docs/treecode.md)
     pair_evaluator: str = "direct"
     # target relative accuracy of the Ewald evaluator; in "mixed" solver
     # precision the Ewald path serves only the f32 Krylov interior (the f64
     # refinement residual stays on the dense double-float tile), so 1e-6
     # does not cap the converged residual
     ewald_tol: float = 1e-6
+    # target relative accuracy of the treecode evaluator
+    # (`ops.treecode.plan_tree` picks interpolation order p from it via the
+    # measured ~5x-per-order contraction rule, and octree depth from the
+    # active node count). Same role gating as ewald_tol in "mixed"
+    # precision: the tree serves the f32 Krylov interior only, so the
+    # looser default does not cap the converged residual — and at f32 the
+    # dense tile's own rounding is ~1e-6 on big sums anyway
+    tree_tol: float = 1e-4
     # pairwise-kernel tile implementation: "exact" (displacement-tensor form,
     # the reference's semantics bit-for-bit), "mxu" (matmul form — the
     # O(N^2*3) contractions ride the MXU; see kernels.stokeslet_block_mxu's
@@ -145,13 +157,14 @@ class Params:
     # application — asymptotically cheaper than the full matvec. With no
     # shell (or nothing coupled to it) the two settings are identical.
     precond: str = "gs"
-    # pair_evaluator="ewald" routes a component's pairwise flow through the
-    # spectral-Ewald evaluator only when its SOURCE count reaches this bound;
-    # below it the dense tile is strictly cheaper than an extra FFT-grid
-    # pass (a 400-node body against 640k targets is ~0.26 Gpairs — tens of
-    # ms dense, vs a full M^3 grid round-trip). Host-side static dispatch,
-    # mirroring how the reference only pays FMM setup for point sets that
-    # warrant it; set to 0 to force every flow through Ewald (parity tests)
+    # a fast pair_evaluator ("ewald"/"tree") routes a component's pairwise
+    # flow through its evaluator only when its SOURCE count reaches this
+    # bound; below it the dense tile is strictly cheaper than an extra
+    # FFT-grid / tree-traversal pass (a 400-node body against 640k targets
+    # is ~0.26 Gpairs — tens of ms dense, vs a full M^3 grid round-trip).
+    # Host-side static dispatch, mirroring how the reference only pays FMM
+    # setup for point sets that warrant it; set to 0 to force every flow
+    # through the fast evaluator (parity tests)
     ewald_min_sources: int = 2048
     implicit_motor_activation_delay: float = 0.0
     periphery_interaction_flag: bool = False

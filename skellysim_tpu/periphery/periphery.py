@@ -201,10 +201,13 @@ def update_RHS(v_on_shell):
 
 
 def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct",
-         mesh=None, impl: str = "exact", ewald_plan=None, ewald_anchors=None):
+         mesh=None, impl: str = "exact", ewald_plan=None, ewald_anchors=None,
+         pair=None, pair_anchors=None):
     """Shell -> target velocities via the double-layer stresslet
     (`periphery.cpp:55-79`): f_dl = 2 eta n (x) rho.
 
+    Evaluator selection rides a `ops.evaluator.PairEvaluator` spec
+    (``pair`` + traced ``pair_anchors``) or the legacy loose kwargs.
     ``evaluator="ring"`` (with a mesh) rotates shell-node source blocks around
     the ICI ring — the same pair-evaluator seam as `fibers.container.flow`
     (reference: one evaluator serves all components, `kernels.hpp:78-122`).
@@ -212,15 +215,29 @@ def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct
     pad the *target* rows (see `System._ring_pad_targets`).
 
     ``evaluator="ewald"`` (with a plan covering shell nodes + targets) sums
-    the double layer in O(N log N) via the spectral-Ewald stresslet — the
+    the double layer in O(N log N) via the spectral-Ewald stresslet, and
+    ``evaluator="tree"`` via the barycentric-treecode stresslet — the
     reference's one-evaluator-serves-all design (`periphery.cpp:337-352`
     routes the shell's stresslet through the FMM). The shell's
     SELF-interaction is not computed here in any mode: `System._apply_matvec`
     evaluates this flow at fiber/body rows only, the self block living in
     the dense stored operator.
     """
+    from ..ops.evaluator import resolve
+
+    evaluator, impl, ewald_plan, ewald_anchors, pair_anchors = resolve(
+        pair, pair_anchors, r_trg.dtype, evaluator, impl, ewald_plan,
+        ewald_anchors)
     rho = density.reshape(-1, 3)
     f_dl = 2.0 * eta * shell.normals[:, :, None] * rho[:, None, :]
+    if (pair is not None and evaluator == "tree" and pair.plan is not None):
+        from ..ops import treecode as tcode
+
+        if pair.plan.depth == 0:
+            return kernels.stresslet_direct(shell.nodes, r_trg, f_dl, eta,
+                                            impl=impl)
+        return tcode._stresslet_tree_impl(pair.plan, pair_anchors,
+                                          shell.nodes, r_trg, f_dl, eta)
     if evaluator == "ewald" and ewald_plan is not None:
         from ..ops import ewald as ew
 

@@ -160,10 +160,12 @@ class System:
 
     def __init__(self, params: Params, shell_shape: PeripheryShape | None = None,
                  mesh=None):
-        if params.pair_evaluator not in ("direct", "ring", "ewald"):
+        from ..ops.evaluator import EVALUATORS
+
+        if params.pair_evaluator not in EVALUATORS:
             raise ValueError(
                 f"unknown pair_evaluator {params.pair_evaluator!r}; "
-                "runtime values are 'direct', 'ring', or 'ewald'")
+                f"runtime values are {', '.join(map(repr, EVALUATORS))}")
         if params.solver_precision not in ("full", "mixed", "auto"):
             raise ValueError(
                 f"unknown solver_precision {params.solver_precision!r}; "
@@ -194,7 +196,7 @@ class System:
         # a counter bump per call. `.trace()` passes through, so the audit
         # registry's `built_from` keeps consuming these directly.
         self._solve_jit = observed_jit(self._solve_impl, name="system.solve",
-                                       static_argnames=("ewald_plan",))
+                                       static_argnames=("pair",))
         # donating twin for the run loop: the input state's buffers (the
         # dense shell operators above all) alias into the unchanged output
         # leaves instead of double-buffering per step. Only safe where a
@@ -204,7 +206,7 @@ class System:
         # (tests pin the aliasing at lowering time instead).
         self._solve_jit_donated = observed_jit(self._solve_impl,
                                                name="system.solve_donated",
-                                               static_argnames=("ewald_plan",),
+                                               static_argnames=("pair",),
                                                donate_argnums=(0,))
         #: built SPMD step programs keyed by (mesh, state structure) —
         #: see `step_spmd`
@@ -213,7 +215,7 @@ class System:
                                            name="system.collision")
         self._vel_jit = observed_jit(self._velocity_at_targets_impl,
                                      name="system.velocity_at_targets",
-                                     static_argnames=("ewald_plan",))
+                                     static_argnames=("pair",))
 
     @property
     def _refine_impl(self) -> str:
@@ -263,7 +265,7 @@ class System:
 
     def _fiber_flow(self, state: SimState, caches_list, r_trg, forces_list,
                     subtract_self: bool = True, impl: str | None = None,
-                    ewald_plan=None, ewald_anchors=None):
+                    pair=None, pair_anchors=None):
         """Fiber-source flow through the selected pair evaluator
         (the reference's `params.pair_evaluator` seam,
         `fiber_container_base.cpp:20-33`). All resolution buckets contribute
@@ -277,17 +279,17 @@ class System:
         buckets = fiber_buckets(state.fibers)
         if impl is None:
             impl = self.params.kernel_impl
-        if ewald_plan is not None:
-            # the O(N log N) evaluator serves whoever passes a plan; callers
-            # whose flows must stay dense (the mixed solver's f64
-            # residual/prep — the Ewald tolerance must not cap the refined
-            # residual) pass ewald_plan=None, gating on the flow's ROLE
-            # rather than the tile name (refine_pair_impl="auto" resolves to
-            # "exact" on CPU, so an impl-name gate leaked those flows here)
+        if pair is not None and pair.is_fast:
+            # the O(N log N) evaluators serve whoever passes a planned
+            # spec; callers whose flows must stay dense (the mixed
+            # solver's f64 residual/prep — ewald_tol/tree_tol must not cap
+            # the refined residual) pass pair=None, gating on the flow's
+            # ROLE rather than the tile name (refine_pair_impl="auto"
+            # resolves to "exact" on CPU, so an impl-name gate leaked
+            # those flows here)
             return fc.flow_multi(buckets, caches_list, r_trg, forces_list,
                                  self.params.eta, subtract_self=subtract_self,
-                                 evaluator="ewald", ewald_plan=ewald_plan,
-                                 ewald_anchors=ewald_anchors)
+                                 pair=pair, pair_anchors=pair_anchors)
         if not self._ring_active():
             return fc.flow_multi(buckets, caches_list, r_trg, forces_list,
                                  self.params.eta, subtract_self=subtract_self,
@@ -305,24 +307,22 @@ class System:
         return vel[:T]
 
     def _shell_flow(self, state: SimState, r_trg, density,
-                    impl: str | None = None, ewald_plan=None,
-                    ewald_anchors=None):
+                    impl: str | None = None, pair=None, pair_anchors=None):
         """Shell -> target flow through the pair-evaluator seam
         (`include/kernels.hpp:78-122`: one evaluator serves all components).
         The density->f_dl math and source padding live in `peri.flow`; only
-        the target padding is System's job. A supplied ``ewald_plan`` routes
-        the double layer through the spectral-Ewald stresslet (the
-        reference's `periphery.cpp:337-352` FMM path) when the shell is
-        large enough to warrant it (`params.ewald_min_sources`); callers
-        whose flows must stay dense (mixed-mode refinement/prep) pass no
-        plan."""
+        the target padding is System's job. A supplied fast ``pair`` spec
+        routes the double layer through the spectral-Ewald or treecode
+        stresslet (the reference's `periphery.cpp:337-352` FMM path) when
+        the shell is large enough to warrant it
+        (`params.ewald_min_sources`); callers whose flows must stay dense
+        (mixed-mode refinement/prep) pass no spec."""
         if impl is None:
             impl = self.params.kernel_impl
-        if (ewald_plan is not None
+        if (pair is not None and pair.is_fast
                 and state.shell.n_nodes >= self.params.ewald_min_sources):
             return peri.flow(state.shell, r_trg, density, self.params.eta,
-                             evaluator="ewald", ewald_plan=ewald_plan,
-                             ewald_anchors=ewald_anchors)
+                             pair=pair, pair_anchors=pair_anchors)
         if not self._ring_active():
             return peri.flow(state.shell, r_trg, density, self.params.eta,
                              impl=impl)
@@ -330,15 +330,16 @@ class System:
         return peri.flow(state.shell, r_pad, density, self.params.eta,
                          evaluator="ring", mesh=self.mesh, impl=impl)[:T]
 
-    def _body_ewald_args(self, group, ewald_plan, ewald_anchors):
-        """(plan, anchors) for one body bucket's double-layer flow, or
+    def _body_pair_args(self, group, pair, pair_anchors):
+        """(pair, anchors) for one body bucket's double-layer flow, or
         (None, None) when its node count is below `params.ewald_min_sources`
-        (dense is strictly cheaper than an extra FFT-grid pass there)."""
-        if (ewald_plan is None or group is None
+        (dense is strictly cheaper than an extra fast-evaluator pass
+        there)."""
+        if (pair is None or not pair.is_fast or group is None
                 or group.n_bodies * group.n_nodes
                 < self.params.ewald_min_sources):
             return None, None
-        return ewald_plan, ewald_anchors
+        return pair, pair_anchors
 
     # ------------------------------------------------------------- state setup
 
@@ -463,8 +464,8 @@ class System:
 
     # ------------------------------------------------------------------- prep
 
-    def _prep(self, state: SimState, ewald_plan=None,
-              ewald_anchors=None):
+    def _prep(self, state: SimState, pair=None,
+              pair_anchors=None):
         """All velocities/forces/RHS/BC assembly (`prep_state_for_solver`,
         `system.cpp:398-458`). Returns (state, fiber caches, body caches,
         shell RHS, body RHS)."""
@@ -490,8 +491,8 @@ class System:
         refine_prep = (precision == "mixed"
                        and state.time.dtype == jnp.float64)
         impl_flow = self._refine_impl if refine_prep else p.kernel_impl
-        prep_plan = None if refine_prep else ewald_plan
-        prep_anchors = None if refine_prep else ewald_anchors
+        prep_pair = None if refine_prep else pair
+        prep_anchors = None if refine_prep else pair_anchors
 
         if buckets:
             caches = [fc.update_cache(g, state.dt, p.eta) for g in buckets]
@@ -504,8 +505,8 @@ class System:
 
             v_all = v_all + self._fiber_flow(state, caches, r_all, external,
                                              impl=impl_flow,
-                                             ewald_plan=prep_plan,
-                                             ewald_anchors=prep_anchors)
+                                             pair=prep_pair,
+                                             pair_anchors=prep_anchors)
 
         b_list = body_buckets(state.bodies)
         if b_list:
@@ -551,8 +552,8 @@ class System:
     # ------------------------------------------------------- operator closures
 
     def _apply_matvec(self, state: SimState, caches, body_caches, x_flat,
-                      lo=None, flow_impl: str | None = None, ewald_plan=None,
-                      ewald_anchors=None):
+                      lo=None, flow_impl: str | None = None, pair=None,
+                      pair_anchors=None):
         """Coupled operator A x (`apply_matvec`, `system.cpp:269-324`).
 
         ``lo`` is an optional (state, caches, body_caches) triple whose float
@@ -601,8 +602,8 @@ class System:
                                              [fw.astype(lo_dtype) for fw in fws],
                                              subtract_self=True,
                                              impl=flow_impl,
-                                             ewald_plan=ewald_plan,
-                                             ewald_anchors=ewald_anchors)
+                                             pair=pair,
+                                             pair_anchors=pair_anchors)
 
         if shell is not None and (buckets or bodies is not None):
             # shell flow is evaluated at fiber and body nodes only; the shell
@@ -612,8 +613,8 @@ class System:
             v_shell2fibbody = self._shell_flow(f_state, r_fibbody,
                                                x_shell.astype(lo_dtype),
                                                impl=flow_impl,
-                                               ewald_plan=ewald_plan,
-                                               ewald_anchors=ewald_anchors)
+                                               pair=pair,
+                                               pair_anchors=pair_anchors)
             v_all = v_all.at[:nf_nodes].add(v_shell2fibbody[:nf_nodes])
             v_all = v_all.at[nf_nodes + ns_nodes:].add(v_shell2fibbody[nf_nodes:])
 
@@ -650,13 +651,13 @@ class System:
             for gb, f_gb, f_bc, xb, ft in zip(b_list, f_b_list,
                                               f_bcaches or [None] * len(b_list),
                                               x_bods, body_fts):
-                b_plan, b_anchors = self._body_ewald_args(gb, ewald_plan,
-                                                          ewald_anchors)
+                b_plan, b_anchors = self._body_pair_args(gb, pair,
+                                                          pair_anchors)
                 v_all = v_all + bd.flow(f_gb, f_bc, r_all,
                                         xb.astype(lo_dtype),
                                         ft.astype(lo_dtype), p.eta,
-                                        impl=flow_impl, ewald_plan=b_plan,
-                                        ewald_anchors=b_anchors)
+                                        impl=flow_impl, pair=b_plan,
+                                        pair_anchors=b_anchors)
 
         res = []
         off = 0
@@ -684,7 +685,7 @@ class System:
         return jnp.concatenate(res)
 
     def _apply_precond(self, state: SimState, caches, body_caches, x_flat,
-                       ewald_plan=None, ewald_anchors=None):
+                       pair=None, pair_anchors=None):
         """Block preconditioner P^-1 x.
 
         `precond="jacobi"` is the reference's independent block solves
@@ -722,8 +723,8 @@ class System:
             # a preconditioner only approximates, so f32 flow is plenty
             v_corr = self._shell_flow(state, r_fibbody,
                                       y_shell.astype(state.shell.nodes.dtype),
-                                      ewald_plan=ewald_plan,
-                                      ewald_anchors=ewald_anchors
+                                      pair=pair,
+                                      pair_anchors=pair_anchors
                                       ).astype(x_flat.dtype)
 
         res = []
@@ -764,11 +765,11 @@ class System:
 
     # ------------------------------------------------------------------- solve
 
-    def _solve_impl(self, state: SimState, ewald_plan=None,
-                    ewald_anchors=None):
+    def _solve_impl(self, state: SimState, pair=None,
+                    pair_anchors=None):
         p = self.params
         state, caches, body_caches, shell_rhs, body_rhs = self._prep(
-            state, ewald_plan=ewald_plan, ewald_anchors=ewald_anchors)
+            state, pair=pair, pair_anchors=pair_anchors)
 
         rhs_parts = []
         for c in (caches or []):
@@ -797,24 +798,24 @@ class System:
                 lambda v: self._apply_matvec(state, caches, body_caches, v,
                                              flow_impl=hi_impl),
                 lambda v: self._apply_matvec(state, caches, body_caches, v,
-                                             lo=lo, ewald_plan=ewald_plan,
-                                             ewald_anchors=ewald_anchors),
+                                             lo=lo, pair=pair,
+                                             pair_anchors=pair_anchors),
                 rhs,
                 precond_lo=lambda v: self._apply_precond(
-                    lo[0], lo[1], lo[2], v, ewald_plan=ewald_plan,
-                    ewald_anchors=ewald_anchors),
+                    lo[0], lo[1], lo[2], v, pair=pair,
+                    pair_anchors=pair_anchors),
                 tol=p.gmres_tol, inner_tol=p.inner_tol,
                 restart=p.gmres_restart, maxiter=p.gmres_maxiter,
                 max_refine=p.max_refine, history=p.gmres_history)
         else:
             result = gmres(
                 lambda v: self._apply_matvec(state, caches, body_caches, v,
-                                             ewald_plan=ewald_plan,
-                                             ewald_anchors=ewald_anchors),
+                                             pair=pair,
+                                             pair_anchors=pair_anchors),
                 rhs,
                 precond=lambda v: self._apply_precond(
-                    state, caches, body_caches, v, ewald_plan=ewald_plan,
-                    ewald_anchors=ewald_anchors),
+                    state, caches, body_caches, v, pair=pair,
+                    pair_anchors=pair_anchors),
                 tol=p.gmres_tol, restart=p.gmres_restart,
                 maxiter=p.gmres_maxiter, history=p.gmres_history)
 
@@ -879,7 +880,7 @@ class System:
     # -------------------------------------------------------- velocity field
 
     def _velocity_at_targets_impl(self, state: SimState, solution, r_trg,
-                                  ewald_plan=None, ewald_anchors=None):
+                                  pair=None, pair_anchors=None):
         """Velocity field at arbitrary targets from a solved state
         (`velocity_at_targets`, `system.cpp:330-384`).
 
@@ -920,8 +921,8 @@ class System:
             # per-request extended-box plans (`listener.process_request`)
             v = v + self._fiber_flow(state, caches, r_trg, f_on_fibers,
                                      subtract_self=False,
-                                     ewald_plan=ewald_plan,
-                                     ewald_anchors=ewald_anchors)
+                                     pair=pair,
+                                     pair_anchors=pair_anchors)
 
         x_bods = []
         if b_list:
@@ -940,17 +941,17 @@ class System:
                     _, ft = bd.link_conditions(
                         gb, bc, bd.local_binding(g, gb, nbt), c, xf, xb)
                     body_ft = body_ft + ft
-                b_plan, b_anchors = self._body_ewald_args(gb, ewald_plan,
-                                                          ewald_anchors)
+                b_plan, b_anchors = self._body_pair_args(gb, pair,
+                                                          pair_anchors)
                 v = v + bd.flow(gb, bc, r_trg, xb, body_ft, p.eta,
-                                impl=p.kernel_impl, ewald_plan=b_plan,
-                                ewald_anchors=b_anchors)
+                                impl=p.kernel_impl, pair=b_plan,
+                                pair_anchors=b_anchors)
 
         if shell is not None:
             v = v + self._shell_flow(state, r_trg,
                                      solution[fib_size:fib_size + shell_size],
-                                     ewald_plan=ewald_plan,
-                                     ewald_anchors=ewald_anchors)
+                                     pair=pair,
+                                     pair_anchors=pair_anchors)
 
         v = v + self._external_flows(state, r_trg)
 
@@ -988,12 +989,12 @@ class System:
         return v
 
     def velocity_at_targets(self, state: SimState, solution, r_trg):
-        """Jitted velocity field evaluation at [n, 3] targets; the ewald
-        evaluator (when configured) plans over nodes + targets so off-node
-        probes stay inside the cell region."""
-        plan, anchors = self._ewald_args(state, extra_targets=r_trg)
-        return self._vel_jit(state, solution, r_trg, ewald_plan=plan,
-                             ewald_anchors=anchors)
+        """Jitted velocity field evaluation at [n, 3] targets; a configured
+        fast evaluator (ewald/tree) plans over nodes + targets so off-node
+        probes stay inside the cell/box region."""
+        pair, anchors = self._pair_args(state, extra_targets=r_trg)
+        return self._vel_jit(state, solution, r_trg, pair=pair,
+                             pair_anchors=anchors)
 
     def _check_collision(self, state: SimState):
         """Fiber/shell + body collision gate (`check_collision`, `system.cpp:576-595`)."""
@@ -1023,19 +1024,15 @@ class System:
 
     # -------------------------------------------------------------- public API
 
-    def make_ewald_plan(self, state: SimState, extra_targets=None):
-        """Host-side Ewald plan over every ACTIVE hydrodynamic node — the
-        analogue of the reference's per-step FMM tree rebuild
-        (`kernels.hpp:78-122`). Quantized planning (`ops.ewald.plan_ewald`)
-        keeps the plan — and so the compiled solve — stable while the
-        geometry drifts. Inactive fiber slots (dynamic-instability padding,
-        which replicate slot 0's coordinates) are excluded from the bounding
-        box and reserved as spread `n_fill` capacity instead — clustered
-        padding would otherwise blow up the per-cell bucket size.
+    def _plan_points(self, state: SimState, extra_targets=None):
+        """(points, n_fill, n_src) over every ACTIVE hydrodynamic node —
+        the shared host-side input of both fast-summation planners.
+        Inactive fiber slots (dynamic-instability padding, which replicate
+        slot 0's coordinates) are excluded from the bounding box and
+        reserved as spread `n_fill` capacity instead — clustered padding
+        would otherwise blow up the per-cell/leaf bucket size.
         ``extra_targets`` extends the box to off-node evaluation points
         (velocity fields)."""
-        from ..ops.ewald import plan_ewald
-
         import numpy as _np
 
         n_fill = 0
@@ -1053,32 +1050,60 @@ class System:
             parts.append(_np.asarray(bd.place(g)[0]).reshape(-1, 3))
         if extra_targets is not None:
             parts.append(_np.asarray(extra_targets).reshape(-1, 3))
-        pts = _np.concatenate(parts, axis=0)
+        return _np.concatenate(parts, axis=0), n_fill, n_src
+
+    def make_ewald_plan(self, state: SimState, extra_targets=None):
+        """Host-side Ewald plan over the `_plan_points` cloud — the
+        analogue of the reference's per-step FMM tree rebuild
+        (`kernels.hpp:78-122`). Quantized planning (`ops.ewald.plan_ewald`)
+        keeps the plan — and so the compiled solve — stable while the
+        geometry drifts."""
+        from ..ops.ewald import plan_ewald
+
+        pts, n_fill, n_src = self._plan_points(state, extra_targets)
         return plan_ewald(pts, eta=self.params.eta,
                           tol=self.params.ewald_tol, n_fill=n_fill,
                           n_src=n_src)
 
-    def _ewald_args(self, state: SimState, extra_targets=None):
-        """(stripped static plan, traced anchors) or (None, None)."""
-        if self.params.pair_evaluator != "ewald":
-            return None, None
-        from ..ops.ewald import plan_anchors, strip_anchors
+    def make_tree_plan(self, state: SimState, extra_targets=None):
+        """Host-side treecode plan over the `_plan_points` cloud
+        (`ops.treecode.plan_tree`) — same quantized-planning discipline as
+        `make_ewald_plan`, choosing octree depth/order from the active node
+        count and `params.tree_tol`."""
+        from ..ops.treecode import plan_tree
 
-        plan = self.make_ewald_plan(state, extra_targets=extra_targets)
-        return strip_anchors(plan), plan_anchors(plan)
+        pts, n_fill, _ = self._plan_points(state, extra_targets)
+        return plan_tree(pts, tol=self.params.tree_tol, n_fill=n_fill)
+
+    def _pair_args(self, state: SimState, extra_targets=None):
+        """(`PairEvaluator` spec, traced anchors) for the configured fast
+        evaluator, or (None, None) for the dense/ring paths. The ONE place
+        evaluator selection + plan construction happens per solve — the
+        spec then rides every flow call site unchanged (satellite of the
+        treecode PR: adding a fourth evaluator must not grow every
+        signature again)."""
+        ev = self.params.pair_evaluator
+        if ev not in ("ewald", "tree"):
+            return None, None
+        from ..ops.evaluator import make_pair
+
+        plan = (self.make_ewald_plan(state, extra_targets=extra_targets)
+                if ev == "ewald"
+                else self.make_tree_plan(state, extra_targets=extra_targets))
+        return make_pair(ev, self.params.kernel_impl, plan)
 
     def step(self, state: SimState):
         """One trial step at state.dt: solve + advance components (`step`,
         `system.cpp:482-492`). Returns (new_state, solution, info)."""
-        plan, anchors = self._ewald_args(state)
-        return self._solve_jit(state, ewald_plan=plan, ewald_anchors=anchors)
+        pair, anchors = self._pair_args(state)
+        return self._solve_jit(state, pair=pair, pair_anchors=anchors)
 
     def _step_donating(self, state: SimState):
         """`step` through the donating jit — the caller's ``state`` buffers
         are CONSUMED on backends with donation support (see __init__)."""
-        plan, anchors = self._ewald_args(state)
-        return self._solve_jit_donated(state, ewald_plan=plan,
-                                       ewald_anchors=anchors)
+        pair, anchors = self._pair_args(state)
+        return self._solve_jit_donated(state, pair=pair,
+                                       pair_anchors=anchors)
 
     def step_spmd(self, state: SimState, mesh, *,
                   allow_replicated_shell: bool = False,
@@ -1092,14 +1117,29 @@ class System:
         info) with ``new_state`` still sharded.
 
         ``donate="auto"`` donates ``state``'s buffers on accelerator
-        backends — do not reuse the argument afterwards there."""
+        backends — do not reuse the argument afterwards there.
+
+        ``pair_evaluator="tree"`` composes with this path: the Krylov
+        matvec's fiber flows route through the treecode on every shard
+        (`fibers.container.flow_multi_local`'s tree branch), re-planned
+        host-side per call like `step`. Requires every fiber slot active —
+        the SPMD layout has no global inactive-slot spread
+        (`fc._spread_inactive` needs the full concatenated active mask),
+        so states with inactive padding fall back to the ring flows."""
+        import numpy as np
+
         from ..parallel.spmd import build_spmd_step
 
         buckets = fiber_buckets(state.fibers)
+        pair = anchors = None
+        if self.params.pair_evaluator == "tree" and all(
+                bool(np.all(np.asarray(g.active))) for g in buckets):
+            pair, anchors = self._pair_args(state)
         key = (mesh, allow_replicated_shell, flat_solution, donate,
                jax.tree_util.tree_structure(state), state.time.dtype,
                tuple(g.n_fibers for g in buckets),
-               state.shell.n_nodes if state.shell is not None else 0)
+               state.shell.n_nodes if state.shell is not None else 0,
+               pair)
         fn = self._spmd_steps.get(key)
         if fn is None:
             from ..obs.compile_log import jit_wrapper
@@ -1107,10 +1147,10 @@ class System:
             fn = build_spmd_step(
                 self, mesh, state,
                 allow_replicated_shell=allow_replicated_shell,
-                flat_solution=flat_solution, donate=donate,
+                flat_solution=flat_solution, donate=donate, pair=pair,
                 jit_wrapper=jit_wrapper(f"step_spmd_d{mesh.size}"))
             self._spmd_steps[key] = fn
-        return fn(state)
+        return fn(state, anchors) if pair is not None else fn(state)
 
     def trial_step(self, state: SimState):
         """The pure, un-jitted trial step: (new_state, solution, info) with a
@@ -1119,8 +1159,9 @@ class System:
         — `jax.vmap(system.trial_step)` batches the whole prep/GMRES/advance
         pipeline, because GMRES already keeps its control flow in `lax`
         primitives (solver/gmres.py "batching" note). Dense evaluators only:
-        the Ewald plan is built host-side per step and cannot live inside a
-        closed batched trace (the ensemble runner rejects it up front)."""
+        the ewald/tree plans are built host-side per step and cannot live
+        inside a closed batched trace (the ensemble runner rejects them up
+        front)."""
         return self._solve_impl(state)
 
     def collision(self, state: SimState):
@@ -1315,7 +1356,7 @@ def auditable_programs():
             state = fixtures.free_state(system)
             fn = (system._solve_jit_donated if donated
                   else system._solve_jit)
-            return built_from(fn, state, ewald_plan=None, ewald_anchors=None)
+            return built_from(fn, state, pair=None, pair_anchors=None)
         return _build
 
     def retrace_probe():
@@ -1323,7 +1364,7 @@ def auditable_programs():
 
         system = fixtures.make_system()
         step = trace_counting_jit(system._solve_impl,
-                                  static_argnames=("ewald_plan",))
+                                  static_argnames=("pair",))
         new_state, _, _ = step(fixtures.free_state(system))
         step(new_state)  # same structure, new values: must not retrace
         return step.trace_count

@@ -32,6 +32,7 @@ def test_bench_prints_one_parseable_json_line(tmp_path):
                 # the real .bench_trace.jsonl (the parent DELETES the
                 # trace path at startup)
                 "BENCH_MULTICHIP_PATH": str(tmp_path / "MULTICHIP.json"),
+                "BENCH_TREECODE_PATH": str(tmp_path / "TREECODE.json"),
                 "BENCH_TRACE_PATH": str(tmp_path / "bench_trace.jsonl")})
     env.pop("JAX_PLATFORMS", None)
     # scrub the conftest's 8-virtual-device pin too: a real `python bench.py`

@@ -32,7 +32,7 @@ def test_system_step_traces_once_across_same_shape_calls():
 
     system, state = _make_system(n_fibers=2, n_nodes=16, dtype=jnp.float32)
     step = trace_counting_jit(system._solve_impl,
-                              static_argnames=("ewald_plan",))
+                              static_argnames=("pair",))
     new_state, _, info = step(state)
     assert bool(info.converged)
     assert step.trace_count == 1

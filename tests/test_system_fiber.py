@@ -82,6 +82,9 @@ def test_step_reports_convergence():
     assert float(info.fiber_error) < 1e-6
 
 
+@pytest.mark.slow  # the profiler capture adds ~20 s of pure tracing overhead
+# to an otherwise-covered run loop (fast-tier budget: the 'not slow' tier
+# sits against the 870s timeout)
 def test_run_with_profiler_trace(tmp_path):
     """profile_dir captures an XLA profiler trace of the run loop
     (SURVEY.md §5.1 structured-profiling upgrade)."""

@@ -15,7 +15,10 @@ import sys
 
 import pytest
 
-_PROBE_TIMEOUT_S = 60
+# a healthy axon tunnel answers the tiny-matmul probe in seconds (client
+# init blocking >60 s means wedged); a wedged one previously cost the
+# 'not slow' tier a flat 60 s of waiting before the skips
+_PROBE_TIMEOUT_S = int(os.environ.get("SKELLY_TPU_PROBE_TIMEOUT_S", "30"))
 _probe_result = None
 
 

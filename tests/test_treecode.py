@@ -1,0 +1,395 @@
+"""Barycentric Lagrange treecode vs the dense kernel oracle.
+
+The treecode is the hierarchical answer to the reference's FMM slot
+(`include/kernels.hpp:56-134`; `ops.ewald` is the grid-based one): every
+stage here is pinned against the dense `kernels.stokeslet_direct` /
+`stresslet_direct` / `oseen_contract` sums, the plan rules against their
+docstring contracts, and the full implicit solve against the direct
+evaluator's converged solution.
+
+Accuracy gates use the plan's FIELD-NORMALIZED error measure
+(max_i |du_i| / max_i |u_i| — see `TreePlan.tol`): per-point relative error
+is unbounded at near-zero-velocity targets for any summation scheme.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from skellysim_tpu.ops import kernels
+from skellysim_tpu.ops import treecode as tc
+from skellysim_tpu.ops.evaluator import EVALUATORS, PairEvaluator, make_pair
+
+
+def _uniform_cloud(n, seed=3, box=1.5):
+    rng = np.random.default_rng(seed)
+    pts = rng.uniform(-box, box, (n, 3))
+    f = rng.standard_normal((n, 3))
+    return pts, f
+
+
+def _fiber_cloud(n_fib, n_nodes, seed=7, box=2.0):
+    """Line-clustered cloud (the fiber geometry the evaluator exists for)."""
+    rng = np.random.default_rng(seed)
+    origins = rng.uniform(-box, box, (n_fib, 3))
+    dirs = rng.normal(size=(n_fib, 3))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    t = np.linspace(0, 1.0, n_nodes)
+    pts = (origins[:, None, :] + t[None, :, None] * dirs[:, None, :])
+    return pts.reshape(-1, 3), rng.standard_normal((n_fib * n_nodes, 3))
+
+
+def _field_rel(u, u_ref):
+    d = np.linalg.norm(np.asarray(u) - np.asarray(u_ref), axis=1)
+    return d.max() / np.linalg.norm(np.asarray(u_ref), axis=1).max()
+
+
+# ------------------------------------------------------------------ plan rules
+
+def test_plan_degenerates_to_dense_below_two_levels():
+    """Small clouds (no well-separated cells above the 2-level minimum) get
+    the depth-0 dense-fallback plan."""
+    pts, _ = _uniform_cloud(200)
+    plan = tc.plan_tree(pts, tol=1e-4)
+    assert plan.depth == 0
+
+
+def test_plan_depth_and_capacity_rules():
+    """depth = ceil(log8(N_q / target_occ)) on the pow2-laddered count;
+    leaf capacity sits on the 8-aligned x1.5 rung ladder above measured
+    occupancy."""
+    pts, _ = _uniform_cloud(3000)
+    plan = tc.plan_tree(pts, tol=1e-4)       # N_q = 4096, occ 32 -> depth 3
+    assert plan.depth == 3
+    assert plan.max_occ % 8 == 0
+    deep = tc.plan_tree(pts, tol=1e-4, target_occ=4.0, max_depth=4)
+    assert deep.depth == 4                   # clamped by max_depth
+
+
+def test_plan_order_rule_from_tol():
+    """order from the measured ~5x-per-order contraction rule, clamped."""
+    assert tc.order_for_tol(1e-2) < tc.order_for_tol(1e-4) \
+        < tc.order_for_tol(1e-6)
+    assert tc.order_for_tol(1e-4) == 6
+    assert tc.order_for_tol(1e-12, max_order=12) == 12
+    pts, _ = _uniform_cloud(3000)
+    assert tc.plan_tree(pts, tol=1e-4).order == tc.order_for_tol(1e-4)
+
+
+def test_plan_stable_under_drift():
+    """The anchor-stripped plan (the jit key) is invariant under a small
+    translation of the cloud; the anchor hops only on the leaf lattice."""
+    pts, _ = _uniform_cloud(3000)
+    plan1 = tc.plan_tree(pts, tol=1e-4)
+    cell = plan1.leaf_size
+    plan2 = tc.plan_tree(pts + 0.01 * cell, tol=1e-4)
+    assert tc.strip_anchors(plan1) == tc.strip_anchors(plan2)
+    # the anchor itself is leaf-lattice quantized
+    for a in plan1.box_lo:
+        assert abs(a / cell - round(a / cell)) < 1e-9
+
+
+# ------------------------------------------------------------------- oracles
+
+def test_degenerate_one_leaf_bitwise_equals_dense():
+    """depth == 0 dispatches to the dense kernels themselves: bitwise."""
+    pts, f = _uniform_cloud(150, seed=11)
+    plan = tc.plan_tree(pts, tol=1e-4)
+    assert plan.depth == 0
+    P, F = jnp.asarray(pts), jnp.asarray(f)
+    assert np.array_equal(np.asarray(tc.stokeslet_tree(plan, P, P, F, 1.3)),
+                          np.asarray(kernels.stokeslet_direct(P, P, F, 1.3)))
+    S = jnp.asarray(np.random.default_rng(2).standard_normal((150, 3, 3)))
+    assert np.array_equal(np.asarray(tc.stresslet_tree(plan, P, P, S, 1.3)),
+                          np.asarray(kernels.stresslet_direct(P, P, S, 1.3)))
+    assert np.array_equal(np.asarray(tc.oseen_tree(plan, P, P, F, 1.3)),
+                          np.asarray(kernels.oseen_contract(P, P, F, 1.3)))
+
+
+def test_treecode_matches_dense_uniform_cloud():
+    """Uniform random cloud at the loose setting (depth 2, order 5):
+    Stokeslet + regularized Oseen within the plan's target accuracy."""
+    pts, f = _uniform_cloud(1500, seed=1)
+    plan = tc.plan_tree(pts, tol=1e-3)
+    assert plan.depth >= 2
+    P, F = jnp.asarray(pts), jnp.asarray(f)
+    err_s = _field_rel(tc.stokeslet_tree(plan, P, P, F, 1.3),
+                       kernels.stokeslet_direct(P, P, F, 1.3))
+    assert err_s < plan.tol, err_s
+    err_o = _field_rel(tc.oseen_tree(plan, P, P, F, 1.3),
+                       kernels.oseen_contract(P, P, F, 1.3))
+    assert err_o < plan.tol, err_o
+
+
+@pytest.mark.slow  # tight-setting oracle (order-8 proxies + 1.5k dense tile);
+# the fast tier keeps the loose-setting uniform/disjoint oracles
+def test_treecode_matches_dense_fiber_clusters():
+    """Line-clustered cloud at the tight setting (depth 2, order 8):
+    Stokeslet + stresslet within the plan's target accuracy."""
+    pts, f = _fiber_cloud(60, 25, seed=5)
+    plan = tc.plan_tree(pts, tol=1e-5)
+    assert plan.depth >= 2 and plan.order > tc.order_for_tol(1e-3)
+    P, F = jnp.asarray(pts), jnp.asarray(f)
+    err_s = _field_rel(tc.stokeslet_tree(plan, P, P, F, 1.0),
+                       kernels.stokeslet_direct(P, P, F, 1.0))
+    assert err_s < plan.tol, err_s
+    S = jnp.asarray(
+        np.random.default_rng(8).standard_normal((pts.shape[0], 3, 3)))
+    err_t = _field_rel(tc.stresslet_tree(plan, P, P, S, 1.0),
+                       kernels.stresslet_direct(P, P, S, 1.0))
+    assert err_t < plan.tol, err_t
+
+
+def test_treecode_disjoint_targets():
+    """Targets off the source cloud (velocity-field probes): no self-pair
+    anywhere, same accuracy gate."""
+    pts, f = _uniform_cloud(1500, seed=13)
+    rng = np.random.default_rng(17)
+    trg = rng.uniform(-1.4, 1.4, (300, 3))
+    plan = tc.plan_tree(np.vstack([pts, trg]), tol=1e-3)
+    assert plan.depth >= 2
+    P, T, F = jnp.asarray(pts), jnp.asarray(trg), jnp.asarray(f)
+    err = _field_rel(tc.stokeslet_tree(plan, P, T, F, 1.0),
+                     kernels.stokeslet_direct(P, T, F, 1.0))
+    assert err < plan.tol, err
+
+
+@pytest.mark.slow  # deep-octree case: depth-3 tree + 4k dense oracle
+def test_treecode_deep_octree_matches_dense():
+    """Depth-3 tree (child->parent transfer path across two levels) on a
+    4k clustered cloud — the second (depth, order) setting of the oracle
+    suite."""
+    pts, f = _fiber_cloud(160, 25, seed=19)
+    plan = tc.plan_tree(pts, tol=1e-4, target_occ=8.0)
+    assert plan.depth == 3
+    P, F = jnp.asarray(pts), jnp.asarray(f)
+    err = _field_rel(tc.stokeslet_tree(plan, P, P, F, 1.0),
+                     kernels.stokeslet_direct(P, P, F, 1.0))
+    assert err < plan.tol, err
+
+
+@pytest.mark.slow  # 16k-node case (~GB-scale dense oracle tile)
+def test_treecode_16k_nodes_matches_dense():
+    pts, f = _uniform_cloud(16384, seed=23)
+    plan = tc.plan_tree(pts, tol=1e-4)
+    assert plan.depth >= 3
+    P, F = jnp.asarray(pts), jnp.asarray(f)
+    err = _field_rel(tc.stokeslet_tree(plan, P, P, F, 1.0),
+                     kernels.stokeslet_direct(P, P, F, 1.0))
+    assert err < plan.tol, err
+
+
+def test_anchor_hop_reuses_compiled_program():
+    """A pure translation of the cloud (leaf-lattice anchor hop) must not
+    retrace the jitted evaluator: the anchors are traced operands."""
+    pts, f = _uniform_cloud(1500, seed=29)
+    plan1 = tc.plan_tree(pts, tol=1e-3)
+    P, F = jnp.asarray(pts), jnp.asarray(f)
+    u1 = tc.stokeslet_tree(plan1, P, P, F, 1.0)
+    n_compiled = tc._stokeslet_tree_impl._cache_size()
+    shift = 5.0 * plan1.leaf_size
+    pts2 = pts + np.array([shift, 0.0, 0.0])
+    plan2 = tc.plan_tree(pts2, tol=1e-3)
+    assert tc.strip_anchors(plan2) == tc.strip_anchors(plan1)
+    u2 = tc.stokeslet_tree(plan2, jnp.asarray(pts2), jnp.asarray(pts2), F,
+                           1.0)
+    assert tc._stokeslet_tree_impl._cache_size() == n_compiled, \
+        "anchor hop forced a recompile"
+    # translation invariance of the physics
+    np.testing.assert_allclose(np.asarray(u2), np.asarray(u1),
+                               rtol=0, atol=1e-8)
+
+
+# ------------------------------------------------------- PairEvaluator spec
+
+def test_pair_evaluator_spec_validation():
+    assert "tree" in EVALUATORS
+    with pytest.raises(ValueError, match="unknown pair evaluator"):
+        PairEvaluator(evaluator="fmm")
+    spec = PairEvaluator(evaluator="tree", impl="exact")
+    assert not spec.is_fast          # no plan attached = dense tiles
+
+
+def test_make_pair_strips_anchors_and_materializes_them():
+    pts, _ = _uniform_cloud(1500, seed=31)
+    plan = tc.plan_tree(pts, tol=1e-3)
+    spec, anchors = make_pair("tree", "exact", plan)
+    assert spec.is_fast
+    assert spec.plan.box_lo is None                  # stripped = jit key
+    np.testing.assert_allclose(np.asarray(anchors)[0], plan.box_lo)
+    # a stripped plan's anchors can never be silently re-fabricated (they
+    # would be garbage): they must ride next to the spec as the traced
+    # operand make_pair returned
+    with pytest.raises(ValueError, match="anchor-stripped"):
+        tc.plan_anchors(spec.plan)
+    spec_d, anchors_d = make_pair("direct", "exact")
+    assert spec_d.plan is None and anchors_d is None
+
+
+def test_system_rejects_unknown_evaluator():
+    from skellysim_tpu.params import Params
+    from skellysim_tpu.system import System
+
+    with pytest.raises(ValueError, match="tree"):
+        System(Params(pair_evaluator="fmm"))
+
+
+def test_config_schema_maps_tree_evaluator():
+    from skellysim_tpu.config import schema
+
+    p = schema.Params(pair_evaluator="tree", tree_tol=3e-4)
+    rp = schema.to_runtime_params(p)
+    assert rp.pair_evaluator == "tree"
+    assert rp.tree_tol == 3e-4
+
+
+# ------------------------------------------------------------- system solves
+
+def _free_fiber_state(system, n_fib, n_nodes, seed=23):
+    from skellysim_tpu.fibers import container as fc
+    from skellysim_tpu.system import BackgroundFlow
+
+    rng = np.random.default_rng(seed)
+    origins = rng.uniform(-2, 2, (n_fib, 3))
+    dirs = rng.normal(size=(n_fib, 3))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    t = np.linspace(0, 1.0, n_nodes)
+    x = origins[:, None, :] + t[None, :, None] * dirs[:, None, :]
+    fibers = fc.make_group(x, lengths=1.0, bending_rigidity=0.01,
+                           radius=0.0125)
+    return system.make_state(
+        fibers=fibers, background=BackgroundFlow.make(uniform=(1.0, 0.0, 0.0)))
+
+
+@pytest.mark.slow  # two full System builds + 4 jit compiles (fast-tier budget:
+# the not-slow tier sits against the 870s timeout)
+def test_system_solve_with_tree_evaluator():
+    """Acceptance: pair_evaluator="tree" converges the full implicit step to
+    the same GMRES tolerance as the dense path (residual parity,
+    tolerance-gated not bitwise), through a REAL depth>=2 tree, and the
+    velocity field at off-node targets matches to the evaluator accuracy."""
+    from skellysim_tpu.params import Params
+    from skellysim_tpu.system import System
+
+    base = Params(eta=1.0, dt_initial=1e-3, t_final=1e-2, gmres_tol=1e-10,
+                  adaptive_timestep_flag=False, tree_tol=1e-6)
+    probes = jnp.asarray(np.random.default_rng(41).uniform(-2, 2, (32, 3)))
+    out = {}
+    for ev in ("direct", "tree"):
+        system = System(dataclasses.replace(base, pair_evaluator=ev))
+        state = _free_fiber_state(system, n_fib=48, n_nodes=24)
+        if ev == "tree":
+            assert system.make_tree_plan(state).depth >= 2
+        new_state, solution, info = system.step(state)
+        assert bool(info.converged), ev
+        assert float(info.residual) < base.gmres_tol, ev
+        out[ev] = (np.asarray(solution),
+                   np.asarray(system.velocity_at_targets(new_state, solution,
+                                                         probes)))
+    err_sol = (np.linalg.norm(out["tree"][0] - out["direct"][0])
+               / np.linalg.norm(out["direct"][0]))
+    assert err_sol < 1e-5, err_sol
+    err_vel = _field_rel(out["tree"][1], out["direct"][1])
+    assert err_vel < 1e-4, err_vel
+
+
+@pytest.mark.slow  # heavy in-process integration (fast-tier budget)
+def test_system_tree_with_inactive_padding_fibers():
+    """grow_capacity padding (inactive slots replicating slot 0) must not
+    blow up leaf occupancy or change results: padded sources are spread
+    over the box with zero strength (`fc._spread_inactive`), with capacity
+    reserved by `plan_tree(n_fill=...)`."""
+    from skellysim_tpu.fibers import container as fc
+    from skellysim_tpu.params import Params
+    from skellysim_tpu.system import BackgroundFlow, System
+
+    rng = np.random.default_rng(29)
+    n_fib, n_nodes = 24, 24
+    origins = rng.uniform(-2, 2, (n_fib, 3))
+    dirs = rng.normal(size=(n_fib, 3))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    t = np.linspace(0, 1.0, n_nodes)
+    x = origins[:, None, :] + t[None, :, None] * dirs[:, None, :]
+
+    params = Params(eta=1.0, dt_initial=1e-3, t_final=1e-2, gmres_tol=1e-8,
+                    pair_evaluator="tree", tree_tol=1e-6,
+                    adaptive_timestep_flag=False)
+    system = System(params)
+    fibers = fc.make_group(x, lengths=1.0, bending_rigidity=0.01,
+                           radius=0.0125)
+    bg = BackgroundFlow.make(uniform=(1.0, 0.0, 0.0))
+    state = system.make_state(fibers=fibers, background=bg)
+    _, sol_ref, info_ref = system.step(state)
+    assert bool(info_ref.converged)
+
+    grown = fc.grow_capacity(fibers, 2 * n_fib)   # half inactive padding
+    state_g = system.make_state(fibers=grown, background=bg)
+    # plan reserves spread fill capacity, not one hot leaf
+    plan = system.make_tree_plan(state_g)
+    plan_ref = system.make_tree_plan(state)
+    if plan_ref.depth > 0 and plan.depth > 0:
+        assert plan.max_occ <= 4 * plan_ref.max_occ
+    _, sol_g, info_g = system.step(state_g)
+    assert bool(info_g.converged)
+    n_active = n_fib * 4 * n_nodes
+    err = (np.linalg.norm(np.asarray(sol_g)[:n_active] - np.asarray(sol_ref))
+           / np.linalg.norm(np.asarray(sol_ref)))
+    assert err < 1e-6, err
+
+
+@pytest.mark.slow  # multi-device compile (fast-tier budget)
+def test_spmd_step_composes_with_tree_evaluator():
+    """pair_evaluator="tree" + step_spmd: the Krylov fiber flows route
+    through the treecode on every shard (one tiled source all-gather,
+    `flow_multi_local`'s tree branch) and the sharded step matches the
+    single-chip tree step."""
+    from skellysim_tpu.params import Params
+    from skellysim_tpu.parallel import make_mesh, shard_state
+    from skellysim_tpu.system import System
+
+    params = Params(eta=1.0, dt_initial=1e-3, t_final=1e-2, gmres_tol=1e-10,
+                    adaptive_timestep_flag=False, pair_evaluator="tree",
+                    tree_tol=1e-6)
+    system = System(params)
+    state = _free_fiber_state(system, n_fib=48, n_nodes=24)
+    assert system.make_tree_plan(state).depth >= 2
+    _, sol1, info1 = system.step(state)
+
+    mesh = make_mesh(2)
+    st_sh = shard_state(state, mesh)
+    _, sol2, info2 = system.step_spmd(st_sh, mesh, donate=False)
+    assert bool(info1.converged) and bool(info2.converged)
+    assert float(info2.residual) < params.gmres_tol
+    err = (np.linalg.norm(np.asarray(sol2) - np.asarray(sol1))
+           / np.linalg.norm(np.asarray(sol1)))
+    assert err < 1e-9, err
+
+
+def test_build_spmd_step_rejects_tree_pair_with_inactive_fibers():
+    """Direct `build_spmd_step(pair=...)` callers with inactive-padded
+    fibers must get a build-time error, not silent point eviction: the
+    SPMD layout has no global inactive-slot spread, so padding nodes
+    (replicating slot 0) would overflow the plan's static leaf buckets.
+    The guard raises before any tracing; `System.step_spmd` instead falls
+    back to the ring flows for such states (its all-active gate)."""
+    from skellysim_tpu.fibers import container as fc
+    from skellysim_tpu.params import Params
+    from skellysim_tpu.parallel import make_mesh, shard_state
+    from skellysim_tpu.parallel.spmd import build_spmd_step
+    from skellysim_tpu.system import System
+
+    params = Params(eta=1.0, dt_initial=1e-3, gmres_tol=1e-8,
+                    adaptive_timestep_flag=False, pair_evaluator="tree",
+                    tree_tol=1e-4)
+    system = System(params)
+    state = _free_fiber_state(system, n_fib=16, n_nodes=16)
+    grown = fc.grow_capacity(state.fibers, 32)  # half the slots inactive
+    state = state._replace(fibers=grown)
+    pair, _ = system._pair_args(state)
+    assert pair is not None and pair.is_fast
+    mesh = make_mesh(2)
+    with pytest.raises(ValueError, match="every fiber slot active"):
+        build_spmd_step(system, mesh, shard_state(state, mesh), pair=pair)
