@@ -65,6 +65,58 @@ python -m skellysim_tpu.obs summarize "$OBS_TMP"/metrics.jsonl "$OBS_TMP"/trace.
   || { echo "obs summarize smoke failed" >&2; rm -rf "$OBS_TMP"; exit 1; }
 rm -rf "$OBS_TMP"
 
+echo "== serve: skelly-serve smoke (2 tenants over TCP, docs/serving.md) =="
+# the acceptance path end to end, in EVERY tier: boot the multi-tenant
+# service as a real subprocess, admit two tenants over the wire, stream
+# their trajectory frames, and gate the serving SLO — zero compile events
+# after warmup (a warm-path retrace here is the serving-latency defect
+# class the whole subsystem exists to prevent). ~45 s, dominated by the
+# server's one warmup compile.
+SERVE_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$SERVE_TMP" <<'EOF'
+import os, sys
+import numpy as np
+from skellysim_tpu.config import BackgroundSource, Config, Fiber, schema
+from skellysim_tpu.config.toml_io import dumps
+from skellysim_tpu.serve.client import SpawnedServer
+
+def scene(shift):
+    cfg = Config()
+    cfg.params.dt_initial = cfg.params.dt_write = 0.005
+    cfg.params.t_final = 0.02
+    cfg.params.gmres_tol = 1e-10
+    cfg.params.adaptive_timestep_flag = False
+    fib = Fiber(n_nodes=8, length=1.0, bending_rigidity=0.01)
+    fib.fill_node_positions(np.array([shift, 0.0, 0.0]),
+                            np.array([0.0, 0.0, 1.0]))
+    cfg.fibers = [fib]
+    cfg.background = BackgroundSource(uniform=[1.0, 0.0, 0.0])
+    return cfg
+
+path = os.path.join(sys.argv[1], "serve_config.toml")
+scene(0.0).save(path)
+with open(path, "a") as fh:
+    fh.write('\n[serve]\nmax_lanes = 2\nbatch_impl = "unroll"\n')
+
+with SpawnedServer(path) as srv:
+    with srv.client() as c:
+        tids = [c.submit(dumps(schema.unpack(scene(s))))["tenant"]
+                for s in (0.1, 0.3)]
+        for tid in tids:
+            st = c.wait(tid, timeout=180)
+            assert st["status"] == "finished", st
+            frames = c.stream(tid)["frames"]
+            assert len(frames) >= 2, (tid, len(frames))
+        stats = c.stats()
+        assert stats["compiles_after_warm"] == 0, stats
+    rc = srv.stop()
+assert rc == 0, f"serve server exited rc={rc}"
+print(f"serve smoke ok: 2 tenants finished, "
+      f"{stats['frames_streamed_total']} frames streamed, "
+      f"0 compiles after warm")
+EOF
+rm -rf "$SERVE_TMP"
+
 echo "== docs: config reference in sync with the schema =="
 JAX_PLATFORMS=cpu python scripts/gen_config_reference.py --check
 

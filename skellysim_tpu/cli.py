@@ -99,6 +99,10 @@ def main(argv=None) -> None:
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="wrap the run in jax.profiler.trace(DIR) — "
                          "perfetto/TensorBoard dumps of the whole loop")
+    ap.add_argument("--jax-cache", default=None, metavar="DIR",
+                    help="persistent XLA compilation cache directory shared "
+                         "across runs/CLIs: re-runs skip prior compiles "
+                         "(bench.py's .jax_cache pattern)")
     ap.add_argument("--log-level", default=os.environ.get("SKELLYSIM_LOG", "INFO"),
                     help="log level for the skellysim_tpu logger "
                          "(the reference reads SPDLOG_LEVEL similarly)")
@@ -121,6 +125,10 @@ def main(argv=None) -> None:
     import jax
 
     jax.config.update("jax_enable_x64", True)
+
+    from .utils.bootstrap import enable_compilation_cache
+
+    enable_compilation_cache(args.jax_cache)
 
     # multi-host bring-up (no-op single-process; the analogue of the
     # reference's MPI_Init, `skelly_sim.cpp:14`) — must run before any JAX

@@ -481,6 +481,77 @@ class EnsembleSweep:
     sweep: List[SweepAxis] = field(default_factory=list)
 
 
+def normalized_member_params(params: "Params") -> "Params":
+    """Params with the per-member knobs zeroed — two configs whose
+    normalized params are equal can share ONE compiled program.
+
+    seed and t_final are the only params handled outside the trace (the
+    member RNG stream and the masked stepper's per-lane horizon). The ONE
+    definition of that contract: the ensemble sweep CLI's
+    members-share-a-program check and skelly-serve's admission gate both
+    call this — a new per-member knob lands in both by editing here.
+    """
+    return dataclasses.replace(params, seed=0, t_final=0.0)
+
+
+@dataclass
+class ServeConfig:
+    """`[serve]` table of a server config TOML (`python -m
+    skellysim_tpu.serve`; see docs/serving.md).
+
+    Lives in the SERVER's run config file alongside the usual tables: the
+    config's fibers/params define the warm compiled program every tenant
+    must match, and `[serve]` sizes the service around it. Each capacity
+    bucket is one compiled ensemble program whose lanes hold tenants with
+    fiber counts up to that capacity (smaller scenes are padded with inert
+    masked fibers — the ensemble masked-lane trick applied to admission).
+    """
+    #: bind address for the TCP service
+    host: str = "127.0.0.1"
+    #: listen port; 0 = ephemeral (pair with the CLI's --port-file)
+    port: int = 0
+    #: padded fiber capacities, one warm compiled program (bucket) each;
+    #: empty = one bucket at the base config's own fiber count
+    bucket_capacities: List[int] = field(default_factory=list)
+    #: concurrent tenant slots (compiled ensemble lanes) per bucket
+    max_lanes: int = 4
+    #: admission-queue bound per bucket; a submit beyond it is REJECTED
+    #: (admission control: shed load instead of growing an unbounded queue)
+    queue_depth: int = 16
+    #: batched execution plan for the lanes: "vmap" (throughput) or
+    #: "unroll" (bit-reproducible lanes; see docs/ensemble.md)
+    batch_impl: str = "vmap"
+    #: per-send socket timeout: a client that stops reading its responses
+    #: is dropped (and its tenants evicted) instead of freezing the
+    #: single-threaded event loop on a full TCP window
+    send_timeout_s: float = 30.0
+
+
+def load_serve_config(path: str) -> ServeConfig:
+    """`[serve]` table of a config TOML -> ServeConfig (defaults when the
+    table is absent; unknown keys rejected — a typo'd knob silently running
+    defaults would mis-size a production service)."""
+    table = toml_io.load(path).get("serve", {})
+    known = {f.name for f in dataclasses.fields(ServeConfig)}
+    unknown = set(table) - known
+    if unknown:
+        raise ValueError(f"{path}: unknown [serve] keys {sorted(unknown)}; "
+                         f"valid keys: {sorted(known)}")
+    cfg = ServeConfig(**table)
+    if cfg.max_lanes < 1:
+        raise ValueError(f"{path}: [serve] max_lanes must be >= 1")
+    if cfg.queue_depth < 0:
+        raise ValueError(f"{path}: [serve] queue_depth must be >= 0")
+    if cfg.batch_impl not in ("vmap", "unroll"):
+        raise ValueError(f"{path}: unknown [serve] batch_impl "
+                         f"{cfg.batch_impl!r}; use 'vmap' or 'unroll'")
+    if any(c < 1 for c in cfg.bucket_capacities):
+        raise ValueError(f"{path}: [serve] bucket_capacities must be >= 1")
+    if cfg.send_timeout_s <= 0:
+        raise ValueError(f"{path}: [serve] send_timeout_s must be > 0")
+    return cfg
+
+
 @dataclass
 class Config:
     """Free-space config (no bounding volume)."""
@@ -591,7 +662,13 @@ def _from_dict(cls, data: dict):
 
 def load_config(path: str):
     """TOML file → Config (shaped subclass chosen by periphery.shape)."""
-    data = toml_io.load(path)
+    return config_from_data(toml_io.load(path))
+
+
+def config_from_data(data: dict):
+    """Parsed TOML dict → Config — the path-free half of `load_config`,
+    shared with skelly-serve's submit path (tenant configs arrive as TOML
+    TEXT over the wire, never touching the server's filesystem)."""
     peri = data.get("periphery")
     if peri is None:
         cfg = Config()

@@ -16,7 +16,6 @@ continuous-batching scheduler. Outputs land in the output directory:
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import os
 import sys
 
@@ -48,9 +47,9 @@ def _members_from_sweep(sweep_file: str):
     if not plans:
         sys.exit(f"sweep spec '{sweep_file}' expands to zero members")
 
-    def norm(params: schema.Params):
-        # members may differ only in the knobs handled outside the trace
-        return dataclasses.replace(params, seed=0, t_final=0.0)
+    # members may differ only in the knobs handled outside the trace — the
+    # one-compiled-program contract shared with skelly-serve admission
+    norm = schema.normalized_member_params
 
     system = None
     members = []
@@ -142,6 +141,10 @@ def main(argv=None) -> None:
                     help="skelly-scope telemetry JSONL (lane events + "
                          "batched-step spans; `python -m skellysim_tpu.obs "
                          "summarize` reports lane occupancy from it)")
+    ap.add_argument("--jax-cache", default=None, metavar="DIR",
+                    help="persistent XLA compilation cache directory shared "
+                         "across runs/CLIs: re-runs skip prior compiles "
+                         "(bench.py's .jax_cache pattern)")
     ap.add_argument("--log-level",
                     default=os.environ.get("SKELLYSIM_LOG", "INFO"))
     args = ap.parse_args(argv)
@@ -158,6 +161,10 @@ def main(argv=None) -> None:
     import jax
 
     jax.config.update("jax_enable_x64", True)
+
+    from ..utils.bootstrap import enable_compilation_cache
+
+    enable_compilation_cache(args.jax_cache)
 
     run(args.sweep_file, output_dir=args.output_dir, batch=args.batch,
         batch_impl=args.batch_impl, overwrite=args.overwrite,

@@ -47,6 +47,10 @@ class MemberSpec:
     state: SimState
     t_final: float
     rng: Optional[SimRNG] = None
+    #: perf_counter timestamp of queue entry (stamped by the scheduler when
+    #: absent); lane events report ``queue_wait_s`` — admission latency,
+    #: the serving SLO — from it
+    enqueued_at: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -76,6 +80,17 @@ class EnsembleScheduler:
     "retire" retires just the failing member (recorded in metrics) and keeps
     the rest of the sweep running — the serving-shaped choice for large
     sweeps.
+
+    ``template`` allows an INITIALLY-EMPTY scheduler (``members=[]``): a
+    long-lived service (skelly-serve) constructs the compiled lanes before
+    any tenant exists, then feeds them incrementally via `admit` + `poll`.
+    The template state defines the lanes' static shapes — the capacity
+    bucket every later member must match.
+
+    ``on_retire(member_id, state, reason)`` receives the member's FINAL lane
+    state the moment before its lane is freed — the exact snapshot point
+    (possibly newer than its last dt_write frame); skelly-serve stores it
+    for tenant snapshot/resume.
     """
 
     def __init__(self, runner: EnsembleRunner, members, batch: int, *,
@@ -84,7 +99,9 @@ class EnsembleScheduler:
                  step_fn: Optional[Callable] = None,
                  write_initial_frames: bool = False,
                  on_dt_underflow: str = "raise",
-                 max_rounds: Optional[int] = None):
+                 max_rounds: Optional[int] = None,
+                 template: Optional[SimState] = None,
+                 on_retire: Optional[Callable] = None):
         if batch < 1:
             raise ValueError(f"batch must be >= 1, got {batch}")
         if on_dt_underflow not in ("raise", "retire"):
@@ -92,29 +109,30 @@ class EnsembleScheduler:
                 f"unknown on_dt_underflow {on_dt_underflow!r}; "
                 "use 'raise' or 'retire'")
         members = list(members)
-        if not members:
-            raise ValueError("ensemble needs at least one member")
+        if not members and template is None:
+            raise ValueError("ensemble needs at least one member (or a "
+                             "template= state for an initially-empty service)")
         self.runner = runner
         self.batch = batch
-        self.queue = deque(members)
+        self.queue = deque()
         self.writer = writer
         self.metrics = metrics
         self.step_fn = step_fn or runner.step
         self.write_initial_frames = write_initial_frames
         self.on_dt_underflow = on_dt_underflow
+        self.on_retire = on_retire
         self.max_rounds = max_rounds
         self.rounds = 0
         self.retired: list = []
         #: template member state for idle-lane padding (inert masked lanes)
-        self._template = members[0].state
+        self._template = template if template is not None else members[0].state
         self.lanes: list = [None] * batch
         # seed the lanes: every lane starts on the template (idle), then the
         # queue fills as many as it can
         self.ens = runner.make_ensemble([self._template] * batch,
                                         [IDLE_T_FINAL] * batch)
-        for lane in range(batch):
-            if self.queue:
-                self._start_member(lane, self.queue.popleft())
+        for spec in members:
+            self.admit(spec)
 
     # ----------------------------------------------------------- lane churn
 
@@ -131,22 +149,38 @@ class EnsembleScheduler:
             t_final=self.ens.t_final.at[lane].set(spec.t_final))
         self.lanes[lane] = _Lane(spec=spec, t=float(spec.state.time),
                                  dt=float(spec.state.dt))
+        # admission latency (queue entry -> lane seat): the serving SLO
+        # skelly-serve's /stats reports; `obs summarize` folds it into the
+        # lane-occupancy table
+        wait_s = (max(0.0, _time.perf_counter() - spec.enqueued_at)
+                  if spec.enqueued_at is not None else 0.0)
         # skelly-scope lane churn: "admit" seats a member before the first
         # batched step, "backfill" refills a lane freed mid-drain (the
         # continuous-batching move; obs summarize reports occupancy)
         obs_tracer.emit("lane",
                         action="admit" if self.rounds == 0 else "backfill",
-                        lane=lane, member=spec.member_id)
+                        lane=lane, member=spec.member_id,
+                        queue_wait_s=round(wait_s, 6))
         self._emit({"event": "start", "member": spec.member_id, "lane": lane,
-                    "t": float(spec.state.time), "t_final": spec.t_final})
+                    "t": float(spec.state.time), "t_final": spec.t_final,
+                    "queue_wait_s": round(wait_s, 6)})
         if self.write_initial_frames and self.writer is not None:
             self.writer(spec.member_id, spec.state,
                         rng_state=self._rng_state(spec))
         logger.info("ensemble start member=%s lane=%d t_final=%g",
                     spec.member_id, lane, spec.t_final)
 
-    def _retire_member(self, lane: int, reason: str = "finished"):
+    def _retire_member(self, lane: int, reason: str = "finished",
+                       final_state=None):
         ln = self.lanes[lane]
+        if self.on_retire is not None:
+            # the member's exact final state, before the lane is reused —
+            # the snapshot skelly-serve resumes evicted tenants from
+            # (``final_state`` lets `evict` reuse its own fetch instead of
+            # gathering the lane twice)
+            if final_state is None:
+                final_state = lane_state(self.ens.states, lane)
+            self.on_retire(ln.spec.member_id, final_state, reason)
         obs_tracer.emit("lane", action="retire", lane=lane,
                         member=ln.spec.member_id, reason=reason,
                         steps=ln.steps)
@@ -165,88 +199,160 @@ class EnsembleScheduler:
         if self.queue:
             self._start_member(lane, self.queue.popleft())
 
+    # -------------------------------------------------- incremental service
+
+    def admit(self, spec: MemberSpec):
+        """Enqueue one member; seat it immediately when a lane is free.
+
+        The incremental half of the continuous-batching API (skelly-serve's
+        admission path): lanes keep their compiled program — seating is pure
+        leaf substitution (`runner.set_lane`), so tenants join a running
+        service without retracing. Returns the lane index when the member
+        seated now, None when it queued behind occupied lanes."""
+        if spec.enqueued_at is None:
+            spec.enqueued_at = _time.perf_counter()
+        self.queue.append(spec)
+        seated = None
+        for lane in range(self.batch):
+            if not self.queue:
+                break
+            if self.lanes[lane] is None:
+                nxt = self.queue.popleft()
+                self._start_member(lane, nxt)
+                if nxt is spec:
+                    seated = lane
+        return seated
+
+    def evict(self, lane: int, reason: str = "evicted") -> SimState:
+        """Free an occupied lane mid-service and return the member's CURRENT
+        state — the exact resume point, possibly newer than its last
+        dt_write frame. The lane backfills from the queue like any
+        retirement (skelly-serve's graceful-eviction path)."""
+        if not 0 <= lane < self.batch or self.lanes[lane] is None:
+            raise ValueError(f"evict: lane {lane} is not occupied")
+        state = lane_state(self.ens.states, lane)
+        self._retire_member(lane, reason=reason, final_state=state)
+        return state
+
+    def lane_of(self, member_id: str):
+        """Lane index currently running ``member_id``, or None."""
+        for lane, ln in enumerate(self.lanes):
+            if ln is not None and ln.spec.member_id == member_id:
+                return lane
+        return None
+
+    def unqueue(self, member_id: str) -> Optional[MemberSpec]:
+        """Drop a still-QUEUED member (never seated; no lane churn).
+        Returns the removed spec — its ``state`` is the member's resume
+        point (skelly-serve snapshots it) — or None when the id is not in
+        the queue."""
+        for spec in self.queue:
+            if spec.member_id == member_id:
+                self.queue.remove(spec)
+                return spec
+        return None
+
+    @property
+    def live(self) -> int:
+        """Occupied lane count."""
+        return sum(1 for ln in self.lanes if ln is not None)
+
     # ------------------------------------------------------------ the drain
 
     def run(self) -> list:
         """Drain queue + lanes to completion; returns retired member ids in
         retirement order."""
-        p = self.runner.system.params
         while any(ln is not None for ln in self.lanes):
             if self.max_rounds is not None and self.rounds >= self.max_rounds:
                 break
-            live = sum(1 for ln in self.lanes if ln is not None)
-            with obs_tracer.span("ensemble_step", round=self.rounds,
-                                 live=live, lanes=self.batch):
-                wall0 = _time.perf_counter()
-                self.ens, info = self.step_fn(self.ens)
-                # ONE device fetch for all [B] outcome vectors (it doubles
-                # as the span's device-work barrier)
-                fetched = {f: np.asarray(getattr(info, f))
-                           for f in ("running", "accepted", "iters",
-                                     "residual", "residual_true",
-                                     "fiber_error", "refines",
-                                     "loss_of_accuracy", "dt_underflow",
-                                     "dt_used", "t", "dt_next", "cycles")}
-                hist = (np.asarray(info.history)
-                        if info.history is not None else None)
-                wall_s = _time.perf_counter() - wall0
-            self.rounds += 1
-
-            for lane, ln in enumerate(self.lanes):
-                if ln is None:
-                    continue
-                if not bool(fetched["running"][lane]):
-                    # occupied but inert: the member was seated already at or
-                    # past its t_final (e.g. a degenerate swept t_final, or a
-                    # resumed state beyond it). Without this retire the lane
-                    # would spin the drain loop forever.
-                    self._retire_member(lane)
-                    continue
-                accepted = bool(fetched["accepted"][lane])
-                underflow = bool(fetched["dt_underflow"][lane])
-                dt_used = float(fetched["dt_used"][lane])
-                t_new = float(fetched["t"][lane])
-                if underflow:
-                    # the sequential loop raises before writing this trial's
-                    # metrics line — no step record here either
-                    if self.on_dt_underflow == "raise":
-                        raise RuntimeError(
-                            f"ensemble member {ln.spec.member_id}: timestep "
-                            f"smaller than dt_min ({p.dt_min}) at t={ln.t:.6g}"
-                        )
-                    self._retire_member(lane, reason="dt_underflow")
-                    continue
-                ln.steps += 1
-                self._emit({
-                    "event": "step", "member": ln.spec.member_id,
-                    "lane": lane, "round": self.rounds - 1,
-                    "step": ln.steps - 1, "t": ln.t,
-                    "dt": dt_used, "iters": int(fetched["iters"][lane]),
-                    "gmres_cycles": int(fetched["cycles"][lane]),
-                    "residual": float(fetched["residual"][lane]),
-                    "residual_true": float(fetched["residual_true"][lane]),
-                    "fiber_error": float(fetched["fiber_error"][lane]),
-                    "accepted": accepted,
-                    "refines": int(fetched["refines"][lane]),
-                    "loss_of_accuracy": bool(
-                        fetched["loss_of_accuracy"][lane]),
-                    "wall_s": round(wall_s, 4),
-                    "wall_ms": round(wall_s * 1e3, 3),
-                    "gmres_history": history_rows(
-                        hist[lane] if hist is not None else None,
-                        fetched["cycles"][lane])})
-                ln.t = t_new
-                ln.dt = float(fetched["dt_next"][lane])
-                if (accepted and self.writer is not None
-                        and crossed_write_boundary(t_new, dt_used,
-                                                   p.dt_write)):
-                    self.writer(ln.spec.member_id,
-                                lane_state(self.ens.states, lane),
-                                rng_state=self._rng_state(ln.spec))
-                    ln.frames += 1
-                if t_new >= ln.spec.t_final:
-                    self._retire_member(lane)
+            self.poll()
         return self.retired
+
+    def poll(self) -> list:
+        """ONE batched round over the current lanes: step, record outcomes,
+        write crossed frames, retire + backfill. A no-op on an idle (all
+        lanes empty) scheduler. Returns the member ids retired this round.
+
+        `run` is poll() in a loop; a long-lived service interleaves poll()
+        with `admit`/`evict` between rounds — one compiled program
+        throughout."""
+        if not any(ln is not None for ln in self.lanes):
+            return []
+        p = self.runner.system.params
+        retired_before = len(self.retired)
+        live = sum(1 for ln in self.lanes if ln is not None)
+        with obs_tracer.span("ensemble_step", round=self.rounds,
+                             live=live, lanes=self.batch):
+            wall0 = _time.perf_counter()
+            self.ens, info = self.step_fn(self.ens)
+            # ONE device fetch for all [B] outcome vectors (it doubles
+            # as the span's device-work barrier)
+            fetched = {f: np.asarray(getattr(info, f))
+                       for f in ("running", "accepted", "iters",
+                                 "residual", "residual_true",
+                                 "fiber_error", "refines",
+                                 "loss_of_accuracy", "dt_underflow",
+                                 "dt_used", "t", "dt_next", "cycles")}
+            hist = (np.asarray(info.history)
+                    if info.history is not None else None)
+            wall_s = _time.perf_counter() - wall0
+        self.rounds += 1
+
+        for lane, ln in enumerate(self.lanes):
+            if ln is None:
+                continue
+            if not bool(fetched["running"][lane]):
+                # occupied but inert: the member was seated already at or
+                # past its t_final (e.g. a degenerate swept t_final, or a
+                # resumed state beyond it). Without this retire the lane
+                # would spin the drain loop forever.
+                self._retire_member(lane)
+                continue
+            accepted = bool(fetched["accepted"][lane])
+            underflow = bool(fetched["dt_underflow"][lane])
+            dt_used = float(fetched["dt_used"][lane])
+            t_new = float(fetched["t"][lane])
+            if underflow:
+                # the sequential loop raises before writing this trial's
+                # metrics line — no step record here either
+                if self.on_dt_underflow == "raise":
+                    raise RuntimeError(
+                        f"ensemble member {ln.spec.member_id}: timestep "
+                        f"smaller than dt_min ({p.dt_min}) at t={ln.t:.6g}"
+                    )
+                self._retire_member(lane, reason="dt_underflow")
+                continue
+            ln.steps += 1
+            self._emit({
+                "event": "step", "member": ln.spec.member_id,
+                "lane": lane, "round": self.rounds - 1,
+                "step": ln.steps - 1, "t": ln.t,
+                "dt": dt_used, "iters": int(fetched["iters"][lane]),
+                "gmres_cycles": int(fetched["cycles"][lane]),
+                "residual": float(fetched["residual"][lane]),
+                "residual_true": float(fetched["residual_true"][lane]),
+                "fiber_error": float(fetched["fiber_error"][lane]),
+                "accepted": accepted,
+                "refines": int(fetched["refines"][lane]),
+                "loss_of_accuracy": bool(
+                    fetched["loss_of_accuracy"][lane]),
+                "wall_s": round(wall_s, 4),
+                "wall_ms": round(wall_s * 1e3, 3),
+                "gmres_history": history_rows(
+                    hist[lane] if hist is not None else None,
+                    fetched["cycles"][lane])})
+            ln.t = t_new
+            ln.dt = float(fetched["dt_next"][lane])
+            if (accepted and self.writer is not None
+                    and crossed_write_boundary(t_new, dt_used,
+                                               p.dt_write)):
+                self.writer(ln.spec.member_id,
+                            lane_state(self.ens.states, lane),
+                            rng_state=self._rng_state(ln.spec))
+                ln.frames += 1
+            if t_new >= ln.spec.t_final:
+                self._retire_member(lane)
+        return self.retired[retired_before:]
 
 
 def run_ensemble(system, members, batch: int = 8, *, batch_impl: str = "vmap",

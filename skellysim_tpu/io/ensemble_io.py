@@ -30,8 +30,11 @@ ENSEMBLE_STEP_FIELDS = ("event", "member", "lane", "round", "step", "t",
                         "refines", "loss_of_accuracy", "wall_s", "wall_ms",
                         "gmres_history")
 
-#: keys of an ``event == "start"`` record (member entered a lane)
-ENSEMBLE_START_FIELDS = ("event", "member", "lane", "t", "t_final")
+#: keys of an ``event == "start"`` record (member entered a lane);
+#: ``queue_wait_s`` is the admission latency (queue entry -> lane seat) —
+#: the serving SLO skelly-serve's /stats aggregates
+ENSEMBLE_START_FIELDS = ("event", "member", "lane", "t", "t_final",
+                         "queue_wait_s")
 
 #: keys of an ``event == "retire"`` / ``"dt_underflow"`` record (lane freed)
 ENSEMBLE_RETIRE_FIELDS = ("event", "member", "lane", "t", "steps", "frames")

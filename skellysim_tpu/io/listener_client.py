@@ -9,15 +9,13 @@ reference binary (and the reference client drives our server).
 
 from __future__ import annotations
 
-import struct
 import subprocess
 import sys
 from dataclasses import asdict, dataclass, field
 
-import msgpack
 import numpy as np
 
-from . import eigen
+from ..serve import protocol
 
 
 def _default_seeds() -> np.ndarray:
@@ -51,12 +49,6 @@ class Request:
         default_factory=VelocityFieldRequest)
 
 
-def _ndencode(obj):
-    if isinstance(obj, np.ndarray):
-        return eigen.pack_matrix(obj)
-    return obj
-
-
 class Listener:
     """Drives a ``--listen`` server subprocess for on-the-fly analysis."""
 
@@ -70,29 +62,18 @@ class Listener:
     def request(self, command: Request) -> dict | None:
         """Send one request; returns the decoded response dict (or None for an
         invalid frame)."""
-        msg = msgpack.packb(asdict(command), default=_ndencode)
-        self._proc.stdin.write(struct.pack("<Q", len(msg)))
-        self._proc.stdin.write(msg)
-        self._proc.stdin.flush()
-        hdr = self._proc.stdout.read(8)
-        if len(hdr) < 8:
+        protocol.write_message(self._proc.stdin, asdict(command))
+        payload = protocol.read_frame(self._proc.stdout)
+        if payload is None:
             raise RuntimeError("listener server closed unexpectedly")
-        (ressize,) = struct.unpack("<Q", hdr)
-        if ressize == 0:
+        if payload == b"":
             return None
-        payload = b""
-        while len(payload) < ressize:
-            chunk = self._proc.stdout.read(ressize - len(payload))
-            if not chunk:
-                raise RuntimeError("listener server closed mid-response")
-            payload += chunk
-        return eigen.decode_tree(msgpack.unpackb(payload, raw=False))
+        return protocol.unpack_message(payload)
 
     def close(self):
         if self._proc.poll() is None:
             try:
-                self._proc.stdin.write(struct.pack("<Q", 0))
-                self._proc.stdin.flush()
+                protocol.write_empty(self._proc.stdin)
                 self._proc.wait(timeout=10)
             except (BrokenPipeError, subprocess.TimeoutExpired):
                 self._proc.terminate()

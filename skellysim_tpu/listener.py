@@ -32,11 +32,9 @@ answers with a zero-length response like the reference
 from __future__ import annotations
 
 import dataclasses
-import struct
 import sys
 import weakref
 
-import msgpack
 import numpy as np
 
 from .builder import build_simulation
@@ -45,6 +43,7 @@ from .io import eigen
 from .io.trajectory import TrajectoryReader, frame_to_state
 from .postprocess import streamlines as compute_streamlines
 from .postprocess import vortex_lines as compute_vortex_lines
+from .serve import protocol
 from .system.system import solution_from_state
 
 _LINE_DEFAULTS = dict(dt_init=0.1, t_final=1.0, abs_err=1e-10, rel_err=1e-6,
@@ -215,22 +214,17 @@ def serve(config_file: str = "skelly_config.toml",
     reader = TrajectoryReader(traj)
     print(f"Entering listener mode ({len(reader)} frames)", file=sys.stderr)
 
+    # framing from serve.protocol — ONE source of truth for the
+    # length-prefixed msgpack wire format both servers speak
     while True:
-        hdr = stdin.read(8)
-        if len(hdr) < 8:
+        payload = protocol.read_frame(stdin)
+        if payload is None:
             return
-        (msgsize,) = struct.unpack("<Q", hdr)
-        if msgsize == 0:
+        if payload == b"":
             print("Terminate message received. Exiting listener mode",
                   file=sys.stderr)
             return
-        payload = b""
-        while len(payload) < msgsize:
-            chunk = stdin.read(msgsize - len(payload))
-            if not chunk:
-                return
-            payload += chunk
-        cmd = eigen.decode_tree(msgpack.unpackb(payload, raw=False))
+        cmd = protocol.unpack_message(payload)
 
         try:
             system, switched = switch_evaluator(system, cmd.get("evaluator"))
@@ -238,18 +232,13 @@ def serve(config_file: str = "skelly_config.toml",
             # reject the request (zero-length answer, like an invalid frame)
             # but keep serving — one typo'd client must not kill the server
             print(f"listener: {e}", file=sys.stderr)
-            stdout.write(struct.pack("<Q", 0))
-            stdout.flush()
+            protocol.write_empty(stdout)
             continue
         # velocity-field fns are cached per (system, plan) in _vel_fn_for,
         # so an evaluator switch naturally rebinds while repeated frames on
         # one evaluator reuse the compiled integrator
         response = process_request(system, template_state, reader, cmd)
         if response is None:
-            stdout.write(struct.pack("<Q", 0))
-            stdout.flush()
+            protocol.write_empty(stdout)
             continue
-        buf = msgpack.packb(response)
-        stdout.write(struct.pack("<Q", len(buf)))
-        stdout.write(buf)
-        stdout.flush()
+        protocol.write_message(stdout, response)

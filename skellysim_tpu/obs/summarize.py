@@ -33,6 +33,9 @@ class Summary:
         self.compiles: list[dict] = []
         self.lane_events: dict[str, int] = {}
         self.lane_rounds: list[dict] = []
+        #: admission latencies from lane admit/backfill events
+        #: (`queue_wait_s`, emitted by the ensemble scheduler)
+        self.queue_waits: list[float] = []
         self.steps: list[dict] = []
         self.resumes = 0
         self.versions: set[int] = set()
@@ -70,6 +73,8 @@ class Summary:
         elif ev == "lane":
             action = rec.get("action", "?")
             self.lane_events[action] = self.lane_events.get(action, 0) + 1
+            if "queue_wait_s" in rec:
+                self.queue_waits.append(float(rec["queue_wait_s"]))
         elif ev is None:
             if rec.get("resume"):
                 self.resumes += 1
@@ -133,6 +138,10 @@ class Summary:
             occ = sum(live) / (len(live) * lanes) if lanes else 0.0
             out.append(f"rounds: {len(self.lane_rounds)}  lanes: "
                        f"{int(lanes)}  mean occupancy: {occ:.1%}")
+        if self.queue_waits:
+            w = self.queue_waits
+            out.append(f"admission wait: mean {sum(w) / len(w):.4f}s  "
+                       f"max {max(w):.4f}s  (n={len(w)})")
         out.append("")
 
     def _convergence_section(self, out: list[str]):

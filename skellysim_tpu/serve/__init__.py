@@ -1,0 +1,58 @@
+"""skelly-serve: a persistent multi-tenant simulation service.
+
+The reference's interaction story is one process serving one client over a
+blocking request loop (`listener.py`); this subsystem composes the pieces
+that already exist — the ensemble continuous-batching scheduler
+(`ensemble.scheduler`), the trajectory snapshot/resume machinery
+(`io.trajectory.resume_state`), and the skelly-scope telemetry stream
+(`obs.tracer`) — into a long-lived server that keeps compiled ensemble
+programs warm and multiplexes many independent client simulations onto
+ensemble lanes (tenant = lane). The "millions of users" leg of the ROADMAP
+north star, and the forcing function for shape-bucketed warm programs.
+
+Layers (see docs/serving.md):
+
+* `protocol` — length-prefixed msgpack framing (one source of truth, shared
+               with `listener.py`) + the serve request/response schema
+               (submit/status/stream/snapshot/cancel/stats/shutdown);
+* `tenants`  — per-tenant lifecycle: admission queue with a capacity-bucket
+               check (a tenant only admits into a lane whose padded shapes
+               match an already-compiled program), snapshot/resume, graceful
+               eviction on client disconnect;
+* `server`   — the event loop: service client requests between batched
+               rounds of the ensemble scheduler (admit/step/retire with
+               tenants joining and leaving, never retracing);
+* `metrics`  — SLO counters derived from obs events (admission latency,
+               lane occupancy, steps/s + frames per tenant, compile events
+               after warmup), exported as telemetry JSONL + `/stats`;
+* `client`   — `ServeClient` / `SpawnedServer` for driving a server;
+* `cli`      — `python -m skellysim_tpu.serve`.
+
+Import discipline: this package root and `protocol` stay jax-free so
+clients (and `listener.py`) can import them without paying backend init;
+`server` pulls in the jax-heavy ensemble stack lazily.
+"""
+
+from . import protocol  # noqa: F401  (jax-free)
+
+
+def __getattr__(name):
+    # lazy jax-heavy surfaces: `serve.SimulationServer` etc. resolve on
+    # first touch without making `import skellysim_tpu.serve` heavy
+    if name in ("SimulationServer", "Bucket"):
+        from . import server
+
+        return getattr(server, name)
+    if name in ("Tenant", "TenantRegistry"):
+        from . import tenants
+
+        return getattr(tenants, name)
+    if name in ("ServeMetrics", "StatsTracer"):
+        from . import metrics
+
+        return getattr(metrics, name)
+    if name in ("ServeClient", "SpawnedServer"):
+        from . import client
+
+        return getattr(client, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,81 @@
+"""Serve driver: `python -m skellysim_tpu.serve --config-file=...`.
+
+Boots the long-lived multi-tenant simulation service (docs/serving.md): the
+config file's fibers/params define the warm compiled program tenants admit
+against, its `[serve]` table (host/port/buckets/lanes/queue) sizes the
+service. `--port 0` binds an ephemeral port; pair it with `--port-file` so
+spawners (CI, `serve.client.SpawnedServer`) can find it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="skellysim-tpu-serve",
+        description="persistent multi-tenant simulation service with "
+                    "warm-program admission control (docs/serving.md)")
+    ap.add_argument("--config-file", default="skelly_config.toml",
+                    help="server run config; its [serve] table sizes the "
+                         "service, its fibers/params define the compiled-"
+                         "program contract tenants admit against")
+    ap.add_argument("--host", default=None,
+                    help="override [serve] host")
+    ap.add_argument("--port", type=int, default=None,
+                    help="override [serve] port (0 = ephemeral)")
+    ap.add_argument("--port-file", default=None,
+                    help="publish the bound port to this file once listening")
+    ap.add_argument("--max-lanes", type=int, default=None,
+                    help="override [serve] max_lanes (tenant slots/bucket)")
+    ap.add_argument("--trace-file", default=None,
+                    help="skelly-scope telemetry JSONL (lane/compile/span "
+                         "events; `python -m skellysim_tpu.obs summarize`)")
+    ap.add_argument("--jax-cache", default=None, metavar="DIR",
+                    help="persistent XLA compilation cache directory shared "
+                         "across runs/CLIs: cold server starts reuse prior "
+                         "compiles (bench.py's .jax_cache pattern)")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the startup bucket-program compile (programs "
+                         "then compile on first admission)")
+    ap.add_argument("--log-level",
+                    default=os.environ.get("SKELLYSIM_LOG", "INFO"))
+    args = ap.parse_args(argv)
+
+    import logging
+
+    logging.basicConfig(level=args.log_level.upper(),
+                        format="[%(asctime)s] [%(levelname)s] %(message)s",
+                        stream=sys.stderr)
+
+    # x64 for the same reason as the run/ensemble CLIs: without it the
+    # builder's "f64" states silently canonicalize to f32 and tight
+    # tolerances floor at f32 noise while steps are still accepted
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from ..utils.bootstrap import enable_compilation_cache
+
+    enable_compilation_cache(args.jax_cache)
+
+    from ..config import schema
+    from .server import SimulationServer
+
+    serve_cfg = schema.load_serve_config(args.config_file)
+    if args.host is not None:
+        serve_cfg.host = args.host
+    if args.port is not None:
+        serve_cfg.port = args.port
+    if args.max_lanes is not None:
+        serve_cfg.max_lanes = args.max_lanes
+
+    server = SimulationServer(args.config_file, serve_cfg=serve_cfg,
+                              trace_path=args.trace_file,
+                              warmup=not args.no_warmup)
+    server.serve_forever(port_file=args.port_file)
+    print("serve: shutdown complete "
+          f"({server.metrics.stats()['retired']} tenant(s) retired)")

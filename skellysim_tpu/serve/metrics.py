@@ -1,0 +1,133 @@
+"""SLO counters for the serve event loop, derived from skelly-scope events.
+
+The server does not keep a second bookkeeping path: every number `/stats`
+reports is folded from the SAME telemetry events the tracer already emits
+(`obs.tracer` schema, docs/observability.md) — ``lane`` events carry
+admissions/backfills/retirements and the `queue_wait_s` admission latency,
+``span`` events named ``ensemble_step`` carry per-round lane occupancy and
+wall time, ``compile`` events mark program (re)compiles. `StatsTracer` tees
+the stream: each event updates the in-memory `ServeMetrics` accumulator AND
+flows on to the ordinary tracer sink (JSONL file or in-memory list), so a
+`--trace-file` from a service run renders under ``obs summarize`` exactly
+like an ensemble sweep's.
+
+The one serving-specific counter the event stream cannot carry is
+``compiles_after_warm``: the server calls `mark_warm()` once every
+constructed bucket has completed its first batched round — from then on ANY
+compile event is a warm-path retrace, the defect class `test_retrace.py`
+pins at trace time and this counter exposes at serve time (the acceptance
+gate: zero after warmup).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..obs import tracer as obs_tracer
+
+
+class ServeMetrics:
+    """Accumulator of serving SLO counters (see `stats`)."""
+
+    def __init__(self):
+        self.admitted = 0          # lane seats (admit + backfill actions)
+        self.retired = 0           # lanes freed, by reason
+        self.retire_reasons: dict[str, int] = {}
+        self.rejected = 0          # admission rejections (server increments)
+        self.queue_waits: list[float] = []
+        self.rounds = 0            # batched ensemble_step rounds
+        self.round_wall_s = 0.0
+        self.occupancy_sum = 0.0   # sum of live/lanes per round
+        self.steps = 0             # member trial steps (live lanes x rounds)
+        self.compiles = 0
+        self.compiles_after_warm = 0
+        self.warm = False
+        self.frames_streamed: dict[str, int] = {}
+
+    # ------------------------------------------------------------ ingest
+
+    def observe(self, ev: str, fields: dict):
+        """Fold one telemetry event (called by `StatsTracer.emit`)."""
+        if ev == "lane":
+            action = fields.get("action")
+            if action in ("admit", "backfill"):
+                self.admitted += 1
+                if "queue_wait_s" in fields:
+                    self.queue_waits.append(float(fields["queue_wait_s"]))
+            elif action == "retire":
+                self.retired += 1
+                reason = fields.get("reason", "finished")
+                self.retire_reasons[reason] = (
+                    self.retire_reasons.get(reason, 0) + 1)
+        elif ev == "span" and fields.get("name") == "ensemble_step":
+            self.rounds += 1
+            self.round_wall_s += float(fields.get("dur_s", 0.0))
+            live = fields.get("live")
+            lanes = fields.get("lanes")
+            if live is not None and lanes:
+                self.occupancy_sum += float(live) / float(lanes)
+                self.steps += int(live)
+        elif ev == "compile":
+            self.compiles += 1
+            if self.warm:
+                self.compiles_after_warm += 1
+
+    def mark_warm(self):
+        """Every bucket has compiled + completed a round: from here on a
+        compile event means a warm-path retrace (SLO violation)."""
+        self.warm = True
+
+    def note_frames_streamed(self, tenant_id: str, n: int):
+        if n:
+            self.frames_streamed[tenant_id] = (
+                self.frames_streamed.get(tenant_id, 0) + n)
+
+    def note_rejected(self):
+        self.rejected += 1
+
+    # ------------------------------------------------------------ report
+
+    def stats(self) -> dict:
+        """The `/stats` response body (also the shape tests pin)."""
+        w = self.queue_waits
+        return {
+            "admitted": self.admitted,
+            "rejected": self.rejected,
+            "retired": self.retired,
+            "retire_reasons": dict(self.retire_reasons),
+            "rounds": self.rounds,
+            "steps": self.steps,
+            "steps_per_s": (self.steps / self.round_wall_s
+                            if self.round_wall_s > 0 else 0.0),
+            "round_wall_s": round(self.round_wall_s, 6),
+            "mean_occupancy": (self.occupancy_sum / self.rounds
+                               if self.rounds else 0.0),
+            "admission_wait_s": {
+                "n": len(w),
+                "mean": (sum(w) / len(w)) if w else 0.0,
+                "max": max(w) if w else 0.0,
+            },
+            "compiles": self.compiles,
+            "compiles_after_warm": self.compiles_after_warm,
+            "warm": self.warm,
+            "frames_streamed": dict(self.frames_streamed),
+            "frames_streamed_total": sum(self.frames_streamed.values()),
+        }
+
+
+class StatsTracer(obs_tracer.Tracer):
+    """A `Tracer` that tees every event into a `ServeMetrics` accumulator.
+
+    ``path=None`` keeps the ordinary in-memory event list (tests assert on
+    it); a path appends telemetry JSONL exactly like any other tracer.
+    """
+
+    def __init__(self, metrics: ServeMetrics, path: Optional[str] = None):
+        # set before super().__init__: the base constructor emits the
+        # telemetry header through our emit()
+        self.metrics = metrics
+        super().__init__(path)
+
+    def emit(self, ev: str, **fields):
+        self.metrics.observe(ev, fields)
+        super().emit(ev, **fields)

@@ -1,0 +1,218 @@
+"""Wire protocol: length-prefixed msgpack framing + the serve request schema.
+
+One source of truth for the framing both servers speak: a message is a
+little-endian u64 byte count followed by that many bytes of msgpack — the
+reference listener protocol (`/root/reference/src/core/listener.cpp:86-136`),
+unchanged. `listener.py` (the reference's blocking post-processing server)
+and the skelly-serve simulation service both read/write through the helpers
+here, so a framing fix lands in every surface at once; `io.listener_client`
+shares them from the client side.
+
+Frame semantics (the reference's, kept):
+
+* a ZERO-LENGTH frame is in-band control — "terminate" from a client,
+  "invalid request" from a server;
+* EOF mid-frame means the peer went away (`read_frame` returns None — never
+  an exception, disconnects are an expected event for a server).
+
+On top of the framing, this module defines the serve request/response
+schema (`REQUEST_FIELDS`): every request is a msgpack map with a ``type``
+key; every response is a map with an ``ok`` bool (error text under
+``error`` when False). Arrays cross the wire in the reference's
+``__eigen__`` encoding (`io.eigen`), trajectory frames as raw
+trajectory-v1 msgpack bytes — a streamed frame is byte-identical to the
+same frame in a `.out` file, so every existing reader works on it.
+
+Import discipline: jax-free (msgpack + numpy only) — clients must be able
+to import this without paying JAX backend init.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+import msgpack
+import numpy as np
+
+from ..io import eigen
+
+#: little-endian u64 frame header (`listener.cpp:92`)
+HEADER = struct.Struct("<Q")
+
+#: sanity bound on one frame (a corrupt/hostile header must not make a
+#: server try to buffer exabytes); generous vs real payloads — a 10k-fiber
+#: 32-node f64 frame is ~8 MB
+MAX_FRAME_BYTES = 1 << 31
+
+
+def _ndencode(obj):
+    if isinstance(obj, np.ndarray):
+        return eigen.pack_matrix(obj)
+    return obj
+
+
+def pack_message(obj) -> bytes:
+    """Message dict -> msgpack bytes (ndarrays via the ``__eigen__`` wire
+    encoding, like every trajectory payload)."""
+    return msgpack.packb(obj, default=_ndencode)
+
+
+def unpack_message(buf: bytes) -> dict:
+    """msgpack bytes -> message dict with ``__eigen__``/``__quat__`` wire
+    payloads decoded back to arrays."""
+    return eigen.decode_tree(msgpack.unpackb(buf, raw=False))
+
+
+# ------------------------------------------------------------ stream framing
+
+def write_frame(stream, payload: bytes) -> None:
+    """One framed message (header + payload) to a file-like stream."""
+    if len(payload) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame of {len(payload)} bytes exceeds "
+                         f"MAX_FRAME_BYTES ({MAX_FRAME_BYTES})")
+    stream.write(HEADER.pack(len(payload)))
+    if payload:
+        stream.write(payload)
+    stream.flush()
+
+
+def write_empty(stream) -> None:
+    """The in-band zero-length frame (terminate / invalid-request)."""
+    write_frame(stream, b"")
+
+
+def read_frame(stream) -> Optional[bytes]:
+    """One framed payload from a file-like stream.
+
+    Returns the payload bytes (``b""`` for the in-band zero-length frame) or
+    None when the peer closed the stream at a frame boundary or mid-frame —
+    a disconnect is an expected event, not an exception."""
+    hdr = stream.read(HEADER.size)
+    if hdr is None or len(hdr) < HEADER.size:
+        return None
+    (size,) = HEADER.unpack(hdr)
+    if size == 0:
+        return b""
+    if size > MAX_FRAME_BYTES:
+        raise ValueError(f"incoming frame header claims {size} bytes "
+                         f"(> MAX_FRAME_BYTES {MAX_FRAME_BYTES})")
+    chunks = []
+    remaining = size
+    while remaining:
+        chunk = stream.read(remaining)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def write_message(stream, obj) -> None:
+    write_frame(stream, pack_message(obj))
+
+
+def read_message(stream) -> Optional[dict]:
+    """One message from a stream; None on disconnect, ``{}``-falsy empty
+    dict NEVER happens (a zero-length frame decodes to None too — callers
+    that must distinguish control frames use `read_frame` directly, like
+    `listener.serve`)."""
+    buf = read_frame(stream)
+    if not buf:
+        return None
+    return unpack_message(buf)
+
+
+class FrameDecoder:
+    """Incremental framing for non-blocking sockets.
+
+    ``feed(data)`` buffers arbitrary byte chunks and returns every COMPLETE
+    frame payload they finish (zero-length control frames come back as
+    ``b""``); partial frames stay buffered until the next feed. The serve
+    event loop reads whatever a socket has ready and feeds it here — the
+    blocking read loop of `read_frame`, inverted.
+    """
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> list[bytes]:
+        self._buf.extend(data)
+        frames = []
+        while True:
+            if len(self._buf) < HEADER.size:
+                return frames
+            (size,) = HEADER.unpack(self._buf[:HEADER.size])
+            if size > MAX_FRAME_BYTES:
+                raise ValueError(f"incoming frame header claims {size} "
+                                 f"bytes (> MAX_FRAME_BYTES)")
+            if len(self._buf) < HEADER.size + size:
+                return frames
+            frames.append(bytes(self._buf[HEADER.size:HEADER.size + size]))
+            del self._buf[:HEADER.size + size]
+
+
+# ----------------------------------------------------------- request schema
+
+#: request type -> (required fields, optional fields). The serve server
+#: rejects anything else up front — a typo'd request must answer with a
+#: structured error, not a stack trace mid-event-loop.
+REQUEST_FIELDS = {
+    # enter the admission queue: a full run-config TOML as text (the same
+    # contract `skelly_config.toml` satisfies), optionally resuming from a
+    # previously snapshotted trajectory frame
+    "submit": (("config",), ("tenant", "t_final", "resume_frame")),
+    # tenant lifecycle + progress counters
+    "status": (("tenant",), ()),
+    # drain the tenant's pending trajectory frames (raw trajectory-v1 bytes)
+    "stream": (("tenant",), ("max_frames",)),
+    # the tenant's CURRENT state as one trajectory frame (the exact resume
+    # point — newer than its last dt_write frame)
+    "snapshot": (("tenant",), ()),
+    # free the tenant's lane (running) or queue slot (queued) now
+    "cancel": (("tenant",), ()),
+    # server-wide SLO counters (serve.metrics)
+    "stats": ((), ()),
+    # stop the event loop after answering
+    "shutdown": ((), ()),
+}
+
+#: tenant lifecycle states (`serve.tenants`)
+TENANT_STATES = ("queued", "running", "finished", "evicted", "cancelled",
+                 "dt_underflow")
+
+
+def make_request(rtype: str, **fields) -> dict:
+    """Validated request dict (the client-side constructor)."""
+    req = {"type": rtype, **fields}
+    err = validate_request(req)
+    if err:
+        raise ValueError(err)
+    return req
+
+
+def validate_request(req) -> Optional[str]:
+    """None when ``req`` is a well-formed request, else the error text the
+    server answers with."""
+    if not isinstance(req, dict):
+        return f"request must be a msgpack map, got {type(req).__name__}"
+    rtype = req.get("type")
+    if rtype not in REQUEST_FIELDS:
+        return (f"unknown request type {rtype!r}; valid types: "
+                + ", ".join(sorted(REQUEST_FIELDS)))
+    required, optional = REQUEST_FIELDS[rtype]
+    missing = [f for f in required if f not in req]
+    if missing:
+        return f"request {rtype!r} missing required field(s): {missing}"
+    unknown = sorted(set(req) - {"type"} - set(required) - set(optional))
+    if unknown:
+        return f"request {rtype!r} has unknown field(s): {unknown}"
+    return None
+
+
+def ok(**fields) -> dict:
+    return {"ok": True, **fields}
+
+
+def error(message: str, **fields) -> dict:
+    return {"ok": False, "error": message, **fields}

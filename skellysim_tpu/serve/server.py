@@ -1,0 +1,490 @@
+"""The serve event loop: warm compiled lanes, tenants joining between rounds.
+
+`SimulationServer` composes three existing subsystems into one long-lived
+process (ROADMAP item 3):
+
+* the ensemble continuous-batching scheduler steps B lanes as ONE compiled
+  program and swaps members in/out without retracing
+  (`ensemble.scheduler.EnsembleScheduler.admit/poll/evict`);
+* the trajectory frame machinery encodes per-tenant frames + snapshots
+  byte-compatible with every existing reader (`io.trajectory`);
+* skelly-scope telemetry carries the SLO stream (`serve.metrics` folds the
+  same events `/stats` reports from).
+
+One thread, no locks: the socket loop services whatever client requests are
+pending (admission, streaming, snapshots, eviction), then runs ONE batched
+round over every bucket with live lanes, then returns to the sockets —
+requests land exactly at round boundaries, which is also the only place the
+scheduler allows lane churn. Latency per request is therefore bounded by
+one batched step, and the solves never leave the device between rounds.
+
+Capacity buckets: each configured capacity is one `EnsembleScheduler` whose
+template pads the base config's fiber batch to that capacity. `warmup()`
+compiles every bucket's program ONCE at startup (an idle-lane batched step
+— all lanes masked inert); from then on every admission is pure leaf
+substitution into a warm program, and any further compile event is a
+warm-path retrace counted by `metrics.compiles_after_warm` (the acceptance
+gate pins it at zero).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from ..obs import tracer as obs_tracer
+from . import protocol, tenants as tenants_mod
+from .metrics import ServeMetrics, StatsTracer
+
+logger = logging.getLogger("skellysim_tpu")
+
+
+class Bucket:
+    """One capacity bucket: a padded template + its compiled lanes."""
+
+    def __init__(self, capacity: int, template, scheduler):
+        self.capacity = capacity
+        self.template = template
+        self.scheduler = scheduler
+        self.warmed = False
+
+
+class SimulationServer:
+    """The serve core, socket-free: `handle_request` + `tick`.
+
+    Tests drive these directly; `serve_forever` wraps them in the TCP event
+    loop. ``config`` is the server's run-config TOML path (or a parsed
+    `schema.Config` plus an explicit ``serve_cfg``): its fibers/params
+    define the compiled-program contract every tenant must match, its
+    `[serve]` table sizes the service.
+    """
+
+    def __init__(self, config, *, serve_cfg=None, trace_path: str = None,
+                 config_dir: str = ".", warmup: bool = True):
+        from ..builder import build_simulation
+        from ..config import schema
+        from ..ensemble.runner import EnsembleRunner
+        from ..ensemble.scheduler import EnsembleScheduler
+
+        if isinstance(config, (str, os.PathLike)):
+            if serve_cfg is None:
+                serve_cfg = schema.load_serve_config(str(config))
+            config_dir = os.path.dirname(os.path.abspath(config)) or "."
+            config = schema.load_config(str(config))
+        elif serve_cfg is None:
+            serve_cfg = schema.ServeConfig()
+        self.base_config = config
+        self.serve_cfg = serve_cfg
+        self.metrics = ServeMetrics()
+        self.tracer = StatsTracer(self.metrics, trace_path)
+        self.registry = tenants_mod.TenantRegistry()
+        self._shutdown = False
+        self.address = None
+
+        system, base_state, _ = build_simulation(config,
+                                                 config_dir=config_dir)
+        if base_state.fibers is None:
+            raise ValueError("serve needs a base config with fibers: they "
+                             "define the compiled-program contract tenants "
+                             "admit against")
+        self.system = system
+        base_n = self._fiber_count(base_state)
+        caps = sorted(set(serve_cfg.bucket_capacities)) or [base_n]
+        if caps[0] < base_n:
+            raise ValueError(
+                f"[serve] bucket_capacities {caps} below the base config's "
+                f"fiber count {base_n}; buckets PAD the base scene, so every "
+                "capacity must be >= it")
+        self.buckets: list[Bucket] = []
+        for cap in caps:
+            template = tenants_mod.pad_state_to_capacity(base_state, cap)
+            runner = EnsembleRunner(system, batch_impl=serve_cfg.batch_impl)
+            sched = EnsembleScheduler(
+                runner, [], serve_cfg.max_lanes, template=template,
+                writer=self._on_frame, metrics=self._on_sched_event,
+                on_retire=self._on_retire, on_dt_underflow="retire")
+            self.buckets.append(Bucket(cap, template, sched))
+        if warmup:
+            self.warmup()
+
+    @staticmethod
+    def _fiber_count(state) -> int:
+        from ..fibers import container as fc
+
+        return sum(g.n_fibers for g in fc.as_buckets(state.fibers))
+
+    # ----------------------------------------------------------- warm path
+
+    def warmup(self):
+        """Compile every bucket's batched program on its idle template lanes
+        (all masked inert — one cheap round each), then arm the
+        zero-compiles-after-warmup gate."""
+        with obs_tracer.use(self.tracer):
+            for b in self.buckets:
+                if not b.warmed:
+                    # pure call, result discarded: compiles (and emits the
+                    # compile event) without advancing the idle lanes
+                    b.scheduler.step_fn(b.scheduler.ens)
+                    b.warmed = True
+            self.metrics.mark_warm()
+        logger.info("serve: %d bucket program(s) warm (capacities %s)",
+                    len(self.buckets), [b.capacity for b in self.buckets])
+
+    def tick(self) -> bool:
+        """One batched round over every bucket with live lanes; True when
+        any stepping happened (the socket loop's idle signal)."""
+        did = False
+        with obs_tracer.use(self.tracer):
+            for b in self.buckets:
+                if b.scheduler.live:
+                    b.scheduler.poll()
+                    did = True
+        return did
+
+    def any_live(self) -> bool:
+        return any(b.scheduler.live for b in self.buckets)
+
+    # ------------------------------------------------- scheduler callbacks
+
+    def _tenant(self, member_id: str):
+        return self.registry.get(member_id)
+
+    def _on_frame(self, member_id: str, state, *, rng_state=None):
+        t = self._tenant(member_id)
+        if t is not None:
+            t.frames.append(tenants_mod.state_snapshot(state,
+                                                       rng_state=rng_state))
+            t.frames_total += 1
+
+    def _on_retire(self, member_id: str, state, reason: str):
+        t = self._tenant(member_id)
+        if t is not None:
+            t.final_frame = tenants_mod.state_snapshot(
+                state, rng_state=t.rng_state)
+            t.t = float(state.time)
+            t.status = reason if reason in tenants_mod.TENANT_STATES \
+                else "finished"
+
+    def _on_sched_event(self, rec: dict):
+        t = self._tenant(rec.get("member", ""))
+        if t is None:
+            return
+        ev = rec.get("event")
+        if ev == "start":
+            t.status = "running"
+        elif ev == "step":
+            t.steps = int(rec["step"]) + 1
+            t.t = float(rec["t"])
+
+    # ------------------------------------------------------------ requests
+
+    def handle_request(self, req, conn=None) -> dict:
+        """One request dict -> one response dict (never raises: admission
+        rejections and malformed requests answer structured errors — one
+        bad client must not kill the service)."""
+        err = protocol.validate_request(req)
+        if err:
+            return protocol.error(err)
+        handler = getattr(self, f"_req_{req['type']}")
+        try:
+            with obs_tracer.use(self.tracer):
+                return handler(req, conn)
+        except Exception as e:  # defense for the event loop
+            logger.exception("serve: %s request failed", req.get("type"))
+            return protocol.error(f"{type(e).__name__}: {e}")
+
+    def _req_submit(self, req, conn) -> dict:
+        from ..builder import build_simulation
+        from ..utils.rng import SimRNG
+
+        if all(b.scheduler.live >= b.scheduler.batch
+               and len(b.scheduler.queue) >= self.serve_cfg.queue_depth
+               for b in self.buckets):
+            # shed BEFORE the host-side scene build: a saturated server must
+            # not pay build_simulation per rejected retry (overload is
+            # exactly when the event loop can least afford it)
+            self.metrics.note_rejected()
+            return protocol.error(
+                "admission queue full on every bucket — retry later",
+                retry=True)
+        try:
+            cfg = tenants_mod.parse_tenant_config(req["config"])
+        except ValueError as e:
+            self.metrics.note_rejected()
+            return protocol.error(str(e))
+        err = tenants_mod.check_params_contract(cfg.params,
+                                                self.base_config.params)
+        if err:
+            self.metrics.note_rejected()
+            return protocol.error(err)
+        _, state, rng = build_simulation(cfg)
+
+        # capacity-bucket selection: smallest bucket the padded scene fits
+        n = self._fiber_count(state)
+        bucket = next((b for b in self.buckets if b.capacity >= n), None)
+        if bucket is not None:
+            state = tenants_mod.pad_state_to_capacity(state, bucket.capacity)
+            if req.get("resume_frame") is not None:
+                # rebuild from the snapshot frame over the fresh state, then
+                # re-pad (frames carry ACTIVE fibers only); the frame's
+                # serialized RNG streams resume too, like cli's --resume
+                state, rng_state = tenants_mod.state_from_snapshot(
+                    bytes(req["resume_frame"]), state)
+                if rng_state:
+                    rng = SimRNG.from_state(rng_state)
+                state = tenants_mod.pad_state_to_capacity(state,
+                                                         bucket.capacity)
+            mismatch = tenants_mod.bucket_mismatch(bucket.template, state)
+        else:
+            mismatch = (f"scene needs {n} fiber slots but the largest "
+                        f"bucket holds {self.buckets[-1].capacity}")
+        if bucket is None or mismatch:
+            self.metrics.note_rejected()
+            return protocol.error(
+                "no capacity bucket matches this scene: " + mismatch
+                + f" (bucket capacities: {[b.capacity for b in self.buckets]})")
+
+        sched = bucket.scheduler
+        if (sched.live >= sched.batch
+                and len(sched.queue) >= self.serve_cfg.queue_depth):
+            self.metrics.note_rejected()
+            return protocol.error(
+                f"admission queue full ({len(sched.queue)} waiting, "
+                f"{sched.batch} lanes busy) — retry later", retry=True)
+
+        tid = req.get("tenant") or self.registry.new_id()
+        if self.registry.get(tid) is not None:
+            self.metrics.note_rejected()
+            return protocol.error(f"tenant id {tid!r} already exists")
+        # explicit None check: a client-requested t_final of 0.0 means "admit
+        # and stop immediately", not "use the config's"
+        t_final = float(cfg.params.t_final if req.get("t_final") is None
+                        else req["t_final"])
+        tenant = tenants_mod.Tenant(
+            tenant_id=tid, bucket=bucket.capacity, t_final=t_final,
+            conn=conn, t=float(state.time),
+            rng_state=rng.dump_state() if rng is not None else None)
+        self.registry.add(tenant)
+        if req.get("resume_frame") is None:
+            # the initial-config frame, like a fresh CLI run (resumed
+            # tenants skip it, like `--resume` appends)
+            self._on_frame(tid, state, rng_state=tenant.rng_state)
+
+        from ..ensemble.scheduler import MemberSpec
+
+        lane = sched.admit(MemberSpec(member_id=tid, state=state,
+                                      t_final=t_final, rng=rng))
+        logger.info("serve: tenant %s -> bucket %d %s", tid, bucket.capacity,
+                    f"lane {lane}" if lane is not None else "queued")
+        return protocol.ok(tenant=tid, bucket=bucket.capacity,
+                           status=tenant.status, lane=lane,
+                           queued=lane is None)
+
+    def _find(self, req):
+        t = self.registry.get(req["tenant"])
+        if t is None:
+            return None, protocol.error(f"unknown tenant {req['tenant']!r}")
+        return t, None
+
+    def _bucket_of(self, tenant) -> Bucket:
+        return next(b for b in self.buckets if b.capacity == tenant.bucket)
+
+    def _req_status(self, req, conn) -> dict:
+        t, err = self._find(req)
+        if err:
+            return err
+        sched = self._bucket_of(t).scheduler
+        return protocol.ok(
+            tenant=t.tenant_id, status=t.status, t=t.t, t_final=t.t_final,
+            steps=t.steps, lane=sched.lane_of(t.tenant_id),
+            bucket=t.bucket, frames_total=t.frames_total,
+            frames_pending=len(t.frames))
+
+    def _req_stream(self, req, conn) -> dict:
+        t, err = self._find(req)
+        if err:
+            return err
+        limit = req.get("max_frames")
+        # None = drain everything; an explicit 0 drains NOTHING (a client
+        # probing eof/pending must not lose frames to a falsy check)
+        limit = len(t.frames) if limit is None else int(limit)
+        frames = [t.frames.popleft() for _ in range(min(limit, len(t.frames)))]
+        t.frames_streamed += len(frames)
+        self.metrics.note_frames_streamed(t.tenant_id, len(frames))
+        eof = (t.status not in ("queued", "running")) and not t.frames
+        return protocol.ok(tenant=t.tenant_id, frames=frames, eof=eof,
+                           pending=len(t.frames))
+
+    def _req_snapshot(self, req, conn) -> dict:
+        t, err = self._find(req)
+        if err:
+            return err
+        sched = self._bucket_of(t).scheduler
+        lane = sched.lane_of(t.tenant_id)
+        t_now = t.t
+        if lane is not None:
+            from ..ensemble.runner import lane_state
+
+            state = lane_state(sched.ens.states, lane)
+            frame = tenants_mod.state_snapshot(state, rng_state=t.rng_state)
+            t_now = float(state.time)
+        elif t.final_frame is not None:
+            frame = t.final_frame
+        else:
+            # queued: its initial frame is the snapshot
+            for spec in sched.queue:
+                if spec.member_id == t.tenant_id:
+                    frame = tenants_mod.state_snapshot(
+                        spec.state, rng_state=t.rng_state)
+                    break
+            else:
+                return protocol.error(
+                    f"tenant {t.tenant_id!r} has no snapshot yet")
+        return protocol.ok(tenant=t.tenant_id, frame=frame, t=t_now,
+                           status=t.status)
+
+    def _req_cancel(self, req, conn) -> dict:
+        t, err = self._find(req)
+        if err:
+            return err
+        self._release(t, reason="cancelled")
+        return protocol.ok(tenant=t.tenant_id, status=t.status)
+
+    def _release(self, tenant, reason: str):
+        """Free whatever the tenant holds (lane or queue slot); terminal
+        states pass through untouched."""
+        sched = self._bucket_of(tenant).scheduler
+        lane = sched.lane_of(tenant.tenant_id)
+        if lane is not None:
+            sched.evict(lane, reason=reason)  # _on_retire stamps the status
+        else:
+            spec = sched.unqueue(tenant.tenant_id)
+            if spec is not None:
+                # a queued member's spec state IS its resume point — keep it
+                # as the snapshot (resumed submits buffer no initial frame,
+                # so dropping the spec here would lose the tenant entirely)
+                tenant.final_frame = tenants_mod.state_snapshot(
+                    spec.state, rng_state=tenant.rng_state)
+                tenant.t = float(spec.state.time)
+                tenant.status = reason
+
+    def evict_conn(self, conn):
+        """Graceful eviction on client disconnect: every tenant the
+        connection owns frees its lane/queue slot, keeping its final
+        snapshot for a later resume."""
+        with obs_tracer.use(self.tracer):
+            for t in self.registry.of_conn(conn):
+                if t.status in ("queued", "running"):
+                    logger.info("serve: evicting tenant %s (disconnect)",
+                                t.tenant_id)
+                    self._release(t, reason="evicted")
+
+    def _req_stats(self, req, conn) -> dict:
+        stats = self.metrics.stats()
+        stats.update(
+            tenants=len(self.registry),
+            buckets=[{"capacity": b.capacity, "lanes": b.scheduler.batch,
+                      "live": b.scheduler.live,
+                      "queued": len(b.scheduler.queue),
+                      "warmed": b.warmed} for b in self.buckets])
+        return protocol.ok(stats=stats)
+
+    def _req_shutdown(self, req, conn) -> dict:
+        self._shutdown = True
+        return protocol.ok(shutdown=True)
+
+    # ---------------------------------------------------------- socket loop
+
+    def serve_forever(self, *, port_file: Optional[str] = None,
+                      idle_wait_s: float = 0.05):
+        """The TCP event loop (single thread): accept/read/answer pending
+        client traffic, then run one batched round, repeat. Returns after a
+        ``shutdown`` request."""
+        import selectors
+        import socket
+
+        lsock = socket.create_server(
+            (self.serve_cfg.host, self.serve_cfg.port))
+        self.address = lsock.getsockname()
+        if port_file:
+            # atomic publish: spawners poll for this file to learn the port
+            tmp = port_file + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(f"{self.address[1]}\n")
+            os.replace(tmp, port_file)
+        logger.info("serve: listening on %s:%d", *self.address[:2])
+        lsock.setblocking(False)
+        sel = selectors.DefaultSelector()
+        sel.register(lsock, selectors.EVENT_READ)
+        decoders: dict = {}
+        try:
+            while not self._shutdown:
+                # step-bound request latency: zero timeout while simulations
+                # are live (service sockets between rounds), short block when
+                # fully idle
+                for key, _ in sel.select(0.0 if self.any_live()
+                                         else idle_wait_s):
+                    if key.fileobj is lsock:
+                        conn, addr = lsock.accept()
+                        # bounded sends: a client that stops reading its
+                        # responses (full TCP window) must not freeze the
+                        # single-threaded loop — the timeout surfaces as
+                        # OSError and drops only that connection
+                        conn.settimeout(self.serve_cfg.send_timeout_s)
+                        sel.register(conn, selectors.EVENT_READ)
+                        decoders[conn] = protocol.FrameDecoder()
+                        logger.info("serve: client %s connected", addr)
+                    else:
+                        self._service_conn(key.fileobj, decoders, sel)
+                    if self._shutdown:
+                        break
+                if not self._shutdown:
+                    self.tick()
+        finally:
+            for conn in list(decoders):
+                self._drop_conn(conn, decoders, sel)
+            sel.unregister(lsock)
+            lsock.close()
+            sel.close()
+            self.tracer.close()
+
+    def _drop_conn(self, conn, decoders, sel):
+        self.evict_conn(conn)
+        decoders.pop(conn, None)
+        try:
+            sel.unregister(conn)
+        except KeyError:
+            pass
+        conn.close()
+
+    def _service_conn(self, conn, decoders, sel):
+        try:
+            data = conn.recv(1 << 16)
+        except (ConnectionError, OSError):
+            data = b""
+        if not data:
+            self._drop_conn(conn, decoders, sel)
+            return
+        try:
+            payloads = decoders[conn].feed(data)
+        except ValueError:
+            self._drop_conn(conn, decoders, sel)
+            return
+        for payload in payloads:
+            if not payload:
+                # in-band goodbye (the listener protocol's terminate frame)
+                self._drop_conn(conn, decoders, sel)
+                return
+            try:
+                req = protocol.unpack_message(payload)
+            except Exception:
+                resp = protocol.error("undecodable msgpack request")
+            else:
+                resp = self.handle_request(req, conn=conn)
+            buf = protocol.pack_message(resp)
+            try:
+                conn.sendall(protocol.HEADER.pack(len(buf)) + buf)
+            except (ConnectionError, OSError):
+                self._drop_conn(conn, decoders, sel)
+                return

@@ -1,0 +1,198 @@
+"""Per-tenant lifecycle: admission, capacity buckets, snapshot/resume, eviction.
+
+A tenant is one independent client simulation multiplexed onto an ensemble
+lane (tenant = lane). This module owns everything about a tenant EXCEPT the
+stepping itself (which stays in `ensemble.scheduler`):
+
+* **Admission** — a submitted config becomes a `SimState` only if it can
+  ride an ALREADY-COMPILED program: its runtime `Params` must equal the
+  server's up to the per-member knobs (seed, t_final — the same
+  one-compiled-program contract the ensemble sweep CLI enforces), and its
+  padded state shapes must match a capacity bucket's template exactly.
+  Scenes smaller than the bucket capacity are padded with inert masked
+  fibers (`fibers.container.grow_capacity` — the ensemble masked-lane trick
+  applied to admission), so many different scenes hit one warm program.
+* **Snapshot/resume** — a tenant's state round-trips through ONE
+  trajectory-v1 frame (`io.trajectory.frame_bytes` / `frame_to_state`),
+  byte-compatible with the `--resume` machinery: a snapshot streamed to a
+  client can be appended to a `.out` file, fed back in a later ``submit``,
+  or inspected by every existing reader.
+* **Eviction** — a tenant whose client disconnects is retired gracefully:
+  its lane frees for the queue, its final state is kept as the snapshot a
+  reconnecting client resumes from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+from ..config import schema
+from ..config.toml_io import loads as toml_loads
+from . import protocol
+
+#: tenant lifecycle states (mirrored in `protocol.TENANT_STATES`):
+#: queued -> running -> finished | evicted | cancelled | dt_underflow
+TENANT_STATES = protocol.TENANT_STATES
+
+
+@dataclasses.dataclass
+class Tenant:
+    """One client simulation's service-side record."""
+
+    tenant_id: str
+    bucket: int                       # capacity (padded fiber count)
+    t_final: float
+    status: str = "queued"
+    #: owning connection key (server-side); None for in-process tenants.
+    #: Disconnect of this connection evicts the tenant.
+    conn: Optional[object] = None
+    t: float = 0.0
+    steps: int = 0
+    #: pending trajectory-v1 frame bytes, drained by ``stream`` requests
+    frames: deque = dataclasses.field(default_factory=deque)
+    frames_total: int = 0
+    frames_streamed: int = 0
+    #: final-state snapshot (one frame), captured at retire/evict
+    final_frame: Optional[bytes] = None
+    #: serialized RNG streams (SimRNG.dump_state) stamped into every frame/
+    #: snapshot, so serve trajectories resume with RNG continuity like a
+    #: CLI-written one (free-space tenants never advance the streams, so
+    #: the admission-time dump stays current)
+    rng_state: Optional[object] = None
+
+    def snapshot_pending(self) -> int:
+        return len(self.frames)
+
+
+class TenantRegistry:
+    """Id -> Tenant map with server-assigned ids and per-connection index."""
+
+    def __init__(self):
+        self._tenants: dict[str, Tenant] = {}
+        self._next = 0
+
+    def new_id(self) -> str:
+        # skip ids a client already claimed explicitly — the server must
+        # never invent a collision and reject its own assignment
+        while True:
+            tid = f"t{self._next:04d}"
+            self._next += 1
+            if tid not in self._tenants:
+                return tid
+
+    def add(self, tenant: Tenant):
+        if tenant.tenant_id in self._tenants:
+            raise ValueError(f"tenant id {tenant.tenant_id!r} already exists")
+        self._tenants[tenant.tenant_id] = tenant
+
+    def get(self, tenant_id: str) -> Optional[Tenant]:
+        return self._tenants.get(tenant_id)
+
+    def of_conn(self, conn) -> list[Tenant]:
+        """Tenants owned by one connection (the disconnect-eviction set)."""
+        return [t for t in self._tenants.values() if t.conn is conn]
+
+    def __len__(self):
+        return len(self._tenants)
+
+    def values(self):
+        return self._tenants.values()
+
+
+# ------------------------------------------------------------- admission
+
+#: the one-compiled-program contract (shared with the ensemble sweep CLI —
+#: ONE definition in `config.schema`)
+normalized_params = schema.normalized_member_params
+
+
+def parse_tenant_config(config_text: str):
+    """Submitted TOML text -> validated `schema.Config`.
+
+    Serve tenants are free-space scenes (fibers + background + point
+    sources): periphery/bodies need server-side precompute npz files a wire
+    submission cannot carry, so they are rejected up front with a message
+    instead of failing deep in the builder."""
+    try:
+        data = toml_loads(config_text)
+    except Exception as e:
+        raise ValueError(f"config TOML parse error: {e}") from None
+    cfg = schema.config_from_data(data)
+    if getattr(cfg, "periphery", None) is not None:
+        raise ValueError(
+            "serve tenants cannot use a periphery: its precompute npz lives "
+            "server-side; run periphery scenes through the batch CLIs")
+    if cfg.bodies:
+        raise ValueError(
+            "serve tenants cannot use bodies: their precompute npz lives "
+            "server-side; run body scenes through the batch CLIs")
+    if not cfg.fibers:
+        raise ValueError("tenant config has no fibers")
+    problems = cfg.validate()
+    if problems:
+        raise ValueError("invalid tenant config:\n  " + "\n  ".join(problems))
+    return cfg
+
+
+def check_params_contract(tenant_params: schema.Params,
+                          server_params: schema.Params) -> Optional[str]:
+    """None when the tenant can share the server's compiled program, else
+    the rejection text naming every differing param."""
+    tn, sn = normalized_params(tenant_params), normalized_params(server_params)
+    if tn == sn:
+        return None
+    diffs = [f.name for f in dataclasses.fields(schema.Params)
+             if getattr(tn, f.name) != getattr(sn, f.name)]
+    return ("tenant params differ from the server's compiled program in "
+            f"{diffs}; only params.seed/params.t_final may vary per tenant "
+            "(one-compiled-program contract)")
+
+
+def pad_state_to_capacity(state, capacity: int):
+    """State with its fiber batch grown to ``capacity`` slots (inert masked
+    padding); a no-op at or above capacity. Mixed-resolution (tuple) fiber
+    containers pass through — they must match a bucket template exactly."""
+    from ..fibers import container as fc
+
+    if state.fibers is None or not isinstance(state.fibers, fc.FiberGroup):
+        return state
+    if state.fibers.n_fibers >= capacity:
+        return state
+    return state._replace(fibers=fc.grow_capacity(state.fibers, capacity))
+
+
+def bucket_mismatch(template_state, state) -> Optional[str]:
+    """None when ``state``'s leaves match the bucket template's static
+    shapes/dtypes (admissible), else the mismatch text. Wraps the ensemble
+    runner's member check — the SAME predicate that guards `set_lane`, so
+    admission can never admit a state the scheduler would later reject."""
+    import jax
+
+    from ..ensemble.runner import _check_member
+
+    try:
+        _check_member(0, jax.tree_util.tree_leaves(template_state), state)
+    except ValueError as e:
+        return str(e)
+    return None
+
+
+def state_snapshot(state, rng_state=None) -> bytes:
+    """One trajectory-v1 frame of ``state`` — the tenant snapshot format."""
+    from ..io.trajectory import frame_bytes
+
+    return frame_bytes(state, rng_state=rng_state)
+
+
+def state_from_snapshot(frame_buf: bytes, template_state):
+    """Snapshot frame bytes -> (SimState, rng_state) over a bucket template
+    (the resume half; the wire twin of `io.trajectory.resume_state`, which
+    also hands back the frame's serialized RNG streams)."""
+    from ..io.trajectory import frame_to_state
+
+    frame = protocol.unpack_message(frame_buf)
+    if not isinstance(frame, dict) or "time" not in frame:
+        raise ValueError("resume_frame is not a trajectory-v1 frame")
+    return frame_to_state(frame, template_state), frame.get("rng_state")

@@ -80,3 +80,36 @@ def force_cpu_devices(n_devices: int | None = None) -> None:
                 "force_cpu_devices must run before JAX backend init, or set "
                 f"XLA_FLAGS={_COUNT_FLAG}={n_devices} JAX_PLATFORMS=cpu in "
                 "the environment.")
+
+
+def enable_compilation_cache(cache_dir: str | None) -> str | None:
+    """Point JAX's persistent XLA compilation cache at ``cache_dir``.
+
+    The one implementation behind every CLI's ``--jax-cache DIR`` flag
+    (run, ensemble, serve) — the same ``.jax_cache`` pattern `bench.py` and
+    the obs cost gate use internally: compiled executables persist across
+    processes, so a cold server start (or CI re-run) whose programs were
+    compiled before skips the multi-minute XLA compiles and goes straight
+    to warm admission. Returns the absolute cache path, or None when
+    ``cache_dir`` is falsy (cache off — the default).
+
+    Min-compile-time threshold of 1 s keeps trivial programs out of the
+    cache (matching bench.py); failures are non-fatal like bench's — an
+    unwritable cache dir must not kill a run that would merely recompile.
+    """
+    if not cache_dir:
+        return None
+    import jax
+
+    path = os.path.abspath(cache_dir)
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        import logging
+
+        logging.getLogger("skellysim_tpu").warning(
+            "--jax-cache %s not enabled: %s", path, e)
+        return None
+    return path

@@ -1,0 +1,476 @@
+"""skelly-serve: persistent multi-tenant service over warm ensemble lanes.
+
+Pins the ISSUE-7 acceptance criteria and the serve subsystem's contracts:
+
+* wire protocol round-trips for EVERY request type + incremental framing
+  (one source of truth shared with `listener.py`);
+* THE acceptance pin: two concurrent tenants with different configs in the
+  same capacity bucket produce trajectories BITWISE matching their
+  sequential `System.run` outputs, with zero ``compile`` events after
+  warmup (`observed_jit` events through the server's StatsTracer — the
+  `test_retrace.py` discipline at the service level);
+* admission control: params-contract and capacity-bucket rejections, queue
+  depth shedding, queued -> backfill promotion;
+* mid-service snapshot/resume: evict a tenant, re-admit from its snapshot,
+  combined trajectory bitwise-matches an uninterrupted run;
+* `queue_wait_s` admission latency on lane events + `obs summarize`
+  reporting it;
+* the scheduler's incremental `admit`/`poll`/`evict` API on an
+  initially-empty (template-constructed) service.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from skellysim_tpu.builder import build_simulation
+from skellysim_tpu.config import BackgroundSource, Config, Fiber, schema
+from skellysim_tpu.config.toml_io import dumps as toml_dumps
+from skellysim_tpu.io.trajectory import frame_bytes
+from skellysim_tpu.serve import protocol
+from skellysim_tpu.serve.server import SimulationServer
+
+
+def _tenant_cfg(shift=0.0, n_nodes=8, n_fibers=1, **param_overrides) -> Config:
+    """Tiny free-fiber scene (fast-tier sized, like test_ensemble's)."""
+    cfg = Config()
+    cfg.params.eta = 1.0
+    cfg.params.dt_initial = 0.005
+    cfg.params.dt_write = 0.005
+    cfg.params.t_final = 0.02
+    cfg.params.gmres_tol = 1e-10
+    cfg.params.adaptive_timestep_flag = False
+    for k, v in param_overrides.items():
+        setattr(cfg.params, k, v)
+    fibers = []
+    for i in range(n_fibers):
+        fib = Fiber(n_nodes=n_nodes, length=1.0, bending_rigidity=0.01)
+        fib.fill_node_positions(np.array([shift + 0.4 * i, 0.0, 0.0]),
+                                np.array([0.0, 0.0, 1.0]))
+        fibers.append(fib)
+    cfg.fibers = fibers
+    cfg.background = BackgroundSource(uniform=[1.0, 0.0, 0.0])
+    return cfg
+
+
+def _toml(cfg: Config) -> str:
+    return toml_dumps(schema.unpack(cfg))
+
+
+def _sequential_frames(cfg: Config) -> list:
+    """Reference trajectory: initial frame + System.run boundary frames,
+    with the rng_state stamp a CLI-written trajectory carries (serve frames
+    carry it too, for resume continuity through `--resume`)."""
+    system, state, rng = build_simulation(cfg)
+    rs = rng.dump_state() if rng is not None else None
+    frames = [frame_bytes(state, rng_state=rs)]
+    system.run(state, writer=lambda st, sol, **kw: frames.append(
+        frame_bytes(st, rng_state=rs)))
+    return frames
+
+
+@pytest.fixture(scope="module")
+def server():
+    """One warm 2-lane unroll server shared module-wide (tenant records
+    accumulate; each test uses fresh tenant ids)."""
+    return SimulationServer(
+        _tenant_cfg(), serve_cfg=schema.ServeConfig(max_lanes=2,
+                                                    batch_impl="unroll"))
+
+
+def _submit(server, cfg, **fields):
+    resp = server.handle_request({"type": "submit", "config": _toml(cfg),
+                                  **fields})
+    assert resp["ok"], resp.get("error")
+    return resp
+
+
+def _drain(server, max_rounds=200):
+    n = 0
+    while server.any_live() and n < max_rounds:
+        server.tick()
+        n += 1
+    assert not server.any_live(), "service did not drain"
+
+
+def _stream(server, tenant) -> list:
+    resp = server.handle_request({"type": "stream", "tenant": tenant})
+    assert resp["ok"]
+    return [bytes(f) for f in resp["frames"]]
+
+
+# ------------------------------------------------------------ wire protocol
+
+def test_protocol_roundtrip_every_request_type():
+    """Every request type survives make_request -> frame -> decode, through
+    the same framing `listener.py` serves over."""
+    samples = {
+        "submit": dict(config="[params]\n", tenant="t1", t_final=0.5,
+                       resume_frame=b"\x81\xa1x\x01"),
+        "status": dict(tenant="t1"),
+        "stream": dict(tenant="t1", max_frames=3),
+        "snapshot": dict(tenant="t1"),
+        "cancel": dict(tenant="t1"),
+        "stats": {},
+        "shutdown": {},
+    }
+    assert set(samples) == set(protocol.REQUEST_FIELDS)
+    for rtype, fields in samples.items():
+        req = protocol.make_request(rtype, **fields)
+        buf = io.BytesIO()
+        protocol.write_message(buf, req)
+        buf.seek(0)
+        back = protocol.read_message(buf)
+        assert back == req, rtype
+        assert protocol.validate_request(back) is None
+
+
+def test_protocol_framing_edges():
+    # zero-length control frame round-trips distinctly from EOF
+    buf = io.BytesIO()
+    protocol.write_empty(buf)
+    buf.seek(0)
+    assert protocol.read_frame(buf) == b""
+    assert protocol.read_frame(buf) is None  # EOF
+    # truncated payload = disconnect, not an exception
+    buf = io.BytesIO(protocol.HEADER.pack(10) + b"abc")
+    assert protocol.read_frame(buf) is None
+
+
+def test_frame_decoder_incremental():
+    """Byte-at-a-time feeding reassembles exactly the sent frames (the
+    non-blocking socket path)."""
+    msgs = [{"type": "stats"}, {"type": "status", "tenant": "t9"}]
+    wire = b"".join(
+        protocol.HEADER.pack(len(p)) + p
+        for p in [protocol.pack_message(m) for m in msgs]) \
+        + protocol.HEADER.pack(0)
+    dec = protocol.FrameDecoder()
+    out = []
+    for i in range(len(wire)):
+        out.extend(dec.feed(wire[i:i + 1]))
+    assert [protocol.unpack_message(p) for p in out[:2]] == msgs
+    assert out[2] == b""
+
+
+def test_validate_request_rejections():
+    assert "unknown request type" in protocol.validate_request({"type": "x"})
+    assert "missing required" in protocol.validate_request({"type": "status"})
+    assert "unknown field" in protocol.validate_request(
+        {"type": "stats", "bogus": 1})
+    assert "msgpack map" in protocol.validate_request([1, 2])
+
+
+def test_serve_config_loading(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text("[serve]\nmax_lanes = 3\nbucket_capacities = [2, 4]\n"
+                 "queue_depth = 5\n")
+    sc = schema.load_serve_config(str(p))
+    assert (sc.max_lanes, sc.bucket_capacities, sc.queue_depth) == (3, [2, 4], 5)
+    p.write_text("[serve]\nmax_lens = 3\n")
+    with pytest.raises(ValueError, match="unknown \\[serve\\] keys"):
+        schema.load_serve_config(str(p))
+    p.write_text("[serve]\nbatch_impl = 'nope'\n")
+    with pytest.raises(ValueError, match="batch_impl"):
+        schema.load_serve_config(str(p))
+
+
+# --------------------------------------------------- the acceptance criteria
+
+def test_two_tenants_bitwise_parity_zero_compiles_after_warm(server):
+    """THE acceptance pin: two concurrent tenants with different configs in
+    the same capacity bucket; per-tenant frame streams BITWISE identical to
+    their sequential System.run trajectories; zero compile events after
+    warmup (observed_jit events through the server tracer)."""
+    assert server.metrics.warm and server.metrics.compiles >= 1
+    compiles_at_warm = server.metrics.compiles
+
+    shifts = (0.1, 0.3)
+    resp = [_submit(server, _tenant_cfg(s)) for s in shifts]
+    assert [r["lane"] for r in resp] == [0, 1]  # concurrent, same bucket
+    assert len({r["bucket"] for r in resp}) == 1
+    _drain(server)
+
+    for r, s in zip(resp, shifts):
+        got = _stream(server, r["tenant"])
+        assert len(got) >= 3
+        assert got == _sequential_frames(_tenant_cfg(s))
+        st = server.handle_request({"type": "status", "tenant": r["tenant"]})
+        assert st["status"] == "finished" and st["t"] <= st["t_final"]
+
+    assert server.metrics.compiles == compiles_at_warm
+    assert server.metrics.stats()["compiles_after_warm"] == 0
+
+
+def test_snapshot_evict_resume_matches_uninterrupted(server):
+    """Satellite pin: evict a tenant mid-service, re-admit from its
+    snapshot — pre-eviction + post-resume frames bitwise-match an
+    uninterrupted run's."""
+    cfg = _tenant_cfg(0.7)
+    r = _submit(server, cfg)
+    server.tick()
+    server.tick()
+    snap = server.handle_request({"type": "snapshot", "tenant": r["tenant"]})
+    assert snap["ok"] and snap["status"] == "running"
+    # graceful eviction (the disconnect path drives the same _release)
+    server.handle_request({"type": "cancel", "tenant": r["tenant"]})
+    pre = _stream(server, r["tenant"])
+    st = server.handle_request({"type": "status", "tenant": r["tenant"]})
+    assert st["status"] == "cancelled"
+
+    r2 = server.handle_request({
+        "type": "submit", "config": _toml(cfg),
+        "resume_frame": bytes(snap["frame"])})
+    assert r2["ok"], r2.get("error")
+    _drain(server)
+    post = _stream(server, r2["tenant"])
+    assert pre + post == _sequential_frames(cfg)
+    assert server.metrics.stats()["compiles_after_warm"] == 0
+
+
+def test_disconnect_evicts_and_snapshot_survives(server):
+    """A client disconnect gracefully evicts its tenants: lane freed, final
+    snapshot retained for a later resume."""
+    conn = object()
+    r = _submit(server, _tenant_cfg(0.9))
+    # hand ownership to a fake connection, then drop it
+    server.registry.get(r["tenant"]).conn = conn
+    server.tick()
+    server.evict_conn(conn)
+    st = server.handle_request({"type": "status", "tenant": r["tenant"]})
+    assert st["status"] == "evicted" and st["lane"] is None
+    snap = server.handle_request({"type": "snapshot", "tenant": r["tenant"]})
+    assert snap["ok"] and snap["t"] > 0.0
+    assert not server.any_live()
+
+
+# ----------------------------------------------------------- admission rules
+
+def test_params_contract_rejection(server):
+    resp = server.handle_request({
+        "type": "submit", "config": _toml(_tenant_cfg(gmres_tol=1e-6))})
+    assert not resp["ok"] and "gmres_tol" in resp["error"]
+    resp = server.handle_request({
+        "type": "submit",
+        "config": _toml(_tenant_cfg(0.1, t_final=0.01, seed=7)),
+        "t_final": 0.01})
+    # seed/t_final are the per-tenant exceptions — this one must admit
+    assert resp["ok"], resp.get("error")
+    _drain(server)
+
+
+def test_bucket_mismatch_rejection(server):
+    resp = server.handle_request({
+        "type": "submit", "config": _toml(_tenant_cfg(n_nodes=16))})
+    assert not resp["ok"] and "bucket" in resp["error"]
+    resp = server.handle_request({
+        "type": "submit", "config": _toml(_tenant_cfg(n_fibers=3))})
+    assert not resp["ok"]
+    assert server.metrics.rejected >= 2
+
+
+def test_tenant_config_validation(server):
+    for bad, needle in [
+        ("not toml [", "parse error"),
+        ("[params]\nt_final = 0.02\n", "no fibers"),
+    ]:
+        resp = server.handle_request({"type": "submit", "config": bad})
+        assert not resp["ok"] and needle in resp["error"]
+
+
+def test_queue_depth_sheds_and_backfills(server):
+    """Admission control: lanes full -> queue; queue full -> structured
+    rejection with retry=True; drained lanes backfill from the queue."""
+    rs = [_submit(server, _tenant_cfg(0.05 * i)) for i in range(3)]
+    assert rs[2]["queued"] and rs[2]["lane"] is None
+    st = server.handle_request({"type": "status", "tenant": rs[2]["tenant"]})
+    assert st["status"] == "queued"
+
+    depth = server.serve_cfg.queue_depth
+    server.serve_cfg.queue_depth = 1  # one slot, already taken by rs[2]
+    try:
+        resp = server.handle_request({
+            "type": "submit", "config": _toml(_tenant_cfg(0.9))})
+        assert not resp["ok"] and resp.get("retry") is True
+    finally:
+        server.serve_cfg.queue_depth = depth
+
+    _drain(server)
+    for r in rs:
+        st = server.handle_request({"type": "status", "tenant": r["tenant"]})
+        assert st["status"] == "finished"
+        assert len(_stream(server, r["tenant"])) >= 3
+
+
+def test_cancel_queued_tenant(server):
+    rs = [_submit(server, _tenant_cfg(0.05 * i)) for i in range(3)]
+    assert rs[2]["queued"]
+    resp = server.handle_request({"type": "cancel",
+                                  "tenant": rs[2]["tenant"]})
+    assert resp["ok"] and resp["status"] == "cancelled"
+    # releasing a QUEUED tenant keeps its spec state as the snapshot — a
+    # resumed submit buffers no initial frame, so dropping the spec
+    # without this would lose the tenant's resume point entirely
+    snap = server.handle_request({"type": "snapshot",
+                                  "tenant": rs[2]["tenant"]})
+    assert snap["ok"] and snap["t"] == 0.0
+    _drain(server)
+    done = [server.handle_request({"type": "status", "tenant": r["tenant"]})
+            ["status"] for r in rs]
+    assert done == ["finished", "finished", "cancelled"]
+
+
+def test_explicit_zero_t_final(server):
+    """A requested t_final of 0.0 is honored (no falsy substitution of the
+    config's): the tenant admits and retires without stepping."""
+    r = _submit(server, _tenant_cfg(0.4), t_final=0.0)
+    _drain(server)
+    st = server.handle_request({"type": "status", "tenant": r["tenant"]})
+    assert st["status"] == "finished" and st["steps"] == 0
+
+
+def test_stats_shape_and_stream_accounting(server):
+    stats = server.handle_request({"type": "stats"})["stats"]
+    for key in ("admitted", "rejected", "retired", "retire_reasons",
+                "rounds", "steps", "steps_per_s", "mean_occupancy",
+                "admission_wait_s", "compiles", "compiles_after_warm",
+                "warm", "frames_streamed", "frames_streamed_total",
+                "tenants", "buckets"):
+        assert key in stats, key
+    assert stats["warm"] is True
+    assert stats["buckets"][0]["lanes"] == 2
+    assert stats["frames_streamed_total"] >= 3
+    assert stats["admission_wait_s"]["n"] == stats["admitted"]
+
+
+def test_unknown_tenant_and_malformed_requests(server):
+    assert "unknown tenant" in server.handle_request(
+        {"type": "status", "tenant": "nope"})["error"]
+    assert "unknown request type" in server.handle_request(
+        {"type": "gibberish"})["error"]
+
+
+# ------------------------------------------------- queue_wait_s + summarize
+
+def test_queue_wait_on_lane_events_and_summarize(server):
+    """Lane admit/backfill events carry queue_wait_s (admission latency);
+    `obs summarize` folds them into the lane table."""
+    lane_events = [e for e in server.tracer.events if e["ev"] == "lane"
+                   and e["action"] in ("admit", "backfill")]
+    assert lane_events, "no lane admissions recorded"
+    assert all("queue_wait_s" in e and e["queue_wait_s"] >= 0.0
+               for e in lane_events)
+    # a queued tenant (lanes were busy) must show a strictly positive wait
+    assert any(e["queue_wait_s"] > 0.0 for e in lane_events
+               if e["action"] == "backfill")
+
+    from skellysim_tpu.obs.summarize import Summary
+
+    s = Summary()
+    for e in server.tracer.events:
+        s.add_line(json.dumps(e))
+    report = s.render()
+    assert "admission wait:" in report
+    assert "ensemble lanes" in report
+
+
+# -------------------------------------------- scheduler incremental service
+
+def test_scheduler_template_admit_poll_evict():
+    """The incremental API directly: an initially-EMPTY scheduler built
+    from a template, members admitted/evicted between polls, one trace."""
+    from skellysim_tpu.ensemble import EnsembleRunner, EnsembleScheduler
+    from skellysim_tpu.ensemble.scheduler import MemberSpec
+    from skellysim_tpu.testing import trace_counting_jit
+
+    system, state, _ = build_simulation(_tenant_cfg())
+    runner = EnsembleRunner(system)
+    step = trace_counting_jit(runner.step_impl)
+    sched = EnsembleScheduler(runner, [], 2, template=state, step_fn=step)
+    assert sched.poll() == [] and sched.rounds == 0  # idle no-op
+
+    lane = sched.admit(MemberSpec(member_id="a", state=state, t_final=0.02))
+    assert lane == 0 and sched.live == 1
+    sched.poll()
+    assert sched.admit(MemberSpec(member_id="b", state=_tenant_state(0.2),
+                                  t_final=0.02)) == 1
+    mid = sched.evict(0, reason="evicted")
+    assert float(mid.time) > 0.0 and sched.lane_of("a") is None
+    # evicted lane state resumes exactly: re-admit and drain both
+    assert sched.admit(MemberSpec(member_id="a2", state=mid,
+                                  t_final=0.02)) == 0
+    sched.run()
+    assert set(sched.retired) == {"a", "b", "a2"}
+    assert step.trace_count == 1, "incremental service retraced"
+
+
+def _tenant_state(shift):
+    _, state, _ = build_simulation(_tenant_cfg(shift))
+    return state
+
+
+# --------------------------------------------------------- padded admission
+
+@pytest.mark.slow  # second compiled bucket program (own capacity)
+def test_padded_bucket_admission_parity():
+    """A 1-fiber tenant admits into a capacity-2 bucket (inert masked
+    padding); its streamed trajectory matches the unpadded sequential run
+    to roundoff, and frames carry only the ACTIVE fibers."""
+    srv = SimulationServer(
+        _tenant_cfg(), serve_cfg=schema.ServeConfig(
+            max_lanes=2, bucket_capacities=[2], batch_impl="unroll"))
+    cfg = _tenant_cfg(0.2)
+    r = _submit(srv, cfg)
+    assert r["bucket"] == 2
+    _drain(srv)
+    got = _stream(srv, r["tenant"])
+    seq = _sequential_frames(cfg)
+    assert len(got) == len(seq)
+    for gb, sb in zip(got, seq):
+        g = protocol.unpack_message(gb)
+        s = protocol.unpack_message(sb)
+        assert len(g["fibers"][1]) == 1  # active fibers only on the wire
+        np.testing.assert_allclose(
+            np.asarray(g["fibers"][1][0]["x_"]),
+            np.asarray(s["fibers"][1][0]["x_"]), rtol=0, atol=1e-10)
+    assert srv.metrics.stats()["compiles_after_warm"] == 0
+
+
+# ------------------------------------------------------------ socket + CLI
+
+@pytest.mark.slow  # subprocess server boot (compile) + TCP round-trips
+def test_socket_end_to_end(tmp_path):
+    """The CI smoke's contract, in-tree: spawn `python -m
+    skellysim_tpu.serve`, admit two tenants over TCP, stream >= 2 frames
+    each, clean shutdown with exit code 0."""
+    import os
+    import subprocess
+    import sys
+
+    from skellysim_tpu.serve.client import SpawnedServer
+
+    cfg_path = str(tmp_path / "serve_config.toml")
+    base = _tenant_cfg()
+    base.save(cfg_path)
+    with open(cfg_path, "a") as fh:
+        fh.write("\n[serve]\nmax_lanes = 2\nbatch_impl = \"unroll\"\n")
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo  # skip the session's .axon_site sitecustomize
+    with SpawnedServer(cfg_path, env=env) as srv:
+        with srv.client() as c:
+            tids = [c.submit(_toml(_tenant_cfg(s)))["tenant"]
+                    for s in (0.1, 0.3)]
+            for tid in tids:
+                st = c.wait(tid, timeout=120)
+                assert st["status"] == "finished"
+                frames = c.stream(tid)["frames"]
+                assert len(frames) >= 2
+            stats = c.stats()
+            assert stats["compiles_after_warm"] == 0
+        rc = srv.stop()
+    assert rc == 0
