@@ -1046,6 +1046,12 @@ def _group_ensemble(extra, ck, on_acc):
     ck()
 
 
+#: current multichip measurement round; bumping this IS the re-measurement
+#: protocol — the new round lands at the repo root, every round (old and
+#: new) is archived under benchmarks/, stale root rounds are pruned
+#: (artifact hygiene, ISSUE 8: r01..r05 no longer accumulate at the root)
+MULTICHIP_ROUND = "r07"
+
 #: repo-root artifact the multichip group writes (ISSUE 3: the measured
 #: strong-scaling ladder replacing the projected 8-chip numbers).
 #: BENCH_MULTICHIP_PATH redirects it (the bench contract test points it at
@@ -1053,7 +1059,31 @@ def _group_ensemble(extra, ck, on_acc):
 MULTICHIP_JSON_PATH = os.environ.get(
     "BENCH_MULTICHIP_PATH",
     os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                 "MULTICHIP_r06.json"))
+                 f"MULTICHIP_{MULTICHIP_ROUND}.json"))
+
+
+def _archive_multichip_round(doc: dict):
+    """Mirror the round under benchmarks/ and prune stale root rounds so
+    only the LATEST round lives at the repo root (docs/performance.md
+    cites `benchmarks/MULTICHIP_r*.json` for history). Redirected runs
+    (BENCH_MULTICHIP_PATH set — the contract smoke) archive nothing."""
+    if os.environ.get("BENCH_MULTICHIP_PATH"):
+        return
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    current = f"MULTICHIP_{MULTICHIP_ROUND}.json"
+    try:
+        arch = os.path.join(here, "benchmarks")
+        os.makedirs(arch, exist_ok=True)
+        with open(os.path.join(arch, current), "w") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+        for p in glob.glob(os.path.join(here, "MULTICHIP_r*.json")):
+            if os.path.basename(p) != current:
+                os.remove(p)
+    except Exception:
+        pass  # hygiene must never cost a measurement
 
 
 def _bench_multichip_matvec(n_dev, r, f, mesh_cache):
@@ -1105,7 +1135,8 @@ def _group_multichip(extra, ck, on_acc):
     """ISSUE 3: the measured strong-scaling ladder (1 -> 2 -> 4 -> 8
     devices) for the dense matvec AND the full coupled SPMD solve, with
     residual/solution parity against the 1-device run. Emits
-    MULTICHIP_r06.json at the repo root (downscale-flagged on the virtual
+    MULTICHIP_<round>.json at the repo root + benchmarks/ archive
+    (downscale-flagged on the virtual
     CPU mesh like every other section)."""
     import jax
     import jax.numpy as jnp
@@ -1121,6 +1152,7 @@ def _group_multichip(extra, ck, on_acc):
     def publish():
         doc = dict(out)
         doc["generated_by"] = "bench.py --group multichip"
+        doc["round"] = MULTICHIP_ROUND
         doc["backend"] = extra.get("backend")
         doc["telemetry_version"] = TELEMETRY_VERSION
         try:
@@ -1128,6 +1160,7 @@ def _group_multichip(extra, ck, on_acc):
                 json.dump(doc, fh, indent=1)
                 fh.write("\n")
             out.pop("artifact_error", None)
+            _archive_multichip_round(doc)
         except Exception as e:
             # never crash the measurement over an unwritable artifact path,
             # but never hide it either — the marker rides into BENCH.json
@@ -1166,19 +1199,29 @@ def _group_multichip(extra, ck, on_acc):
         ck()
         publish()
 
-    # --- full coupled SPMD solve ladder (fibers + shell + forced body)
+    # --- full coupled SPMD solve ladder (fibers + shell + forced body).
+    # r07 (ISSUE 8): the ladder runs the communication-avoiding solver
+    # (gmres_block_s=4 — 2 batched Gram psums per 4 Krylov iterations
+    # instead of 12 sequential rounds) at a scene where compute/comm
+    # balance is honest: the r06 CPU downscale (16x16) was so small that
+    # per-round dispatch noise swamped the solve; 32 fibers x 32 nodes
+    # keeps the CPU rung compile-affordable while the matvec does real work
+    n_fib = 256 if on_acc else 32
+    n_nod = 32
+
     def scene():
         import dataclasses
 
         from __graft_entry__ import _make_system
 
         system, state = _make_system(
-            n_fibers=256 if on_acc else 16, n_nodes=32 if on_acc else 16,
-            dtype=jnp.float64, coupled=True)
-        system.params = dataclasses.replace(system.params, gmres_tol=1e-10)
+            n_fibers=n_fib, n_nodes=n_nod, dtype=jnp.float64, coupled=True)
+        system.params = dataclasses.replace(system.params, gmres_tol=1e-10,
+                                            gmres_block_s=4)
         return system, state
 
-    cp = {"n_fibers": 256 if on_acc else 16, "shell_n": 56, "body_n": 50}
+    cp = {"n_fibers": n_fib, "n_nodes": n_nod, "shell_n": 56, "body_n": 50,
+          "gmres_block_s": 4}
     out["coupled_spmd"] = cp  # attached up front so skip markers survive
     sol_1dev = None
     for d in ladder:
@@ -1203,6 +1246,148 @@ def _group_multichip(extra, ck, on_acc):
     publish()  # always leave an artifact, even if every rung was skipped
 
 
+def _group_collectives(extra, ck, on_acc):
+    """ISSUE 8: the collective-latency budget of the coupled solve —
+    the measurements behind the s-step solver and the fused rings.
+
+    (a) psum round latency vs payload on the full mesh: per-iteration
+        GMRES dots are LATENCY-bound (a [101] f32 psum moves 404 bytes;
+        its wall is all launch+sync), which is why batching rounds wins;
+    (b) the s-step exchange itself: s sequential masked-dot psums
+        ([m+1] each) vs ONE batched [(m+1)+s, s] Gram psum — the exact
+        orthogonalization traffic `solver.gmres(block_s=s)` replaces;
+    (c) ring-vs-fused matvec: the ppermute source-block ring against the
+        fused Pallas `make_async_remote_copy` kernel
+        (`parallel.ring_fused`; TPU-only — the CPU fallback records the
+        build-time mode so the artifact says WHICH path it measured).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from skellysim_tpu.parallel import make_mesh
+    from skellysim_tpu.parallel.compat import fused_ring_mode, shard_map
+    from skellysim_tpu.parallel.mesh import FIBER_AXIS
+
+    n_dev = min(8, len(jax.devices()))
+    out = {"devices": n_dev}
+    if not on_acc:
+        _mark_downscaled(out, _CPU_FALLBACK)
+    extra["collectives"] = out
+    ck()
+    if n_dev < 2:
+        out["error"] = "needs a multi-device mesh"
+        ck()
+        return
+    mesh = make_mesh(n_dev)
+    reps = 32
+
+    def _wall(fn, *args, trials=3):
+        np.asarray(fn(*args))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            r = fn(*args)
+        np.asarray(r)
+        return (time.perf_counter() - t0) / trials
+
+    # --- (a) chained psum rounds vs payload size
+    rounds = {}
+    out["psum_rounds"] = rounds
+    for elems in (128, 2048, 32768, 262144):
+        if _remaining() < 30:
+            rounds[f"e{elems}"] = {"skipped_budget": int(_remaining())}
+            ck()
+            continue
+
+        def local(x):
+            def body(_, y):
+                return lax.psum(y, FIBER_AXIS) * (1.0 / n_dev)
+            return lax.fori_loop(0, reps, body, x)
+
+        fn = jax.jit(shard_map(local, mesh=mesh, in_specs=(P(FIBER_AXIS),),
+                               out_specs=P(FIBER_AXIS), check_vma=False))
+        x = jnp.ones((elems,), dtype=jnp.float32)
+        w = _wall(fn, x)
+        rounds[f"e{elems}"] = {"us_per_round": round(w / reps * 1e6, 2),
+                               "bytes": 4 * elems}
+        ck()
+
+    # --- (b) sequential dot psums vs one batched Gram psum (m=100, s=4)
+    m, s, n = 100, 4, 8192
+    if _remaining() > 30:
+        rng = np.random.default_rng(7)
+        V = jnp.asarray(rng.standard_normal((m + 1, n)), dtype=jnp.float32)
+        W = jnp.asarray(rng.standard_normal((n, s)), dtype=jnp.float32)
+
+        def seq(Vl, Wl):
+            def body(_, carry):
+                h = jnp.stack([lax.psum(Vl @ Wl[:, j], FIBER_AXIS)
+                               for j in range(s)])   # s SEPARATE rounds
+                return carry + h[0, 0] * 1e-30
+            return lax.fori_loop(0, reps, body, jnp.float32(0.0))
+
+        def batched(Vl, Wl):
+            def body(_, carry):
+                G = lax.psum(Vl @ Wl, FIBER_AXIS)    # ONE [m+1, s] round
+                return carry + G[0, 0] * 1e-30
+            return lax.fori_loop(0, reps, body, jnp.float32(0.0))
+
+        spec = (P(None, FIBER_AXIS), P(FIBER_AXIS, None))
+        w_seq = _wall(jax.jit(shard_map(seq, mesh=mesh, in_specs=spec,
+                                        out_specs=P(), check_vma=False)),
+                      V, W)
+        w_bat = _wall(jax.jit(shard_map(batched, mesh=mesh, in_specs=spec,
+                                        out_specs=P(), check_vma=False)),
+                      V, W)
+        out["gram_exchange"] = {
+            "m": m, "s": s, "n": n,
+            "sequential_us": round(w_seq / reps * 1e6, 2),
+            "batched_us": round(w_bat / reps * 1e6, 2),
+            "speedup": round(w_seq / w_bat, 2) if w_bat else None}
+    else:
+        out["gram_exchange"] = {"skipped_budget": int(_remaining())}
+    ck()
+
+    # --- (c) ring matvec: ppermute vs fused Pallas ring
+    if _remaining() > 45:
+        from skellysim_tpu.parallel.ring import ring_stokeslet
+
+        n_pts = 4096 if on_acc else 1024
+        rng = np.random.default_rng(11)
+        r = jnp.asarray(rng.uniform(-2, 2, (n_pts, 3)), dtype=jnp.float32)
+        f = jnp.asarray(rng.standard_normal((n_pts, 3)), dtype=jnp.float32)
+        impl = "pallas" if on_acc else "exact"
+        mode = fused_ring_mode("pallas")
+        rv = {"n": n_pts, "impl": impl, "fused_ring_mode": mode}
+        out["ring_matvec"] = rv
+        try:
+            os.environ["SKELLY_FUSED_RING"] = "0"
+            jax.clear_caches()   # mode is a build-time choice, not a jit key
+            w_ring = _wall(lambda: ring_stokeslet(r, r, f, 1.0, mesh=mesh,
+                                                  impl=impl))
+            rv["ppermute"] = {"wall_s": round(w_ring, 5),
+                              "gpairs_per_s": round(
+                                  n_pts * n_pts / w_ring / 1e9, 3)}
+            if mode == "fused":
+                os.environ.pop("SKELLY_FUSED_RING", None)
+                jax.clear_caches()
+                w_fused = _wall(lambda: ring_stokeslet(
+                    r, r, f, 1.0, mesh=mesh, impl="pallas"))
+                rv["fused"] = {"wall_s": round(w_fused, 5),
+                               "gpairs_per_s": round(
+                                   n_pts * n_pts / w_fused / 1e9, 3),
+                               "speedup_vs_ppermute": round(
+                                   w_ring / w_fused, 2) if w_fused else None}
+        except Exception as e:
+            rv["error"] = _short_err(e)
+        finally:
+            os.environ.pop("SKELLY_FUSED_RING", None)
+    else:
+        out["ring_matvec"] = {"skipped_budget": int(_remaining())}
+    ck()
+
+
 #: repo-root artifact the treecode group writes (ISSUE 6: the measured
 #: O(N^2) -> O(N log N) crossover for the treecode pair evaluator).
 #: BENCH_TREECODE_PATH redirects it (the bench contract test points it at
@@ -1221,7 +1406,7 @@ def _group_treecode(extra, ck, on_acc):
     EQUIVALENT dense pairs/sec (N^2 / wall), so tree_vs_direct > 1 means
     the treecode beats the O(N^2) tile outright; the smallest such N is
     the measured crossover, recorded in TREECODE_r06.json
-    (downscale-flagged on CPU like MULTICHIP_r06)."""
+    (downscale-flagged on CPU like the MULTICHIP rounds)."""
     import jax.numpy as jnp
 
     from skellysim_tpu.ops import kernels
@@ -1303,6 +1488,7 @@ GROUPS = [
     ("kernels", _group_kernels, 1.0),
     ("scale", _group_scale, 2.6),
     ("multichip", _group_multichip, 1.3),
+    ("collectives", _group_collectives, 0.7),
     ("treecode", _group_treecode, 1.0),
     ("solves", _group_solves, 1.0),
     ("coupled", _group_coupled, 2.6),
@@ -1329,10 +1515,12 @@ def _child_main(group: str, out_path: str):
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         from skellysim_tpu.utils.bootstrap import force_cpu_devices
 
-        # the multichip ladder needs a virtual 8-device mesh on the CPU
-        # fallback (mirroring the test strategy); other groups keep the
-        # single-device platform so their numbers stay comparable
-        force_cpu_devices(8 if group == "multichip" else None)
+        # the multichip ladder and the collectives group need a virtual
+        # 8-device mesh on the CPU fallback (mirroring the test strategy);
+        # other groups keep the single-device platform so their numbers
+        # stay comparable
+        force_cpu_devices(8 if group in ("multichip", "collectives")
+                          else None)
     import jax
 
     jax.config.update("jax_enable_x64", True)

@@ -122,12 +122,27 @@ JAX_PLATFORMS=cpu python scripts/gen_config_reference.py --check
 
 echo "== unit/integration tests (tier: $TIER) =="
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+# fast-tier budget guard: the not-slow tier must stay under the driver's
+# 870 s timeout with headroom — above the warning line, slow-mark the
+# newly-expensive tests (pytest.ini `slow`) instead of letting the tier
+# creep into the timeout and fail far from the offending commit
+TIER_BUDGET_WARN_S=780
+tier_t0=$(date +%s)
 case "$TIER" in
   fast)    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow and not tpu" ;;
   full)    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not tpu" ;;
   nightly) python -m pytest tests/ -q ;;
   *) echo "unknown tier '$TIER' (use fast|full|nightly)" >&2; exit 2 ;;
 esac
+tier_wall=$(( $(date +%s) - tier_t0 ))
+echo "== test tier wall: ${tier_wall}s =="
+if [ "$TIER" = fast ] && [ "$tier_wall" -gt "$TIER_BUDGET_WARN_S" ]; then
+  echo "!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!" >&2
+  echo "!! WARNING: not-slow tier took ${tier_wall}s (> ${TIER_BUDGET_WARN_S}s warning line," >&2
+  echo "!! 870s hard timeout). Slow-mark the newly-expensive tests NOW —" >&2
+  echo "!! see pytest.ini 'slow' and ROADMAP.md's tier-1 budget note."     >&2
+  echo "!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!" >&2
+fi
 
 echo "== graft entry: compile check + FULL-STEP multichip dryrun =="
 # dryrun_multichip(8) is the full coupled implicit step as one explicitly-

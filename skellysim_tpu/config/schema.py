@@ -172,6 +172,9 @@ class Params:
     dt_write: float = 0.1
     t_final: float = 100.0
     gmres_tol: float = 1e-8
+    # communication-avoiding s-step GMRES block size (1 = the sequential
+    # cycle; see skellysim_tpu/params.py `gmres_block_s` for semantics)
+    gmres_block_s: int = 1
     fiber_error_tol: float = 0.1
     seed: int = 130319
     implicit_motor_activation_delay: float = 0.0
@@ -525,6 +528,13 @@ class ServeConfig:
     #: is dropped (and its tenants evicted) instead of freezing the
     #: single-threaded event loop on a full TCP window
     send_timeout_s: float = 30.0
+    #: terminal tenant-record retention (seconds): finished / evicted /
+    #: cancelled records (and their final-state snapshots) expire this long
+    #: after retirement, bounding server memory under sustained traffic.
+    #: 0 disables expiry (the pre-TTL behavior: records live until
+    #: shutdown). An expired tenant answers "unknown tenant" — clients
+    #: must fetch snapshots/frames within the TTL.
+    record_ttl_s: float = 0.0
 
 
 def load_serve_config(path: str) -> ServeConfig:
@@ -714,6 +724,7 @@ def to_runtime_params(p: Params) -> runtime_params.Params:
         dt_write=p.dt_write,
         t_final=p.t_final,
         gmres_tol=p.gmres_tol,
+        gmres_block_s=p.gmres_block_s,
         fiber_error_tol=p.fiber_error_tol,
         seed=p.seed,
         implicit_motor_activation_delay=p.implicit_motor_activation_delay,

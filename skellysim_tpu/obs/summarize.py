@@ -162,6 +162,16 @@ class Summary:
         if cycles:
             out.append(f"gmres restart cycles: mean "
                        f"{sum(cycles) / len(cycles):.1f}  max {max(cycles)}")
+        # dot-product psum rounds per solve (`solver.gmres.collective_rounds`
+        # — iters/block_s batched Gram rounds + per-cycle residual norms):
+        # the s-step ladder lever, surfaced here so a collective-count
+        # regression shows up in telemetry, not just in bench reruns
+        rounds = [int(s["collective_rounds"]) for s in self.steps
+                  if "collective_rounds" in s]
+        if rounds:
+            out.append(f"collective rounds/solve: mean "
+                       f"{sum(rounds) / len(rounds):.1f}  max {max(rounds)}"
+                       f"  total {sum(rounds)}")
         rt = [float(s["residual_true"]) for s in self.steps
               if s.get("residual_true") is not None]
         if rt:

@@ -80,17 +80,15 @@ def _pad_to(a, n, axis, value=0.0):
     return jnp.pad(a, widths, constant_values=value)
 
 
-def _stokeslet_kernel(trg_ref, src_ref, f_ref, out_ref):
-    """One [TILE_T, TILE_S] interaction tile; accumulates over grid axis 1."""
-    j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    tx, ty, tz = trg_ref[0, :], trg_ref[1, :], trg_ref[2, :]
-    sx, sy, sz = src_ref[0, :], src_ref[1, :], src_ref[2, :]
-    fx, fy, fz = f_ref[0, :], f_ref[1, :], f_ref[2, :]
+def stokeslet_tile_sums(trg_T, src_T, f_T):
+    """Unscaled Stokeslet pair sums for one transposed-layout tile:
+    ``[3, nt]`` targets x ``[3, ns]`` sources/forces -> ``(ux, uy, uz)``
+    row sums. Pure jnp math on values already loaded from refs — the ONE
+    definition shared by the gridded VMEM tile below and the fused ring
+    kernel (`parallel.ring_fused`), so the two cannot drift."""
+    tx, ty, tz = trg_T[0, :], trg_T[1, :], trg_T[2, :]
+    sx, sy, sz = src_T[0, :], src_T[1, :], src_T[2, :]
+    fx, fy, fz = f_T[0, :], f_T[1, :], f_T[2, :]
 
     dx = tx[:, None] - sx[None, :]
     dy = ty[:, None] - sy[None, :]
@@ -106,6 +104,18 @@ def _stokeslet_kernel(trg_ref, src_ref, f_ref, out_ref):
     ux = jnp.sum(rinv * fx[None, :] + common * dx, axis=1)
     uy = jnp.sum(rinv * fy[None, :] + common * dy, axis=1)
     uz = jnp.sum(rinv * fz[None, :] + common * dz, axis=1)
+    return ux, uy, uz
+
+
+def _stokeslet_kernel(trg_ref, src_ref, f_ref, out_ref):
+    """One [TILE_T, TILE_S] interaction tile; accumulates over grid axis 1."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ux, uy, uz = stokeslet_tile_sums(trg_ref[:], src_ref[:], f_ref[:])
     out_ref[0, :] += ux
     out_ref[1, :] += uy
     out_ref[2, :] += uz
@@ -162,16 +172,13 @@ def stokeslet_pallas(r_src, r_trg, f_src, eta, *, tile_t: int = DEFAULT_TILE_T,
     return u_T.T[:n_trg] * (factor / eta)
 
 
-def _stresslet_kernel(trg_ref, src_ref, s_ref, out_ref):
-    """Stresslet tile: s_ref holds the 9 source components [9, TILE_S]."""
-    j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    tx, ty, tz = trg_ref[0, :], trg_ref[1, :], trg_ref[2, :]
-    sx, sy, sz = src_ref[0, :], src_ref[1, :], src_ref[2, :]
+def stresslet_tile_sums(trg_T, src_T, s_T):
+    """Unscaled stresslet pair sums for one transposed-layout tile:
+    ``[3, nt]`` targets x ``[3, ns]`` sources + ``[9, ns]`` row-major
+    stresslet components -> ``(ux, uy, uz)``. Shared by the gridded tile
+    and the fused ring kernel like `stokeslet_tile_sums`."""
+    tx, ty, tz = trg_T[0, :], trg_T[1, :], trg_T[2, :]
+    sx, sy, sz = src_T[0, :], src_T[1, :], src_T[2, :]
 
     dx = tx[:, None] - sx[None, :]
     dy = ty[:, None] - sy[None, :]
@@ -183,16 +190,29 @@ def _stresslet_kernel(trg_ref, src_ref, s_ref, out_ref):
     rinv5 = rinv2 * rinv2 * rinv
 
     # d^T S d over the 9 components (S row-major: Sxx..Szz)
-    dSd = (dx * dx * s_ref[0, :][None, :] + dx * dy * s_ref[1, :][None, :]
-           + dx * dz * s_ref[2, :][None, :] + dy * dx * s_ref[3, :][None, :]
-           + dy * dy * s_ref[4, :][None, :] + dy * dz * s_ref[5, :][None, :]
-           + dz * dx * s_ref[6, :][None, :] + dz * dy * s_ref[7, :][None, :]
-           + dz * dz * s_ref[8, :][None, :])
+    dSd = (dx * dx * s_T[0, :][None, :] + dx * dy * s_T[1, :][None, :]
+           + dx * dz * s_T[2, :][None, :] + dy * dx * s_T[3, :][None, :]
+           + dy * dy * s_T[4, :][None, :] + dy * dz * s_T[5, :][None, :]
+           + dz * dx * s_T[6, :][None, :] + dz * dy * s_T[7, :][None, :]
+           + dz * dz * s_T[8, :][None, :])
     common = -3.0 * dSd * rinv5
 
-    out_ref[0, :] += jnp.sum(common * dx, axis=1)
-    out_ref[1, :] += jnp.sum(common * dy, axis=1)
-    out_ref[2, :] += jnp.sum(common * dz, axis=1)
+    return (jnp.sum(common * dx, axis=1), jnp.sum(common * dy, axis=1),
+            jnp.sum(common * dz, axis=1))
+
+
+def _stresslet_kernel(trg_ref, src_ref, s_ref, out_ref):
+    """Stresslet tile: s_ref holds the 9 source components [9, TILE_S]."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ux, uy, uz = stresslet_tile_sums(trg_ref[:], src_ref[:], s_ref[:])
+    out_ref[0, :] += ux
+    out_ref[1, :] += uy
+    out_ref[2, :] += uz
 
 
 @partial(jax.jit, static_argnames=("tile_t", "tile_s", "interpret"))

@@ -11,6 +11,7 @@ against the modern API and the fallback logic lives in exactly one place.
 from __future__ import annotations
 
 import contextlib
+import os
 
 import jax
 
@@ -30,6 +31,38 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
 
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                       check_rep=False)
+
+
+def fused_ring_mode(impl: str = "pallas") -> str:
+    """Build-time transfer-mode selection for the source-block rings:
+    ``"fused"`` (one Pallas `make_async_remote_copy` kernel per ring,
+    `parallel.ring_fused`), ``"fused-interpret"`` (the same kernel on the
+    Pallas interpreter — CPU debugging, opt-in only), or ``"ppermute"``
+    (the `lax.ppermute` loop). ONE call site in `parallel.ring` serves CPU
+    CI and TPU runs; this function is where the fallback logic lives, next
+    to the other version/backend seams.
+
+    The fused kernel engages only for ``impl="pallas"`` (its pair math IS
+    the Pallas tile math — exact/mxu probes must keep their tile
+    semantics), on a compiled TPU backend whose pallas build ships the
+    remote-DMA API. ``SKELLY_FUSED_RING=0`` forces the ppermute ring
+    (escape hatch); ``SKELLY_FUSED_RING=interpret`` opts the interpreter
+    in off-TPU (where its remote-DMA emulation supports it).
+    """
+    override = os.environ.get("SKELLY_FUSED_RING", "").strip().lower()
+    if override in ("0", "off", "ppermute"):
+        return "ppermute"
+    if impl != "pallas":
+        return "ppermute"
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:  # pallas not shipped on this build
+        return "ppermute"
+    if not hasattr(pltpu, "make_async_remote_copy"):
+        return "ppermute"
+    if override == "interpret":
+        return "fused-interpret"
+    return "fused" if jax.default_backend() == "tpu" else "ppermute"
 
 
 def use_mesh(mesh):

@@ -9,6 +9,10 @@ neighbor-to-neighbor around the ICI ring with `lax.ppermute` — structurally
 identical to ring attention's KV-block rotation, applied to Stokes kernels.
 Peak per-chip memory is O(N/D) instead of O(N), and every hop is a
 nearest-neighbor ICI transfer that overlaps with the local block computation.
+On TPU backends the whole ring can build as ONE fused Pallas
+`make_async_remote_copy` kernel instead of D-1 ppermute launches
+(`parallel.ring_fused`; selection at build time via
+`compat.fused_ring_mode`, shared call site `_ring_or_fused`).
 
 All functions take sources/targets/densities sharded along their leading axis
 over ``mesh`` (pad to a multiple of the mesh size) and return targets with the
@@ -33,8 +37,34 @@ from ..ops.kernels import (DEFAULT_EPS, DEFAULT_REG, oseen_block,
                            pallas_impl_for, stokeslet_block,
                            stokeslet_block_mxu, stresslet_block,
                            stresslet_block_mxu)
-from .compat import shard_map
+from .compat import fused_ring_mode, shard_map
 from .mesh import FIBER_AXIS
+
+
+def _ring_or_fused(kind, impl: str, block_fn, axis_name: str, n_dev: int,
+                   r_trg, *rotating, unroll: bool = False):
+    """THE ring call site: fused Pallas ring kernel where the build-time
+    seam (`compat.fused_ring_mode`) selects it, else the `lax.ppermute`
+    accumulation — CPU CI and TPU runs share this one dispatch.
+
+    ``kind`` names the fused kernel family ("stokeslet"/"stresslet"; None
+    for tiles the fused path does not serve, e.g. the Oseen contraction
+    and the DF accuracy tier). Selection is per-build: the fused kernel
+    additionally requires whole-shard blocks inside its VMEM budget
+    (`ring_fused.fused_ring_fits`) and a multi-device ring.
+    """
+    mode = fused_ring_mode(impl) if kind is not None else "ppermute"
+    if mode != "ppermute" and n_dev > 1:
+        from . import ring_fused
+
+        if ring_fused.fused_ring_fits(kind, r_trg.shape[0],
+                                      rotating[0].shape[0], n_dev):
+            return ring_fused.fused_ring_block_sum(
+                kind, r_trg, *rotating, axis_name=axis_name, n_dev=n_dev,
+                interpret=(mode == "fused-interpret"))
+    return _ring_accumulate(lambda *r: block_fn(r_trg, *r), axis_name,
+                            n_dev, jnp.zeros_like(r_trg), *rotating,
+                            unroll=unroll)
 
 
 def _ring_accumulate(block_fn, axis_name: str, n_dev: int, u0, *rotating,
@@ -105,14 +135,16 @@ def _ring_block(impl: str, exact_block, mxu_block, pallas_block_name=None):
 
 
 def _ring_eval(block_fn, mesh: Mesh, axis_name: str, specs, scale, *operands,
-               unroll: bool = False):
+               unroll: bool = False, kind: str | None = None,
+               impl: str = "exact"):
     """shard_map a ring accumulation: operands[0] = targets (stay resident),
-    operands[1:] rotate."""
+    operands[1:] rotate. ``kind``/``impl`` feed the fused-ring dispatch
+    (`_ring_or_fused`)."""
     n_dev = mesh.shape[axis_name]
 
     def local(trg_l, *rot_l):
-        u = _ring_accumulate(lambda *r: block_fn(trg_l, *r), axis_name, n_dev,
-                             jnp.zeros_like(trg_l), *rot_l, unroll=unroll)
+        u = _ring_or_fused(kind, impl, block_fn, axis_name, n_dev, trg_l,
+                           *rot_l, unroll=unroll)
         return u * scale
 
     # check_vma off on the interpret-mode pallas path only (see
@@ -190,9 +222,8 @@ def ring_flow_local(kind: str, impl: str, r_trg, src, payload, eta, *,
     block = _ring_block(impl, exact_block, mxu_block, pallas_name)
     scale = 1.0 / (8.0 * math.pi * eta)
     if ring:
-        u = _ring_accumulate(lambda s, f: block(r_trg, s, f), axis_name,
-                             n_dev, jnp.zeros_like(r_trg), src, payload,
-                             unroll=_pallas_interpret(impl))
+        u = _ring_or_fused(kind, impl, block, axis_name, n_dev, r_trg,
+                           src, payload, unroll=_pallas_interpret(impl))
     else:
         u = block(r_trg, src, payload)
     return u * scale
@@ -215,7 +246,8 @@ def ring_stokeslet(r_src, r_trg, f_src, eta, *, mesh: Mesh,
                         "stokeslet_pallas_block")
     return _ring_eval(block, mesh, axis_name, (spec, spec, spec),
                       1.0 / (8.0 * math.pi * eta), r_trg, r_src, f_src,
-                      unroll=_pallas_interpret(impl))
+                      unroll=_pallas_interpret(impl), kind="stokeslet",
+                      impl=impl)
 
 
 @partial(jax.jit, static_argnames=("mesh", "axis_name", "impl"))
@@ -230,7 +262,8 @@ def ring_stresslet(r_dl, r_trg, f_dl, eta, *, mesh: Mesh,
     return _ring_eval(block, mesh, axis_name,
                       (spec, spec, P(axis_name, None, None)),
                       1.0 / (8.0 * math.pi * eta), r_trg, r_dl, f_dl,
-                      unroll=_pallas_interpret(impl))
+                      unroll=_pallas_interpret(impl), kind="stresslet",
+                      impl=impl)
 
 
 def _df_ring_block(impl: str, xla_block, pallas_block_name: str):

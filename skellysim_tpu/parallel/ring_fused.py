@@ -1,0 +1,191 @@
+"""Fused Pallas ring collectives: the source-block ring as ONE kernel.
+
+The `lax.ppermute` ring (`parallel.ring._ring_accumulate`) expresses the
+overlap intent — permute the next blocks, compute on the current ones — but
+leaves the scheduling to XLA, and on the measured ladder
+(MULTICHIP_r06/r07) the per-hop collective launch latency dominates the
+coupled solve at exactly the sizes the SPMD step runs at. This module fuses
+the WHOLE ring into one Pallas kernel per shard (SNIPPETS.md [1]-[3], the
+jax distributed-pallas ring pattern): the neighbor transfer is a
+`pltpu.make_async_remote_copy` RDMA started BEFORE the resident block's
+pair-kernel arithmetic, so the ICI hop hides under VPU compute instead of
+serializing with it, and the n_dev-1 hops cost zero collective launches
+beyond the single kernel.
+
+Scope (build-time checked, `fused_ring_fits`):
+
+* f32 `impl="pallas"` tiles only — the kernel's pair math IS the Pallas
+  tile math (`ops.pallas_kernels.stokeslet_tile_sums` /
+  `stresslet_tile_sums`, one shared definition), so a user probing the
+  exact/mxu tiles keeps the `ppermute` ring and its tile semantics;
+* whole-shard blocks resident in VMEM (`_VMEM_PAIR_BUDGET`): this is a
+  LATENCY optimization for the solve-scale regime where the ladder loses
+  to one device — bandwidth-bound blocks too big for VMEM fall back to the
+  `ppermute` ring at build time, which already streams fine at scale;
+* a compiled TPU backend. CPU CI always falls back (selection lives in
+  `parallel.compat.fused_ring_mode`, so the call site in `parallel.ring`
+  is ONE line shared by both paths); ``SKELLY_FUSED_RING=interpret`` opts
+  the Pallas interpreter in where its remote-DMA emulation supports it.
+
+Ring safety: ``n_dev`` comm slots, each written and read EXACTLY ONCE per
+kernel instance — step ``s`` starts the RDMA of slot ``s`` into the right
+neighbor's slot ``s+1``, computes on slot ``s`` while the transfer is in
+flight, then waits its send+receive. No slot reuse means no mid-step
+synchronization at all; the recv semaphore per slot is the only intra-step
+ordering. Across kernel INSTANCES (the same call site re-executed inside
+the solver loop, or back-to-back stokeslet/stresslet rings) the kernel
+brackets itself with an ENTRY and an EXIT neighbor barrier: with both in
+place a device needs 2 barrier credits per phase and its neighbors can
+have produced at most 5 of the 6 credits required to reach instance k+1's
+sends while a neighbor is still reading instance k — the counting makes
+phase skew >= 2 impossible even though barrier credits are anonymous
+(a single entry barrier alone would NOT be safe: a fast neighbor's next-
+instance signal could stand in for a slow neighbor's missing one, and the
+RDMA would overwrite comm slots still being read). The slot buffers cost
+``n_dev * (3 + payload_rows) * ns`` floats of VMEM, bounded by
+`fused_ring_fits` alongside the pair tile.
+
+The accumulation order around the ring is the SAME as the ppermute ring's
+(my block first, then left neighbor's, ...), so the two paths agree to the
+Pallas tile's usual f32 tolerance, shard by shard.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..ops.pallas_kernels import (_PAD_SENTINEL, _out_struct, _pad_to,
+                                  stokeslet_tile_sums, stresslet_tile_sums)
+
+#: cap on nt_padded * ns_padded for the whole-block VMEM kernel: the pair
+#: intermediates are a handful of [nt, ns] f32 arrays, so this bounds VMEM
+#: at a few MB (the gridded tile sweep topped out at 512x2048-class tiles).
+#: Bigger blocks are bandwidth-bound, not latency-bound — they keep the
+#: ppermute ring.
+_VMEM_PAIR_BUDGET = 512 * 2048
+
+#: payload rows in the rotating comm block (3 coord rows + payload rows)
+_PAYLOAD_ROWS = {"stokeslet": 3, "stresslet": 9}
+
+#: pallas_call collective_id for the ring's barrier semaphore (one ring
+#: kernel family; concurrent distinct collectives would need distinct ids)
+_COLLECTIVE_ID = 7
+
+
+#: cap on the n_dev-slot comm buffer (floats): slots are written/read once
+#: per instance (the no-reuse safety scheme above), so the buffer scales
+#: with mesh size — 4 MB of f32 leaves the pair tile its VMEM headroom
+_VMEM_COMM_BUDGET = 1 << 20
+
+
+def fused_ring_fits(kind: str, n_trg: int, n_src: int,
+                    n_dev: int = 1) -> bool:
+    """True when the whole-block fused kernel serves this shape: known
+    kernel family, padded pair tile inside the VMEM budget, and the
+    n_dev-slot comm buffer inside its own."""
+    if kind not in _PAYLOAD_ROWS:
+        return False
+    nt = -(-n_trg // 8) * 8
+    ns = -(-n_src // 128) * 128
+    comm = n_dev * (3 + _PAYLOAD_ROWS[kind]) * ns
+    return nt * ns <= _VMEM_PAIR_BUDGET and comm <= _VMEM_COMM_BUDGET
+
+
+def _ring_kernel(kind: str, axis_name: str, n_dev: int):
+    """Kernel body: resident targets x rotating [rows, ns] comm blocks."""
+    tile_sums = (stokeslet_tile_sums if kind == "stokeslet"
+                 else stresslet_tile_sums)
+
+    def kernel(trg_ref, blk_ref, out_ref, comm, send_sem, recv_sem):
+        my_id = lax.axis_index(axis_name)
+        right = lax.rem(my_id + 1, n_dev)
+        left = lax.rem(my_id + n_dev - 1, n_dev)
+
+        comm[0] = blk_ref[:]
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+        def neighbor_barrier():
+            barrier_sem = pltpu.get_barrier_semaphore()
+            for nb in (left, right):
+                pltpu.semaphore_signal(
+                    barrier_sem, inc=1, device_id=nb,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_wait(barrier_sem, 2)
+
+        # ENTRY barrier: no RDMA before both neighbors entered THIS
+        # instance (paired with the exit barrier below, the credit count
+        # bounds cross-instance skew to < 2 phases — module docstring)
+        neighbor_barrier()
+
+        for step in range(n_dev):      # static unroll: n_dev is mesh size
+            rdma = None
+            if step < n_dev - 1:
+                # slot step -> right neighbor's slot step+1: every slot is
+                # written once and read once, so steps need no slot-reuse
+                # synchronization beyond the per-slot recv semaphore
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=comm.at[step], dst_ref=comm.at[step + 1],
+                    send_sem=send_sem.at[step], recv_sem=recv_sem.at[step + 1],
+                    device_id=right,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                rdma.start()           # transfer in flight DURING compute
+            blk = comm[step]
+            ux, uy, uz = tile_sums(trg_ref[:], blk[:3], blk[3:])
+            out_ref[0, :] += ux
+            out_ref[1, :] += uy
+            out_ref[2, :] += uz
+            if step < n_dev - 1:
+                rdma.wait()
+
+        # EXIT barrier: we are done READING every comm slot; the paired
+        # entry wait of the next instance cannot be satisfied while either
+        # neighbor still sits before this point
+        neighbor_barrier()
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("kind", "axis_name", "n_dev", "interpret"))
+def fused_ring_block_sum(kind: str, r_trg, src, payload, *, axis_name: str,
+                         n_dev: int, interpret: bool = False):
+    """UNSCALED ring-accumulated pair sum for one shard (call INSIDE the
+    `shard_map` over ``axis_name``): [nt, 3] resident targets, [ns, 3]
+    resident sources, payload [ns, 3] forces ("stokeslet") or [ns, 3, 3]
+    stresslets. Drop-in for `parallel.ring._ring_accumulate`'s result (the
+    caller applies the 1/(8 pi eta) scale), transfer overlapped with
+    compute via one fused Pallas kernel.
+    """
+    prows = _PAYLOAD_ROWS[kind]
+    n_trg, n_src = r_trg.shape[0], src.shape[0]
+    dtype = r_trg.dtype
+
+    nt = -(-n_trg // 8) * 8
+    ns = -(-n_src // 128) * 128
+    trg_T = _pad_to(r_trg.T, nt, axis=1)
+    src_T = _pad_to(src.T, ns, axis=1, value=_PAD_SENTINEL)
+    pay_T = _pad_to(payload.reshape(n_src, prows).T, ns, axis=1)
+    blk = jnp.concatenate([src_T, pay_T], axis=0)  # [3 + prows, ns]
+
+    # no grid: operands stage whole-block into VMEM (the budget check in
+    # `fused_ring_fits` is what makes that legal), comm slots in VMEM so
+    # the RDMA lands directly where the next step computes
+    compiler_params = pltpu.TPUCompilerParams(collective_id=_COLLECTIVE_ID)
+    u_T = pl.pallas_call(
+        _ring_kernel(kind, axis_name, n_dev),
+        out_shape=_out_struct((3, nt), dtype, trg_T, blk),
+        scratch_shapes=(
+            pltpu.VMEM((n_dev, 3 + prows, ns), dtype),
+            pltpu.SemaphoreType.DMA((n_dev,)),
+            pltpu.SemaphoreType.DMA((n_dev,)),
+        ),
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(trg_T, blk)
+    return u_T.T[:n_trg]

@@ -23,10 +23,12 @@ Decomposition (everything per shard, mesh size D):
 Collectives are explicit and bounded (docs/parallel.md documents the full
 inventory; tests/test_spmd.py pins it against the lowered HLO):
 
-* `psum` for every GMRES dot product / norm (injected into `solver.gmres`
-  through its ``rdot`` seam: one collective per orthogonalization pass) and
-  for the partial sums onto REPLICATED rows (body-node velocities, link
-  forces/torques);
+* `psum` for the GMRES reductions (injected into `solver.gmres` through
+  its ``rdot`` seam — with ``Params.gmres_block_s > 1`` the s-step cycle
+  batches them into two [(m+1)+s, s] Gram rounds per s iterations instead
+  of 3 per iteration; docs/parallel.md) and for the partial sums onto
+  REPLICATED rows (body-node velocities, link forces/torques, bundled
+  into ONE tuple-psum per matvec);
 * `ppermute` ring rotation of fiber/shell source blocks for all pairwise
   flows at shard-resident targets (`fibers.container.flow_multi_local`,
   `periphery.flow_local`) — including the double-float refinement tiles, so
@@ -621,14 +623,16 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
                 precond_lo=make_precond(lo[0], lo[1], lo[2]),
                 tol=p.gmres_tol, inner_tol=p.inner_tol,
                 restart=p.gmres_restart, maxiter=p.gmres_maxiter,
-                max_refine=p.max_refine, rdot=rdot)
+                max_refine=p.max_refine, rdot=rdot,
+                block_s=p.gmres_block_s)
         else:
             result = gmres(
                 make_matvec(st, caches, body_caches, pair_spec=krylov_pair,
                             pair_anchors=anchors), rhs,
                 precond=make_precond(st, caches, body_caches),
                 tol=p.gmres_tol, restart=p.gmres_restart,
-                maxiter=p.gmres_maxiter, rdot=rdot)
+                maxiter=p.gmres_maxiter, rdot=rdot,
+                block_s=p.gmres_block_s)
 
         # ------------------------------------------------ advance components
         new_state = st
@@ -776,7 +780,13 @@ def auditable_programs():
     def build(n_dev):
         def _build():
             mesh = make_mesh(n_dev)
-            system = fixtures.make_system(shell=True)
+            # gmres_block_s=4: the audited ladder configuration IS the
+            # communication-avoiding solver (ISSUE 8) — the contracts pin
+            # the BATCHED Gram rounds (2 all-reduces per 4 Krylov
+            # iterations in the solver loop body, vs the sequential
+            # cycle's 3 per iteration), so a regression back to
+            # per-iteration psums fails the collective inventory
+            system = fixtures.make_system(shell=True, gmres_block_s=4)
             state = shard_state(fixtures.coupled_state(system), mesh)
             fn = build_spmd_step(system, mesh, state, flat_solution=False,
                                  donate=True)
@@ -787,7 +797,7 @@ def auditable_programs():
         from ..testing import trace_counting_jit
 
         mesh = make_mesh(2)
-        system = fixtures.make_system()
+        system = fixtures.make_system(gmres_block_s=4)
         state = shard_state(fixtures.free_state(system), mesh)
         fn = build_spmd_step(system, mesh, state, donate=False,
                              jit_wrapper=trace_counting_jit)

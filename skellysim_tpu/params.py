@@ -58,6 +58,16 @@ class Params:
     gmres_tol: float = 1e-10
     gmres_restart: int = 100
     gmres_maxiter: int = 1000
+    # communication-avoiding s-step GMRES block size (`solver.gmres
+    # block_s`): each Arnoldi round generates s preconditioned Krylov
+    # candidates and orthogonalizes them in TWO batched Gram reductions
+    # instead of 3 per iteration — under `step_spmd` that is 2 psum rounds
+    # per s iterations instead of 3s, the lever that flips the multi-chip
+    # coupled-solve ladder positive (docs/parallel.md). 1 = the sequential
+    # cycle, BITWISE identical to the pre-s-step solver (parity-pinned);
+    # 4 is the measured sweet spot on the bench scenes — larger s trades
+    # monomial-basis conditioning (f32 Krylov interior) for fewer rounds.
+    gmres_block_s: int = 1
     # skelly-scope convergence history: ring-buffer capacity (rows) of
     # per-restart (iters, implicit, explicit) residuals carried device-side
     # through the solve and surfaced as the metrics JSONL's `gmres_history`

@@ -140,7 +140,22 @@ class SimulationServer:
                 if b.scheduler.live:
                     b.scheduler.poll()
                     did = True
+        self._expire_records()
         return did
+
+    def _expire_records(self):
+        """Bounded tenant-record retention (`[serve] record_ttl_s`):
+        terminal records expire `ttl` after retirement — a long-lived
+        server under sustained traffic must not grow its registry (and the
+        final-frame snapshots it holds) without bound. Runs on every tick
+        AND every request, so idle servers expire too."""
+        import time
+
+        dead = self.registry.expire(self.serve_cfg.record_ttl_s,
+                                    time.monotonic())
+        for tid in dead:
+            logger.info("serve: tenant record %s expired (record_ttl_s=%g)",
+                        tid, self.serve_cfg.record_ttl_s)
 
     def any_live(self) -> bool:
         return any(b.scheduler.live for b in self.buckets)
@@ -158,6 +173,8 @@ class SimulationServer:
             t.frames_total += 1
 
     def _on_retire(self, member_id: str, state, reason: str):
+        import time
+
         t = self._tenant(member_id)
         if t is not None:
             t.final_frame = tenants_mod.state_snapshot(
@@ -165,6 +182,7 @@ class SimulationServer:
             t.t = float(state.time)
             t.status = reason if reason in tenants_mod.TENANT_STATES \
                 else "finished"
+            t.retired_at = time.monotonic()   # [serve] record_ttl_s clock
 
     def _on_sched_event(self, rec: dict):
         t = self._tenant(rec.get("member", ""))
@@ -186,6 +204,7 @@ class SimulationServer:
         err = protocol.validate_request(req)
         if err:
             return protocol.error(err)
+        self._expire_records()
         handler = getattr(self, f"_req_{req['type']}")
         try:
             with obs_tracer.use(self.tracer):
@@ -361,6 +380,8 @@ class SimulationServer:
         else:
             spec = sched.unqueue(tenant.tenant_id)
             if spec is not None:
+                import time
+
                 # a queued member's spec state IS its resume point — keep it
                 # as the snapshot (resumed submits buffer no initial frame,
                 # so dropping the spec here would lose the tenant entirely)
@@ -368,6 +389,7 @@ class SimulationServer:
                     spec.state, rng_state=tenant.rng_state)
                 tenant.t = float(spec.state.time)
                 tenant.status = reason
+                tenant.retired_at = time.monotonic()
 
     def evict_conn(self, conn):
         """Graceful eviction on client disconnect: every tenant the

@@ -61,6 +61,10 @@ class Tenant:
     #: CLI-written one (free-space tenants never advance the streams, so
     #: the admission-time dump stays current)
     rng_state: Optional[object] = None
+    #: monotonic timestamp of entry into a terminal state (finished /
+    #: evicted / cancelled / dt_underflow) — the `[serve] record_ttl_s`
+    #: retention clock; None while queued/running (never expires)
+    retired_at: Optional[float] = None
 
     def snapshot_pending(self) -> int:
         return len(self.frames)
@@ -93,6 +97,19 @@ class TenantRegistry:
     def of_conn(self, conn) -> list[Tenant]:
         """Tenants owned by one connection (the disconnect-eviction set)."""
         return [t for t in self._tenants.values() if t.conn is conn]
+
+    def expire(self, ttl_s: float, now: float) -> list[str]:
+        """Drop terminal records older than ``ttl_s`` (the `[serve]
+        record_ttl_s` retention bound); returns the expired ids. ``ttl_s
+        <= 0`` disables expiry; live (queued/running) tenants never
+        expire — only `Tenant.retired_at` starts the clock."""
+        if ttl_s <= 0:
+            return []
+        dead = [tid for tid, t in self._tenants.items()
+                if t.retired_at is not None and now - t.retired_at >= ttl_s]
+        for tid in dead:
+            del self._tenants[tid]
+        return dead
 
     def __len__(self):
         return len(self._tenants)

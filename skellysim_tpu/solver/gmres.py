@@ -95,19 +95,39 @@ def _reductions(rdot):
     ``rdot(A, w)`` contracts the vector (solution-layout) axis: ``A @ w`` for
     the single-program solver; the SPMD solver (`parallel.spmd`) injects a
     partial-dot + `lax.psum` so GMRES runs unchanged on row-sharded Krylov
-    vectors with explicit collectives. The default path keeps
-    `jnp.linalg.norm` bit-for-bit (golden trajectories pin it)."""
+    vectors with explicit collectives. ``w`` may carry a trailing block axis
+    (``[n, s]`` — the s-step cycle's batched Gram reduction rides the SAME
+    seam: one psum of an ``[rows, s]`` block instead of ``s`` sequential
+    ``[rows]`` reductions). The default path keeps `jnp.linalg.norm`
+    bit-for-bit (golden trajectories pin it)."""
     if rdot is None:
         return (lambda A, w: A @ w), jnp.linalg.norm
     return rdot, lambda v: jnp.sqrt(rdot(v, v))
 
 
+def _chol_ridge(S, scale):
+    """Cholesky of the projected candidate Gram with a noise-floor ridge.
+
+    ``S`` is the BCGS-projected Gram (raw Gram minus the projection outer
+    product) — near convergence it collapses toward zero while its entries
+    carry cancellation noise of order ``rows * eps * scale`` (``scale`` =
+    the largest RAW candidate norm^2), which can push it indefinite. The
+    ridge sits AT that noise floor, so the factorization stays finite and
+    the perturbation it adds is below what the subtraction already lost.
+    GMRES self-corrects the O(ridge) Hessenberg error through the
+    explicit-residual restart (see `gmres.outer_cond`)."""
+    s = S.shape[0]
+    eps = jnp.asarray(jnp.finfo(S.dtype).eps, dtype=S.dtype)
+    ridge = eps * jnp.maximum(scale, jnp.asarray(1.0, dtype=S.dtype))
+    return jnp.linalg.cholesky(S + ridge * jnp.eye(s, dtype=S.dtype))
+
+
 @partial(jax.jit, static_argnames=("matvec", "precond", "restart", "maxiter",
-                                   "debug", "rdot", "history"))
+                                   "debug", "rdot", "history", "block_s"))
 def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
           tol: float = 1e-10, restart: int = 100, maxiter: int = 1000,
           debug: bool = False, rdot: Callable | None = None,
-          history: int = 0) -> GmresResult:
+          history: int = 0, block_s: int = 1) -> GmresResult:
     """Solve ``matvec(x) = b`` with right-preconditioned restarted GMRES.
 
     ``precond`` approximates A^-1 (applied on the right). Initial guess is zero,
@@ -132,10 +152,29 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
     stays free of host callbacks (audit's host-sync contract) and batches
     under `vmap` like every other carry; unwritten rows stay NaN. Read it
     out with `history_rows(result.history, result.cycles)`.
+
+    ``block_s=s`` (static, default 1) switches the Arnoldi cycle to the
+    communication-avoiding s-step form (`Params.gmres_block_s`,
+    docs/parallel.md): each round generates ``s`` preconditioned Krylov
+    candidates (monomial matvec powers) and orthogonalizes them in TWO
+    batched ``[(m+1)+s, s]`` Gram reductions through ``rdot`` (BCGS +
+    Cholesky-QR, then one CGS2 re-orthogonalization pass for f32-interior
+    stability) instead of 3 reductions per iteration — under the SPMD
+    solver that is 2 psum rounds per ``s`` iterations instead of ``3s``.
+    ``block_s=1`` is the EXACT sequential path, bitwise identical to the
+    pre-s-step solver (pinned by `tests/test_gmres.py`); the restart
+    length rounds up to a multiple of ``s`` so every round is full.
     """
+    if block_s < 1:
+        raise ValueError(f"block_s must be >= 1, got {block_s}")
     n = b.shape[0]
     dtype = b.dtype
     m = min(restart, maxiter)
+    if block_s > 1:
+        # full rounds only: the cycle advances s columns at a time, so the
+        # basis length must divide (overshoot past maxiter inside one cycle
+        # is bounded by s-1 and the outer loop still stops on maxiter)
+        m = -(-m // block_s) * block_s
     M = precond if precond is not None else (lambda v: v)
     rdot, _norm = _reductions(rdot)
 
@@ -207,6 +246,167 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
         resid = jnp.abs(g[jnp.minimum(k, m)]) / safe_b_norm
         return x0 + dx, resid, k
 
+    def arnoldi_cycle_block(x0, r0):
+        """Communication-avoiding restart cycle (``block_s`` > 1).
+
+        Each while-round extends the basis by ``s`` columns: generate the
+        monomial candidates p_j = (A M)^j v_k, orthogonalize the block in
+        ONE batched [(m+1)+s, s] Gram reduction (BCGS against the masked
+        basis + Cholesky-QR among the candidates), re-orthogonalize once
+        (CGS2) with a second batched reduction, then recover the s raw
+        Hessenberg columns from the change-of-basis coefficients — pure
+        replicated small-matrix work, no collectives. Under the SPMD rdot
+        that is 2 psum rounds per s iterations instead of the sequential
+        cycle's 3 per iteration.
+
+        The Hessenberg recovery (Hoemmen-style): with C = <v_i, p_j> and
+        upper-triangular R = coefficients of the new orthonormal rows q_u
+        in p_j, the coefficient vector of p_t in the EXTENDED basis is
+        e_t = C[:, t] + scatter(R[:, t] at rows k+1...). Then
+
+            Hraw[:, k]   = e_0                          (A M v_k = p_1)
+            Hraw[:, k+t] = (e_t - Hraw @ e_{t-1}|without-diag)
+                           / e_{t-1}[k+t]               (t = 1..s-1)
+
+        because A M q_{t-1} expands p_t's defining relation through the
+        already-known raw columns. Givens rotations then triangularize each
+        recovered column exactly as the sequential path does, so restart /
+        convergence / back-substitution semantics are unchanged.
+        """
+        s = block_s
+        beta = _norm(r0)
+        safe_beta = jnp.where(beta > 0.0, beta, 1.0)
+
+        V0 = jnp.zeros((m + 1, n), dtype=dtype).at[0].set(r0 / safe_beta)
+        Hr0 = jnp.zeros((m + 1, m), dtype=dtype)   # raw Arnoldi columns
+        H0 = jnp.zeros((m + 1, m), dtype=dtype)    # Givens-rotated columns
+        cs0 = jnp.zeros(m, dtype=dtype)
+        sn0 = jnp.zeros(m, dtype=dtype)
+        g0 = jnp.zeros(m + 1, dtype=dtype).at[0].set(beta)
+        eps = jnp.asarray(jnp.finfo(dtype).eps, dtype=dtype)
+        rows = jnp.asarray(m + 1 + s, dtype=dtype)
+
+        def cond(state):
+            k, *_, done = state
+            return (k < m) & ~done
+
+        def body(state):
+            k, V, Hr, H, cs, sn, g, done = state
+
+            # ---- s preconditioned matvec powers (one matvec per trip)
+            def gen(j, P):
+                prev = jnp.where(j == 0, V[k], P[jnp.maximum(j - 1, 0)])
+                return P.at[j].set(matvec(M(prev)))
+
+            P = lax.fori_loop(0, s, gen, jnp.zeros((s, n), dtype=dtype))
+
+            # ---- BCGS + Cholesky-QR: first batched Gram (collective 1)
+            mask = (jnp.arange(m + 1, dtype=jnp.int32) <= k).astype(dtype)
+            Vm = V * mask[:, None]
+            G = rdot(jnp.concatenate([Vm, P], axis=0), P.T)
+            C1, S1 = G[:m + 1], G[m + 1:]
+            scale1 = rows * jnp.max(jnp.diagonal(S1))
+            W = P - C1.T @ Vm
+            L1 = _chol_ridge(S1 - C1.T @ C1, scale1)
+            Q1 = jax.scipy.linalg.solve_triangular(L1, W, lower=True)
+
+            # ---- CGS2 re-orthogonalization: second batched Gram (coll. 2)
+            G2 = rdot(jnp.concatenate([Vm, Q1], axis=0), Q1.T)
+            C2, S2 = G2[:m + 1], G2[m + 1:]
+            W2 = Q1 - C2.T @ Vm
+            L2 = _chol_ridge(S2 - C2.T @ C2, rows * jnp.max(jnp.diagonal(S2)))
+            Q = jax.scipy.linalg.solve_triangular(L2, W2, lower=True)
+
+            # effective change of basis over BOTH passes:
+            #   p_j = C[:, j] . V  +  sum_u Rm[u, j] q_u
+            C = C1 + C2 @ L1.T
+            Rm = (L1 @ L2).T                    # upper triangular [s, s]
+            # a fully converged/dependent candidate block can still leave
+            # NaN rows in Q (0/0 through the triangular solves); those rows
+            # are never ACCEPTED (col_ok below) but they must not poison V
+            # — a NaN row times a zero back-substitution weight is NaN
+            Q = jnp.where(jnp.isfinite(Q), Q, 0.0)
+            V = lax.dynamic_update_slice(V, Q, (k + 1, jnp.int32(0)))
+            # breakdown floor for the recovered subdiagonals: below the
+            # projected Gram's noise floor the computed q direction is
+            # cancellation noise, not a Krylov direction — end the cycle
+            # (the outer loop's explicit residual decides what's next)
+            tiny = jnp.sqrt(eps * scale1) + jnp.asarray(
+                jnp.finfo(dtype).tiny, dtype=dtype)
+
+            def ecol(t):
+                base = lax.dynamic_update_slice(
+                    jnp.zeros(m + 1, dtype=dtype), Rm[:, t], (k + 1,))
+                return base + C[:, t]
+
+            def givens_col(j, hcol, cs, sn, g):
+                def rot(i, hc):
+                    hi, hip = hc[i], hc[i + 1]
+                    return (hc.at[i].set(cs[i] * hi + sn[i] * hip)
+                            .at[i + 1].set(-sn[i] * hi + cs[i] * hip))
+
+                hcol = lax.fori_loop(0, j, rot, hcol)
+                hj, hjp = hcol[j], hcol[j + 1]
+                denom = jnp.sqrt(hj ** 2 + hjp ** 2)
+                denom_safe = jnp.where(denom > 0.0, denom, 1.0)
+                c_new = jnp.where(denom > 0.0, hj / denom_safe, 1.0)
+                s_new = jnp.where(denom > 0.0, hjp / denom_safe, 0.0)
+                hcol = hcol.at[j].set(denom).at[j + 1].set(0.0)
+                cs = cs.at[j].set(c_new)
+                sn = sn.at[j].set(s_new)
+                g = g.at[j + 1].set(-s_new * g[j]).at[j].set(c_new * g[j])
+                return hcol, cs, sn, g
+
+            accepted = jnp.int32(0)
+            prev_e = jnp.zeros(m + 1, dtype=dtype)
+            for t in range(s):       # static: s is small, no collectives
+                j = k + t
+                e_t = ecol(t)
+                if t == 0:
+                    hraw = e_t
+                    rdiag = jnp.asarray(1.0, dtype=dtype)   # no division
+                else:
+                    rdiag = prev_e[j]
+                    coef = prev_e.at[j].set(0.0)[:m]
+                    hraw = (e_t - Hr @ coef) / jnp.where(rdiag > tiny,
+                                                         rdiag, 1.0)
+                col_ok = jnp.isfinite(hraw).all() & (rdiag > tiny)
+                acc = ~done & col_ok
+                hrot, cs_n, sn_n, g_n = givens_col(j, hraw, cs, sn, g)
+                Hr = jnp.where(acc, Hr.at[:, j].set(hraw), Hr)
+                H = jnp.where(acc, H.at[:, j].set(hrot), H)
+                cs = jnp.where(acc, cs_n, cs)
+                sn = jnp.where(acc, sn_n, sn)
+                g = jnp.where(acc, g_n, g)
+                accepted = accepted + acc.astype(jnp.int32)
+                done = done | (~done & ~col_ok) \
+                    | (acc & (jnp.abs(g[j + 1]) <= tol_abs))
+                prev_e = e_t
+            return k + accepted, V, Hr, H, cs, sn, g, done
+
+        k, V, Hr, H, cs, sn, g, done = lax.while_loop(
+            cond, body, (jnp.int32(0), V0, Hr0, H0, cs0, sn0, g0,
+                         beta <= tol_abs))
+
+        # identical masked back-substitution to the sequential cycle
+        idx = jnp.arange(m, dtype=jnp.int32)
+        active = idx < k
+
+        def back_sub(i, y):
+            j = m - 1 - i
+            hjj = H[j, j]
+            rhs = g[j] - jnp.dot(H[j, :], y)
+            yj = jnp.where(active[j], rhs / jnp.where(hjj != 0.0, hjj, 1.0),
+                           0.0)
+            return y.at[j].set(yj)
+
+        y = lax.fori_loop(0, m, back_sub, jnp.zeros(m, dtype=dtype))
+        dx = M(y @ V[:m])
+        resid = jnp.abs(g[jnp.minimum(k, m)]) / safe_b_norm
+        return x0 + dx, resid, k
+
+    cycle = arnoldi_cycle if block_s == 1 else arnoldi_cycle_block
+
     def outer_cond(state):
         (x, r, resid_true, prev_true, resid_impl, total_iters, cycles,
          hist) = state
@@ -224,7 +424,7 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
 
     def outer_body(state):
         x, r, resid_true, _, _, total_iters, cycles, hist = state
-        x, resid_impl, k = arnoldi_cycle(x, r)
+        x, resid_impl, k = cycle(x, r)
         r = b - matvec(x)
         prev_true = resid_true
         resid_true = _norm(r) / safe_b_norm
@@ -257,12 +457,12 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
 
 @partial(jax.jit, static_argnames=("matvec_hi", "matvec_lo", "precond_lo",
                                    "restart", "maxiter", "max_refine",
-                                   "rdot", "history"))
+                                   "rdot", "history", "block_s"))
 def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
              precond_lo: Callable | None = None, tol: float = 1e-10,
              inner_tol: float = 1e-5, restart: int = 100, maxiter: int = 1000,
              max_refine: int = 8, rdot: Callable | None = None,
-             history: int = 0) -> GmresResult:
+             history: int = 0, block_s: int = 1) -> GmresResult:
     """Mixed-precision GMRES with iterative refinement.
 
     The TPU-native answer to the reference's f64 accuracy gates (GMRES tol
@@ -290,7 +490,9 @@ def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
     — (cumulative inner iters, the sweep's inner implicit exit residual,
     the f64 explicit residual after the correction) — all in ``b.dtype``
     (no narrow->wide promotion edges: the inner solve's vectors already
-    carry ``b.dtype``, only its interior is f32).
+    carry ``b.dtype``, only its interior is f32). ``block_s`` passes
+    through to the inner Krylov solve (the s-step communication-avoiding
+    cycle — see `gmres`); the refinement sweep structure is unchanged.
     """
     M = precond_lo if precond_lo is not None else (lambda v: v)
     _norm = _reductions(rdot)[1]
@@ -305,7 +507,8 @@ def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
     def body(state):
         x, r, _, outer, total, hist = state
         d = gmres(matvec_lo, r, precond=M, tol=inner_tol,
-                  restart=restart, maxiter=maxiter, rdot=rdot)
+                  restart=restart, maxiter=maxiter, rdot=rdot,
+                  block_s=block_s)
         x = x + d.x
         r = b - matvec_hi(x)
         r_rel = _norm(r) / safe_b_norm
@@ -327,6 +530,31 @@ def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
                        converged=r_rel <= tol, residual_true=r_rel,
                        refines=outers, cycles=outers,
                        history=hist if history > 0 else None)
+
+
+def collective_rounds(iters, cycles, block_s: int = 1,
+                      restart: int | None = None) -> int:
+    """Dot-product collective rounds one solve paid through the ``rdot``
+    seam — the quantity the s-step cycle exists to shrink, surfaced as the
+    run-loop metrics field ``collective_rounds`` and summed/meaned by
+    `obs summarize` (docs/observability.md).
+
+    Sequential (``block_s=1``): 3 reductions per inner iteration (two ICGS
+    Gram passes + the new column's norm). s-step: 2 batched Gram reductions
+    per round of ``s`` iterations. Both plus 2 per restart boundary (the
+    entry-residual norm and the explicit-residual norm). For `gmres_ir`
+    results ``cycles`` counts refinement SWEEPS, not the inner solver's
+    restart cycles — pass ``restart`` (the caller's `Params.gmres_restart`)
+    so boundaries are floored at ``ceil(iters / restart)`` and an inner
+    restart blow-up still moves the metric. A (tight) lower bound, not an
+    exact trace count; host-side bookkeeping only — never traced."""
+    iters, cycles = int(iters), int(cycles)
+    boundaries = cycles
+    if restart:
+        boundaries = max(boundaries, -(-iters // max(int(restart), 1)))
+    if block_s <= 1:
+        return 3 * iters + 2 * boundaries
+    return 2 * (-(-iters // block_s)) + 2 * boundaries
 
 
 def history_rows(history, cycles) -> list:

@@ -32,7 +32,7 @@ from ..params import Params, REFINE_PAIR_IMPLS
 from ..periphery import periphery as peri
 from ..periphery.periphery import PeripheryShape, PeripheryState
 from ..solver import gmres, gmres_ir
-from ..solver.gmres import history_rows
+from ..solver.gmres import collective_rounds, history_rows
 from .sources import BackgroundFlow, PointSources
 
 
@@ -88,9 +88,10 @@ def _rewrap_fibers(fibers, new_buckets: tuple):
 #: docs/performance.md "Run-loop metrics JSONL"; schema-pinned by
 #: tests/test_cli_pipeline.py). Resumed runs are segmented by a marker line
 #: {"resume": true, "t": ...} that `cli.run(resume=True)` appends first.
-METRICS_FIELDS = ("step", "t", "dt", "iters", "gmres_cycles", "residual",
-                  "residual_true", "fiber_error", "accepted", "refines",
-                  "loss_of_accuracy", "wall_s", "wall_ms", "gmres_history")
+METRICS_FIELDS = ("step", "t", "dt", "iters", "gmres_cycles",
+                  "collective_rounds", "residual", "residual_true",
+                  "fiber_error", "accepted", "refines", "loss_of_accuracy",
+                  "wall_s", "wall_ms", "gmres_history")
 
 
 def crossed_write_boundary(t_new: float, dt: float, dt_write: float) -> bool:
@@ -806,7 +807,8 @@ class System:
                     pair_anchors=pair_anchors),
                 tol=p.gmres_tol, inner_tol=p.inner_tol,
                 restart=p.gmres_restart, maxiter=p.gmres_maxiter,
-                max_refine=p.max_refine, history=p.gmres_history)
+                max_refine=p.max_refine, history=p.gmres_history,
+                block_s=p.gmres_block_s)
         else:
             result = gmres(
                 lambda v: self._apply_matvec(state, caches, body_caches, v,
@@ -817,7 +819,8 @@ class System:
                     state, caches, body_caches, v, pair=pair,
                     pair_anchors=pair_anchors),
                 tol=p.gmres_tol, restart=p.gmres_restart,
-                maxiter=p.gmres_maxiter, history=p.gmres_history)
+                maxiter=p.gmres_maxiter, history=p.gmres_history,
+                block_s=p.gmres_block_s)
 
         fib_size, shell_size, body_size = self._sizes(state)
         new_state = state
@@ -1310,6 +1313,13 @@ class System:
                     "step": n_steps - 1,
                     "t": t_cur, "dt": dt, "iters": int(info.iters),
                     "gmres_cycles": int(info.cycles),
+                    # dot-product psum rounds this solve paid through the
+                    # rdot seam (the s-step lever; `gmres.collective_rounds`
+                    # — restart= floors boundaries at ceil(iters/restart)
+                    # so mixed-precision inner restarts still register)
+                    "collective_rounds": collective_rounds(
+                        info.iters, info.cycles, p.gmres_block_s,
+                        restart=p.gmres_restart),
                     "residual": residual,
                     "residual_true": float(info.residual_true),
                     "fiber_error": fiber_error, "accepted": accept,

@@ -28,7 +28,7 @@ def test_bench_prints_one_parseable_json_line(tmp_path):
     env.update({"BENCH_FORCE_CPU": "1", "BENCH_BUDGET_S": "120",
                 "BENCH_PROBE_S": "1",
                 # keep this smoke run's partial ladder out of the real
-                # MULTICHIP_r06.json artifact, and its span stream out of
+                # MULTICHIP round artifact, and its span stream out of
                 # the real .bench_trace.jsonl (the parent DELETES the
                 # trace path at startup)
                 "BENCH_MULTICHIP_PATH": str(tmp_path / "MULTICHIP.json"),

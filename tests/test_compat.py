@@ -1,0 +1,129 @@
+"""Direct coverage for `parallel.compat` — the jax-version seam itself.
+
+The seam un-broke 27 seed tests (PR 3) but until ISSUE 8 had no tests of
+its own: everything exercised it only through the big SPMD programs. These
+pin the three behaviors the call sites rely on, fast-tier sized:
+
+* `shard_map` routes to whatever API the running jax ships, and the 0.4.x
+  fallback ALWAYS disables replication checking (`check_rep=False`) — the
+  old checker has no while/scan rule, and every solver loop here is a
+  `lax.while_loop` (requesting `check_vma=True` must still build);
+* `use_mesh` yields a context manager on every jax (modern `jax.set_mesh`
+  or the legacy Mesh-as-context), None being a no-op;
+* `fused_ring_mode` selects the ring transfer path at build time:
+  ppermute on CPU / non-pallas tiles / explicit opt-out, the fused Pallas
+  kernel only where the backend can compile it.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from skellysim_tpu.parallel import make_mesh
+from skellysim_tpu.parallel.compat import (fused_ring_mode, shard_map,
+                                           use_mesh)
+from skellysim_tpu.parallel.mesh import FIBER_AXIS
+
+
+def test_shard_map_fallback_selection():
+    """The wrapper uses `jax.shard_map` where it exists, else the 0.4.x
+    experimental spelling — exactly one of the two, chosen by presence."""
+    mesh = make_mesh(2)
+    f = shard_map(lambda x: lax.psum(x, FIBER_AXIS), mesh=mesh,
+                  in_specs=(P(FIBER_AXIS),), out_specs=P(FIBER_AXIS))
+    x = jnp.arange(8, dtype=jnp.float32)
+    out = f(x)
+    # psum of per-shard partials: every element = sum of its shard pair
+    expected = jnp.repeat(x.reshape(2, 4).sum(0), 2).reshape(4, 2).T.reshape(-1)
+    assert jnp.allclose(out, expected)
+
+
+def test_shard_map_check_vma_survives_while_loop():
+    """check_vma=True must BUILD AND RUN a while_loop body on the pinned
+    0.4.x jax: the fallback maps it onto check_rep=False because the old
+    replication checker rejects every solver loop (the exact seed
+    breakage this seam exists to absorb)."""
+    mesh = make_mesh(4)
+
+    def local(x):
+        def cond(c):
+            _, i = c
+            return i < 3
+
+        def body(c):
+            y, i = c
+            return y + lax.psum(y, FIBER_AXIS) * 0.0 + 1.0, i + 1
+
+        y, _ = lax.while_loop(cond, body, (x, jnp.int32(0)))
+        return y
+
+    f = shard_map(local, mesh=mesh, in_specs=(P(FIBER_AXIS),),
+                  out_specs=P(FIBER_AXIS), check_vma=True)
+    out = f(jnp.zeros(8, dtype=jnp.float32))
+    assert jnp.allclose(out, 3.0)
+
+
+def test_use_mesh_none_and_mesh():
+    with use_mesh(None):
+        pass  # no-op context
+    mesh = make_mesh(2)
+    with use_mesh(mesh):
+        # inside the active-mesh context sharded computation still works
+        assert jnp.asarray(1.0) + 1.0 == 2.0
+
+
+def test_fused_ring_mode_cpu_defaults_to_ppermute(monkeypatch):
+    monkeypatch.delenv("SKELLY_FUSED_RING", raising=False)
+    # CPU backend: never the compiled fused kernel
+    assert fused_ring_mode("pallas") == "ppermute"
+
+
+def test_fused_ring_mode_non_pallas_tiles_keep_ppermute(monkeypatch):
+    monkeypatch.delenv("SKELLY_FUSED_RING", raising=False)
+    # exact/mxu/df probes must keep their tile semantics on the ring
+    for impl in ("exact", "mxu", "df", "pallas_df"):
+        assert fused_ring_mode(impl) == "ppermute", impl
+
+
+def test_fused_ring_mode_overrides(monkeypatch):
+    monkeypatch.setenv("SKELLY_FUSED_RING", "0")
+    assert fused_ring_mode("pallas") == "ppermute"
+    monkeypatch.setenv("SKELLY_FUSED_RING", "off")
+    assert fused_ring_mode("pallas") == "ppermute"
+    # interpret opt-in selects the interpreter kernel even off-TPU (its
+    # remote-DMA emulation is a jax-version capability; selection is not
+    # execution)
+    monkeypatch.setenv("SKELLY_FUSED_RING", "interpret")
+    assert fused_ring_mode("pallas") == "fused-interpret"
+    # but the opt-out beats impl gating either way
+    monkeypatch.setenv("SKELLY_FUSED_RING", "ppermute")
+    assert fused_ring_mode("pallas") == "ppermute"
+
+
+def test_fused_ring_mode_tpu_selects_fused(monkeypatch):
+    monkeypatch.delenv("SKELLY_FUSED_RING", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert fused_ring_mode("pallas") == "fused"
+    assert fused_ring_mode("exact") == "ppermute"
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="compiled fused ring needs a TPU backend")
+def test_fused_ring_executes_on_tpu():
+    """On real hardware the fused kernel must agree with the ppermute ring
+    (same tile math, same accumulation order) to f32 tile tolerance."""
+    import numpy as np
+
+    from skellysim_tpu.ops import kernels
+    from skellysim_tpu.parallel.ring import ring_stokeslet
+
+    rng = np.random.default_rng(0)
+    n = 512
+    r = jnp.asarray(rng.uniform(-1, 1, (n, 3)), dtype=jnp.float32)
+    f = jnp.asarray(rng.standard_normal((n, 3)), dtype=jnp.float32)
+    mesh = make_mesh(min(4, len(jax.devices())))
+    ref = kernels.stokeslet_direct(r, r, f, 1.0)
+    u = ring_stokeslet(r, r, f, 1.0, mesh=mesh, impl="pallas")
+    assert float(jnp.abs(u - ref).max()) < 5e-5

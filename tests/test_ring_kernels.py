@@ -204,3 +204,69 @@ def test_ring_pallas_impl_matches_single_program():
     out64 = ring_stokeslet(r64, r64, f64, 1.2, mesh=mesh, impl="pallas")
     ref64 = ring_stokeslet(r64, r64, f64, 1.2, mesh=mesh, impl="exact")
     np.testing.assert_array_equal(np.asarray(out64), np.asarray(ref64))
+
+
+# ------------------------------------------------------- fused ring (ISSUE 8)
+
+def test_fused_ring_traces_with_correct_shapes():
+    """The fused Pallas ring kernel (`parallel.ring_fused`) abstract-evals
+    inside shard_map with the ring contract's shapes — compiled execution
+    is TPU-only (tests/test_compat.py::test_fused_ring_executes_on_tpu),
+    but shape/trace regressions must fail on CPU CI too."""
+    from jax.sharding import PartitionSpec as P
+
+    from skellysim_tpu.parallel.compat import shard_map
+    from skellysim_tpu.parallel.ring_fused import fused_ring_block_sum
+
+    mesh = make_mesh(4)
+    st = jax.ShapeDtypeStruct((64, 3), jnp.float32)
+    out = jax.eval_shape(
+        shard_map(lambda r, s, f: fused_ring_block_sum(
+            "stokeslet", r, s, f, axis_name="fib", n_dev=4),
+            mesh=mesh, in_specs=(P("fib"),) * 3, out_specs=P("fib"),
+            check_vma=False), st, st, st)
+    assert out.shape == (64, 3) and out.dtype == jnp.float32
+    # stresslet family: [ns, 3, 3] payload
+    out = jax.eval_shape(
+        shard_map(lambda r, s, f: fused_ring_block_sum(
+            "stresslet", r, s, f, axis_name="fib", n_dev=4),
+            mesh=mesh,
+            in_specs=(P("fib"), P("fib"), P("fib", None, None)),
+            out_specs=P("fib"), check_vma=False),
+        st, st, jax.ShapeDtypeStruct((64, 3, 3), jnp.float32))
+    assert out.shape == (64, 3)
+
+
+def test_fused_ring_fits_budget():
+    from skellysim_tpu.parallel.ring_fused import (_VMEM_PAIR_BUDGET,
+                                                   fused_ring_fits)
+
+    assert fused_ring_fits("stokeslet", 64, 64, 8)
+    assert fused_ring_fits("stresslet", 512, 2048, 8)
+    # beyond the whole-block VMEM budget: bandwidth-bound, keep ppermute
+    assert not fused_ring_fits("stokeslet", 4096, 4096, 8)
+    assert 4096 * 4096 > _VMEM_PAIR_BUDGET
+    # the n_dev-slot comm buffer has its own budget (slots are never
+    # reused within an instance — the ring-safety scheme)
+    assert not fused_ring_fits("stresslet", 8, 2048, 256)
+    # unknown kernel families never take the fused path
+    assert not fused_ring_fits("oseen", 8, 8, 8)
+
+
+def test_ring_cpu_build_selects_ppermute(mesh, cloud):
+    """On the CPU backend the build-time seam keeps every ring on
+    ppermute — results bit-match a build with the fused path explicitly
+    disabled (i.e. the dispatch really did not take the fused branch)."""
+    r_src, r_trg, f = cloud
+    rs, rt, f32 = (r_src.astype(jnp.float32), r_trg.astype(jnp.float32),
+                   f.astype(jnp.float32))
+    u_default = ring_stokeslet(rs, rt, f32, 1.0, mesh=mesh, impl="exact")
+    import os
+
+    os.environ["SKELLY_FUSED_RING"] = "0"
+    try:
+        jax.clear_caches()
+        u_off = ring_stokeslet(rs, rt, f32, 1.0, mesh=mesh, impl="exact")
+    finally:
+        os.environ.pop("SKELLY_FUSED_RING", None)
+    assert np.array_equal(np.asarray(u_default), np.asarray(u_off))

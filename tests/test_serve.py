@@ -322,6 +322,33 @@ def test_cancel_queued_tenant(server):
     assert done == ["finished", "finished", "cancelled"]
 
 
+def test_record_ttl_expires_terminal_records(server):
+    """`[serve] record_ttl_s`: terminal tenant records expire that long
+    after retirement (bounded retention — docs/serving.md); live tenants
+    and records inside the TTL survive; 0 (the default) disables expiry."""
+    r = _submit(server, _tenant_cfg(0.55), t_final=0.0)
+    _drain(server)
+    tid = r["tenant"]
+    assert server.handle_request({"type": "status", "tenant": tid})["ok"]
+    old_ttl = server.serve_cfg.record_ttl_s
+    try:
+        server.serve_cfg.record_ttl_s = 60.0
+        server.tick()                      # inside the TTL: record survives
+        assert server.handle_request({"type": "status", "tenant": tid})["ok"]
+        # age the record past the TTL instead of sleeping (fast tier)
+        server.registry.get(tid).retired_at -= 120.0
+        resp = server.handle_request({"type": "status", "tenant": tid})
+        assert not resp["ok"] and "unknown tenant" in resp["error"]
+        # a live (running/queued) tenant has no retirement clock at all
+        r2 = _submit(server, _tenant_cfg(0.6))
+        assert server.registry.get(r2["tenant"]).retired_at is None
+        _drain(server)
+        assert server.handle_request(
+            {"type": "status", "tenant": r2["tenant"]})["ok"]
+    finally:
+        server.serve_cfg.record_ttl_s = old_ttl
+
+
 def test_explicit_zero_t_final(server):
     """A requested t_final of 0.0 is honored (no falsy substitution of the
     config's): the tenant admits and retires without stepping."""

@@ -240,3 +240,46 @@ def test_run_loop_donating_jit_marks_consumption():
     for name in ("step_single_donated", "step_single"):
         findings = run_program_audit(get_program(name), checks=["donation"])
         assert [f.render() for f in findings] == [], name
+
+
+@pytest.mark.slow
+def test_spmd_block_s4_coupled_parity(coupled_parts):
+    """ISSUE 8: the communication-avoiding solver (gmres_block_s=4 — the
+    configuration the d8 audit contract pins) solves the coupled scene on
+    the mesh to the same backend-agreement gate as the single program, at
+    the sequential cycle's iteration count."""
+    params = Params(**PARAMS, gmres_block_s=4)
+    sys_ref = System(params, shell_shape=SHAPE)
+    _, sol_ref, info_ref = sys_ref.step(_coupled_state(sys_ref,
+                                                       coupled_parts))
+    assert bool(info_ref.converged)
+
+    mesh = make_mesh(N_DEV)
+    sys_sp = System(params, shell_shape=SHAPE)
+    state = shard_state(_coupled_state(sys_sp, coupled_parts), mesh)
+    _, sol_sp, info_sp = sys_sp.step_spmd(state, mesh)
+    assert bool(info_sp.converged)
+    assert abs(float(info_sp.residual_true)
+               - float(info_ref.residual_true)) <= GATE
+    np.testing.assert_allclose(np.asarray(sol_sp), np.asarray(sol_ref),
+                               atol=GATE)
+
+    # sequential-cycle reference on the SAME mesh scene: the s-step basis
+    # must not cost extra iterations (ISSUE 8 acceptance: within 10%)
+    sys_s1 = System(Params(**PARAMS), shell_shape=SHAPE)
+    state1 = shard_state(_coupled_state(sys_s1, coupled_parts), mesh)
+    _, _, info_s1 = sys_s1.step_spmd(state1, mesh)
+    assert int(info_sp.iters) <= int(np.ceil(1.1 * int(info_s1.iters) / 4) * 4)
+
+
+def test_spmd_contract_pins_batched_gram_rounds(spmd_audit):
+    """The updated d8 contract IS the s-step pin (ISSUE 8 acceptance): the
+    largest psum operand is the batched [(m+1)+s, s] Gram block — the
+    sequential [m+1] per-iteration reduction shape is gone from the
+    inventory, and the solver loop pays 2 rounds per s=4 iterations
+    instead of 3 per iteration (>= 3x fewer rounds per cycle)."""
+    findings, contract = spmd_audit
+    assert [f.render() for f in findings] == []
+    ar = contract["collectives"]["all_reduce"]
+    # (gmres_restart rounded to a block multiple + 1 + s) * s = 420
+    assert ar["max_elems"] == (100 + 1 + 4) * 4
