@@ -117,6 +117,18 @@ print(f"serve smoke ok: 2 tenants finished, "
 EOF
 rm -rf "$SERVE_TMP"
 
+echo "== guard: skelly-guard chaos smoke (docs/robustness.md) =="
+# fault injection against the REAL service, in EVERY tier: NaN one
+# tenant's lane -> status=failed with a verdict while its bucket sibling
+# streams to completion; then SIGKILL the server mid-flight and restart
+# it on the same write-ahead journal -> the live tenant is re-admitted
+# and finishes. ~60 s (two server boots; the second reuses the first's
+# .jax_cache so recovery pays recovery latency, not compile latency).
+CHAOS_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python -m skellysim_tpu.guard.smoke "$CHAOS_TMP" \
+  || { echo "guard chaos smoke failed" >&2; rm -rf "$CHAOS_TMP"; exit 1; }
+rm -rf "$CHAOS_TMP"
+
 echo "== docs: config reference in sync with the schema =="
 JAX_PLATFORMS=cpu python scripts/gen_config_reference.py --check
 

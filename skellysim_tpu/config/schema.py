@@ -175,6 +175,14 @@ class Params:
     # communication-avoiding s-step GMRES block size (1 = the sequential
     # cycle; see skellysim_tpu/params.py `gmres_block_s` for semantics)
     gmres_block_s: int = 1
+    # skelly-guard device-side escalation ladder (all default OFF; see
+    # skellysim_tpu/params.py `guard_*` and docs/robustness.md): on a
+    # retryable solver health verdict, retry the trial at halved dt up to
+    # N times, then fall back gmres_block_s -> 1, then the full-f64 dense
+    # Krylov interior, before declaring the member failed
+    guard_dt_halvings: int = 0
+    guard_block_fallback: bool = False
+    guard_f64_fallback: bool = False
     fiber_error_tol: float = 0.1
     seed: int = 130319
     implicit_motor_activation_delay: float = 0.0
@@ -535,6 +543,26 @@ class ServeConfig:
     #: shutdown). An expired tenant answers "unknown tenant" — clients
     #: must fetch snapshots/frames within the TTL.
     record_ttl_s: float = 0.0
+    #: crash-safe write-ahead tenant journal (serve.journal,
+    #: docs/robustness.md): append-only trajectory-v1 snapshots on
+    #: admit / evict / every `journal_every` rounds. A restarted server
+    #: pointed at the same path re-admits every live tenant from the
+    #: journal with at most `journal_every` rounds of replay. Empty =
+    #: journaling off (the pre-guard behavior: a killed server loses its
+    #: tenants).
+    journal_path: str = ""
+    #: checkpoint cadence (batched rounds) for live lanes when journaling;
+    #: the bound on replay after a crash. Must be >= 1 when journaling.
+    journal_every: int = 8
+    #: allow `chaos` requests (guard.chaos fault injection — the CI chaos
+    #: smoke and the fault-injection tests). NEVER enable in production:
+    #: a chaos request deliberately poisons tenant state.
+    chaos_enabled: bool = False
+    #: per-connection frame-size bound (bytes): a header claiming more
+    #: answers a structured error and the connection survives
+    #: (protocol.FrameDecoder skip mode); the default matches
+    #: protocol.MAX_FRAME_BYTES
+    max_frame_bytes: int = 1 << 31
 
 
 def load_serve_config(path: str) -> ServeConfig:
@@ -559,6 +587,12 @@ def load_serve_config(path: str) -> ServeConfig:
         raise ValueError(f"{path}: [serve] bucket_capacities must be >= 1")
     if cfg.send_timeout_s <= 0:
         raise ValueError(f"{path}: [serve] send_timeout_s must be > 0")
+    if cfg.journal_path and cfg.journal_every < 1:
+        raise ValueError(f"{path}: [serve] journal_every must be >= 1 "
+                         "when journal_path is set")
+    if cfg.max_frame_bytes < 1 << 16:
+        raise ValueError(f"{path}: [serve] max_frame_bytes must be >= 64 KiB "
+                         "(a single status response must fit)")
     return cfg
 
 
@@ -725,6 +759,9 @@ def to_runtime_params(p: Params) -> runtime_params.Params:
         t_final=p.t_final,
         gmres_tol=p.gmres_tol,
         gmres_block_s=p.gmres_block_s,
+        guard_dt_halvings=p.guard_dt_halvings,
+        guard_block_fallback=p.guard_block_fallback,
+        guard_f64_fallback=p.guard_f64_fallback,
         fiber_error_tol=p.fiber_error_tol,
         seed=p.seed,
         implicit_motor_activation_delay=p.implicit_motor_activation_delay,

@@ -106,7 +106,11 @@ def run(sweep_file: str, output_dir: str | None = None,
             sched = EnsembleScheduler(
                 runner, members, batch or spec.batch, writer=writers,
                 metrics=metrics, write_initial_frames=True,
-                on_dt_underflow="retire")
+                on_dt_underflow="retire",
+                # quarantine, not abort: one poisoned member must not take
+                # down a 10k-member sweep (docs/robustness.md) — its
+                # "failed" record + verdict land in the metrics JSONL
+                on_failure="retire")
             retired = sched.run()
     finally:
         # close even when the drain raises (System.run's tracer lifecycle)

@@ -42,6 +42,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..guard import verdict as _verdict
 from ..system.system import SimState, System
 
 
@@ -80,6 +81,18 @@ class EnsembleStepInfo(NamedTuple):
     #: [B, gmres_history, 3] per-member convergence ring buffers
     #: (`solver.gmres` docstring), or None when Params.gmres_history == 0
     history: jnp.ndarray | None = None
+    #: [B] int32 packed health words (`guard.verdict` bit layout: the
+    #: solver's nonfinite/stagnation/breakdown bits plus the dt_underflow
+    #: bit stamped here) — 0 = healthy lane
+    health: jnp.ndarray = 0
+    #: [B] terminal-verdict quarantine mask: the lane carries a verdict no
+    #: retry can repair (`verdict.is_terminal`) and was frozen un-advanced
+    #: this round — the scheduler retires it as ``failed`` (siblings'
+    #: leaves are bitwise-unaffected: frozen lanes are masked selects,
+    #: exactly like rejected and finished lanes)
+    failed: jnp.ndarray = False
+    #: [B] guard-ladder retries this round (`StepInfo.guard_retries`)
+    guard_retries: jnp.ndarray = 0
 
 
 def _check_member(i, template_leaves, state):
@@ -235,8 +248,11 @@ class EnsembleRunner:
         conv = infos.converged
         # the host loop's ladder runs in Python floats (f64); matching it
         # bitwise for any state dtype means doing the dt/t arithmetic in f64
-        # and casting back only at the state boundary
-        dt64 = states.dt.astype(jnp.float64)
+        # and casting back only at the state boundary. The dt that actually
+        # advanced is infos.dt_used — identical to states.dt unless the
+        # guard escalation ladder retried at a halved dt (guard.escalate)
+        dt_used = jnp.asarray(infos.dt_used, dtype=states.dt.dtype)
+        dt64 = dt_used.astype(jnp.float64)
         ferr64 = infos.fiber_error.astype(jnp.float64)
         false_lanes = jnp.zeros_like(conv)
         if p.adaptive_timestep_flag:
@@ -256,10 +272,24 @@ class EnsembleRunner:
             coll = false_lanes
             dt_underflow = false_lanes
 
+        # the packed per-lane health word: the solver/step verdicts plus
+        # the dt_underflow bit stamped here (guard.verdict layout). A lane
+        # whose verdict is TERMINAL (nonfinite — no dt can repair a
+        # poisoned state) is quarantined: frozen un-advanced this round
+        # and flagged `failed` for the scheduler to retire. dt_underflow
+        # keeps its dedicated path (on_dt_underflow policy), bit included.
+        health = (jnp.asarray(infos.health, dtype=jnp.int32)
+                  | jnp.where(dt_underflow,
+                              jnp.int32(_verdict.DT_UNDERFLOW),
+                              jnp.int32(0)))
+        failed = running & _verdict.is_terminal(health) & ~dt_underflow
+
         # the sequential loop raises BEFORE applying an underflowed update,
-        # leaving the state untouched: frozen lanes here do the same
-        advance = running & accept & ~dt_underflow
-        reject = running & ~accept & ~dt_underflow
+        # leaving the state untouched: frozen (underflowed or quarantined)
+        # lanes here do the same — masked selects, so sibling lanes'
+        # leaves are bitwise-unaffected (pinned by tests/test_ensemble.py)
+        advance = running & accept & ~dt_underflow & ~failed
+        reject = running & ~accept & ~dt_underflow & ~failed
 
         merged = _where_lanes(advance, new_states, states)
         t_new64 = states.time.astype(jnp.float64) + dt64
@@ -277,11 +307,16 @@ class EnsembleRunner:
                 jnp.asarray(infos.refines, dtype=jnp.int32), conv.shape),
             loss_of_accuracy=jnp.broadcast_to(
                 jnp.asarray(infos.loss_of_accuracy), conv.shape),
-            collided=coll, dt_underflow=dt_underflow, dt_used=states.dt,
+            collided=coll, dt_underflow=dt_underflow, dt_used=dt_used,
             t=merged.time, dt_next=merged.dt, solutions=solutions,
             cycles=jnp.broadcast_to(
                 jnp.asarray(infos.cycles, dtype=jnp.int32), conv.shape),
-            history=infos.history)
+            history=infos.history,
+            health=jnp.broadcast_to(health, conv.shape),
+            failed=jnp.broadcast_to(failed, conv.shape),
+            guard_retries=jnp.broadcast_to(
+                jnp.asarray(infos.guard_retries, dtype=jnp.int32),
+                conv.shape))
         return EnsembleState(states=merged, t_final=ens.t_final), info
 
     def step(self, ens: EnsembleState):

@@ -25,6 +25,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..guard import verdict as _verdict
 from ..obs import tracer as obs_tracer
 from ..solver.gmres import history_rows
 from ..system.system import SimState, crossed_write_boundary
@@ -81,16 +82,27 @@ class EnsembleScheduler:
     the rest of the sweep running — the serving-shaped choice for large
     sweeps.
 
+    ``on_failure``: what to do with a lane the runner quarantined on a
+    TERMINAL health verdict (`EnsembleStepInfo.failed` — a nonfinite
+    state no retry can repair; docs/robustness.md). "raise" (default)
+    mirrors the sequential loop's eventual abort; "retire" (skelly-serve)
+    retires just that member with reason ``"failed"`` — its metrics
+    record and `on_retire` callback carry the decoded verdict, and its
+    siblings' trajectories are bitwise-unaffected (the quarantine pin in
+    tests/test_ensemble.py).
+
     ``template`` allows an INITIALLY-EMPTY scheduler (``members=[]``): a
     long-lived service (skelly-serve) constructs the compiled lanes before
     any tenant exists, then feeds them incrementally via `admit` + `poll`.
     The template state defines the lanes' static shapes — the capacity
     bucket every later member must match.
 
-    ``on_retire(member_id, state, reason)`` receives the member's FINAL lane
-    state the moment before its lane is freed — the exact snapshot point
-    (possibly newer than its last dt_write frame); skelly-serve stores it
-    for tenant snapshot/resume.
+    ``on_retire(member_id, state, reason, **extra)`` receives the member's
+    FINAL lane state the moment before its lane is freed — the exact
+    snapshot point (possibly newer than its last dt_write frame);
+    skelly-serve stores it for tenant snapshot/resume. ``extra`` carries
+    structured failure context (``health``/``verdict``) on ``failed`` and
+    ``dt_underflow`` retirements.
     """
 
     def __init__(self, runner: EnsembleRunner, members, batch: int, *,
@@ -99,6 +111,7 @@ class EnsembleScheduler:
                  step_fn: Optional[Callable] = None,
                  write_initial_frames: bool = False,
                  on_dt_underflow: str = "raise",
+                 on_failure: str = "raise",
                  max_rounds: Optional[int] = None,
                  template: Optional[SimState] = None,
                  on_retire: Optional[Callable] = None):
@@ -108,6 +121,9 @@ class EnsembleScheduler:
             raise ValueError(
                 f"unknown on_dt_underflow {on_dt_underflow!r}; "
                 "use 'raise' or 'retire'")
+        if on_failure not in ("raise", "retire"):
+            raise ValueError(
+                f"unknown on_failure {on_failure!r}; use 'raise' or 'retire'")
         members = list(members)
         if not members and template is None:
             raise ValueError("ensemble needs at least one member (or a "
@@ -120,6 +136,7 @@ class EnsembleScheduler:
         self.step_fn = step_fn or runner.step
         self.write_initial_frames = write_initial_frames
         self.on_dt_underflow = on_dt_underflow
+        self.on_failure = on_failure
         self.on_retire = on_retire
         self.max_rounds = max_rounds
         self.rounds = 0
@@ -171,8 +188,9 @@ class EnsembleScheduler:
                     spec.member_id, lane, spec.t_final)
 
     def _retire_member(self, lane: int, reason: str = "finished",
-                       final_state=None):
+                       final_state=None, extra: Optional[dict] = None):
         ln = self.lanes[lane]
+        extra = extra or {}
         if self.on_retire is not None:
             # the member's exact final state, before the lane is reused —
             # the snapshot skelly-serve resumes evicted tenants from
@@ -180,13 +198,13 @@ class EnsembleScheduler:
             # gathering the lane twice)
             if final_state is None:
                 final_state = lane_state(self.ens.states, lane)
-            self.on_retire(ln.spec.member_id, final_state, reason)
+            self.on_retire(ln.spec.member_id, final_state, reason, **extra)
         obs_tracer.emit("lane", action="retire", lane=lane,
                         member=ln.spec.member_id, reason=reason,
-                        steps=ln.steps)
+                        steps=ln.steps, **extra)
         self._emit({"event": "retire" if reason == "finished" else reason,
                     "member": ln.spec.member_id, "lane": lane, "t": ln.t,
-                    "steps": ln.steps, "frames": ln.frames})
+                    "steps": ln.steps, "frames": ln.frames, **extra})
         logger.info("ensemble retire member=%s lane=%d t=%.6g steps=%d (%s)",
                     ln.spec.member_id, lane, ln.t, ln.steps, reason)
         self.retired.append(ln.spec.member_id)
@@ -292,7 +310,8 @@ class EnsembleScheduler:
                                  "residual", "residual_true",
                                  "fiber_error", "refines",
                                  "loss_of_accuracy", "dt_underflow",
-                                 "dt_used", "t", "dt_next", "cycles")}
+                                 "dt_used", "t", "dt_next", "cycles",
+                                 "health", "failed", "guard_retries")}
             hist = (np.asarray(info.history)
                     if info.history is not None else None)
             wall_s = _time.perf_counter() - wall0
@@ -310,8 +329,28 @@ class EnsembleScheduler:
                 continue
             accepted = bool(fetched["accepted"][lane])
             underflow = bool(fetched["dt_underflow"][lane])
+            failed = bool(fetched["failed"][lane])
+            health = int(fetched["health"][lane])
             dt_used = float(fetched["dt_used"][lane])
             t_new = float(fetched["t"][lane])
+            if failed:
+                # terminal health verdict: the runner froze the lane
+                # un-advanced (quarantine — siblings bitwise-unaffected);
+                # retire it as "failed" with the decoded verdict, or
+                # mirror the sequential loop's abort
+                verdict_s = _verdict.describe(health)
+                obs_tracer.emit("fault", kind="lane_failed", lane=lane,
+                                member=ln.spec.member_id, health=health,
+                                verdict=verdict_s, t=ln.t)
+                if self.on_failure == "raise":
+                    raise RuntimeError(
+                        f"ensemble member {ln.spec.member_id}: terminal "
+                        f"solver health verdict '{verdict_s}' "
+                        f"(health={health:#x}) at t={ln.t:.6g}")
+                self._retire_member(lane, reason="failed",
+                                    extra={"health": health,
+                                           "verdict": verdict_s})
+                continue
             if underflow:
                 # the sequential loop raises before writing this trial's
                 # metrics line — no step record here either
@@ -320,7 +359,13 @@ class EnsembleScheduler:
                         f"ensemble member {ln.spec.member_id}: timestep "
                         f"smaller than dt_min ({p.dt_min}) at t={ln.t:.6g}"
                     )
-                self._retire_member(lane, reason="dt_underflow")
+                obs_tracer.emit("fault", kind="dt_underflow", lane=lane,
+                                member=ln.spec.member_id, health=health,
+                                t=ln.t)
+                self._retire_member(lane, reason="dt_underflow",
+                                    extra={"health": health,
+                                           "verdict":
+                                               _verdict.describe(health)})
                 continue
             ln.steps += 1
             self._emit({
@@ -336,6 +381,8 @@ class EnsembleScheduler:
                 "refines": int(fetched["refines"][lane]),
                 "loss_of_accuracy": bool(
                     fetched["loss_of_accuracy"][lane]),
+                "health": health,
+                "guard_retries": int(fetched["guard_retries"][lane]),
                 "wall_s": round(wall_s, 4),
                 "wall_ms": round(wall_s * 1e3, 3),
                 "gmres_history": history_rows(
@@ -357,11 +404,13 @@ class EnsembleScheduler:
 
 def run_ensemble(system, members, batch: int = 8, *, batch_impl: str = "vmap",
                  writer=None, metrics=None, write_initial_frames: bool = False,
-                 on_dt_underflow: str = "raise", max_rounds=None) -> list:
+                 on_dt_underflow: str = "raise", on_failure: str = "raise",
+                 max_rounds=None) -> list:
     """One-call convenience: build an `EnsembleRunner` over ``system`` and
     drain ``members`` (a MemberSpec iterable) through ``batch`` lanes."""
     runner = EnsembleRunner(system, batch_impl=batch_impl)
     return EnsembleScheduler(
         runner, members, batch, writer=writer, metrics=metrics,
         write_initial_frames=write_initial_frames,
-        on_dt_underflow=on_dt_underflow, max_rounds=max_rounds).run()
+        on_dt_underflow=on_dt_underflow, on_failure=on_failure,
+        max_rounds=max_rounds).run()

@@ -1,0 +1,36 @@
+"""skelly-guard: device-side solver health verdicts, escalation, quarantine.
+
+The robustness layer (docs/robustness.md). The reference SkellySim aborts
+the whole MPI job when a fiber solve loses accuracy or GMRES stalls
+(`solver_hydro.cpp:85-92` warns, the run loop raises); a long-lived
+multi-tenant service (skelly-serve) needs the opposite: one tenant's
+divergence must never take down the batch, and a killed server must come
+back with every tenant intact. Four legs:
+
+* `guard.verdict` — the packed per-member health word computed INSIDE the
+  solver loops (`jnp.isfinite` + masked reductions, no host sync: audit's
+  host-sync contract stays empty) and threaded through
+  `GmresResult.health` -> `StepInfo.health` -> `EnsembleStepInfo.health`;
+* `guard.escalate` — the bounded device-side retry ladder
+  (`Params.guard_*`): halve dt, fall back `gmres_block_s -> 1`, route the
+  Krylov interior through the full-f64 dense path, before a member is
+  declared failed. One implementation serves sequential `System.run` and
+  the vmapped ensemble (the ladder stages are max-one-trip `while_loop`s,
+  so a healthy batch pays nothing);
+* quarantine — the ensemble scheduler retires lanes with terminal
+  verdicts as ``failed`` (masked inert, siblings bitwise-unaffected) and
+  skelly-serve surfaces ``status="failed"`` with the decoded verdict plus
+  a crash-safe write-ahead tenant journal (`serve.journal`);
+* `guard.chaos` — fault injectors (NaN a lane, zero a preconditioner,
+  garble wire frames, SIGKILL the server) driving the test suite and the
+  `ci/run_ci.sh` chaos smoke.
+"""
+
+from .verdict import (BREAKDOWN, DT_UNDERFLOW, HEALTH_BITS, HEALTH_OK,
+                      NONFINITE, STAGNATION, decode, is_terminal,
+                      retryable)
+
+__all__ = [
+    "HEALTH_OK", "NONFINITE", "STAGNATION", "BREAKDOWN", "DT_UNDERFLOW",
+    "HEALTH_BITS", "decode", "is_terminal", "retryable",
+]

@@ -1,0 +1,115 @@
+"""Fault injectors: deliberately break things the guard must survive.
+
+Drives the fault-injection tests (tests/test_guard.py, tests/test_serve.py,
+tests/test_ensemble.py) and the `ci/run_ci.sh` chaos smoke
+(`python -m skellysim_tpu.guard.smoke`). Four injector families, matching
+the failure modes docs/robustness.md enumerates:
+
+* `poison_state` / `poison_lane` — flip NaNs into a (lane's) state between
+  rounds: the silent-ensemble-poisoning mode the quarantine exists for;
+* `zero_preconditioner` — force GMRES stagnation by nulling the
+  preconditioner on a live `System` (the implicit residual collapses via
+  degenerate Givens rotations while the explicit one never moves — the
+  exact implicit/explicit divergence Belos warns about);
+* `garble_frame` / `truncate_frame` / `oversized_header` — wire-level
+  client-frame corruption for the protocol robustness tests;
+* `SIGKILL` — via `serve.client.SpawnedServer.kill()`; the journal
+  recovery tests own that path.
+
+Injectors are ordinary host-side functions; none are imported by
+production code paths (the serve `chaos` request imports lazily and is
+config-gated off by default).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def poison_state(state, *, value=float("nan")):
+    """``state`` with every floating leaf of its FIBER positions set to
+    ``value`` — the canonical poisoned-member injection. Shapes/dtypes are
+    untouched, so the poisoned state still rides the same compiled
+    program (`ensemble.runner.set_lane` accepts it)."""
+    from ..fibers import container as fc
+
+    def poison(g):
+        return g._replace(x=jnp.full_like(g.x, value))
+
+    buckets = tuple(poison(g) for g in fc.as_buckets(state.fibers))
+    fibers = (buckets[0] if isinstance(state.fibers, fc.FiberGroup)
+              else buckets)
+    return state._replace(fibers=fibers)
+
+
+def poison_lane(ens, lane: int, *, value=float("nan")):
+    """An `EnsembleState` with lane ``lane``'s member state poisoned
+    (between-rounds injection: assign the result back to
+    ``scheduler.ens``). Sibling lanes' leaves are returned PHYSICALLY
+    unchanged up to the one-lane `.at[].set` — the NaN-isolation pin
+    asserts their trajectories stay bitwise identical."""
+    from ..ensemble.runner import lane_state, set_lane
+
+    poisoned = poison_state(lane_state(ens.states, lane), value=value)
+    return ens._replace(states=set_lane(ens.states, lane, poisoned))
+
+
+def zero_preconditioner(system):
+    """Patch ``system`` (in place; returns it) so every preconditioner
+    application is zero — the stagnation injector. GMRES's Krylov updates
+    become A·0 = 0: the Givens recurrence zeroes the implicit residual
+    while x never moves, so the solve exits through the stall path with a
+    STAGNATION verdict (`guard.verdict`).
+
+    Patch BEFORE the system's first solve: `observed_jit` caches compiled
+    programs per call signature, so a system that already solved keeps
+    its healthy compilation for identical shapes.
+    """
+    orig = system._apply_precond
+
+    def zeroed(state, caches, body_caches, v, **kw):
+        return jnp.zeros_like(orig(state, caches, body_caches, v, **kw))
+
+    system._apply_precond = zeroed
+    return system
+
+
+# ------------------------------------------------------------ wire chaos
+
+def garble_frame(payload: bytes, *, seed: int = 0, flips: int = 16) -> bytes:
+    """``payload`` with ``flips`` deterministic byte flips — still a
+    well-FRAMED message, no longer valid msgpack (the server must answer
+    a structured error, not die)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    buf = bytearray(payload)
+    for _ in range(max(1, flips)):
+        i = int(rng.integers(0, len(buf)))
+        buf[i] ^= 0xFF
+    return bytes(buf)
+
+
+def truncate_frame(framed: bytes, keep: int) -> bytes:
+    """The first ``keep`` bytes of a framed (header + payload) message —
+    a mid-frame disconnect / partial delivery."""
+    return framed[:keep]
+
+
+def oversized_header(size: int) -> bytes:
+    """A frame header claiming ``size`` bytes (no body) — the hostile /
+    corrupt header the decoder must survive via skip mode."""
+    from ..serve import protocol
+
+    return protocol.HEADER.pack(size)
+
+
+def nan_lane_of(scheduler, member_id: str, *, value=float("nan")) -> int:
+    """Poison the lane currently running ``member_id`` on a live
+    scheduler; returns the lane index. The serve `chaos` request's
+    implementation."""
+    lane = scheduler.lane_of(member_id)
+    if lane is None:
+        raise ValueError(f"member {member_id!r} holds no lane")
+    scheduler.ens = poison_lane(scheduler.ens, lane, value=value)
+    return lane

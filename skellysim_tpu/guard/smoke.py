@@ -1,0 +1,129 @@
+"""The chaos smoke: boot a real server, break it on purpose, assert recovery.
+
+`python -m skellysim_tpu.guard.smoke WORKDIR` — exit-code gated in
+`ci/run_ci.sh` (docs/robustness.md). Two acts over one spawned serve
+subprocess (jax-free parent, like the serve smoke):
+
+1. **Quarantine**: two tenants in one capacity bucket; a `chaos`
+   request NaN-poisons tenant A's lane. A must answer ``status=failed``
+   with a nonzero nonfinite verdict while B streams to completion.
+2. **Crash recovery**: submit a longer-running tenant, SIGKILL the server
+   mid-flight, restart it on the same config + journal. The restarted
+   server must re-admit the live tenant from the write-ahead journal and
+   drive it to completion; the failed tenant's terminal record must
+   survive too.
+
+~40 s wall, dominated by the two warmup compiles (the journal recovery
+REQUIRES a second cold server — that is the point).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _scene(shift: float):
+    from ..config import BackgroundSource, Config, Fiber
+
+    cfg = Config()
+    cfg.params.dt_initial = cfg.params.dt_write = 0.005
+    cfg.params.t_final = 0.02
+    cfg.params.gmres_tol = 1e-10
+    cfg.params.adaptive_timestep_flag = False
+    fib = Fiber(n_nodes=8, length=1.0, bending_rigidity=0.01)
+    fib.fill_node_positions(np.array([shift, 0.0, 0.0]),
+                            np.array([0.0, 0.0, 1.0]))
+    cfg.fibers = [fib]
+    cfg.background = BackgroundSource(uniform=[1.0, 0.0, 0.0])
+    return cfg
+
+
+def main(workdir: str) -> int:
+    from ..config import schema
+    from ..config.toml_io import dumps as toml_dumps
+    from ..serve.client import SpawnedServer
+    from .verdict import NONFINITE
+
+    def toml_of(cfg):
+        return toml_dumps(schema.unpack(cfg))
+
+    path = os.path.join(workdir, "chaos_config.toml")
+    journal = os.path.join(workdir, "chaos_journal.bin")
+    _scene(0.0).save(path)
+    with open(path, "a") as fh:
+        fh.write('\n[serve]\nmax_lanes = 2\nbatch_impl = "unroll"\n'
+                 'chaos_enabled = true\njournal_every = 2\n'
+                 f'journal_path = "{journal}"\n')
+
+    # both servers share one persistent XLA cache: the RESTARTED server's
+    # warmup then reuses the first boot's compile (the --jax-cache
+    # pattern every CLI shares) — recovery latency, not compile latency
+    cache = ["--jax-cache", os.path.join(workdir, ".jax_cache")]
+
+    # ---- act 1: NaN quarantine, sibling survives. Tenants are seated at
+    # submit time (free lanes); the horizons are long enough (20 rounds)
+    # that the chaos request lands while A is still running.
+    srv = SpawnedServer(path, args=cache)
+    with srv.client() as c:
+        ta = c.submit(toml_of(_scene(0.1)), t_final=0.1)["tenant"]
+        tb = c.submit(toml_of(_scene(0.3)), t_final=0.1)["tenant"]
+        c.chaos("nan_lane", tenant=ta)
+        sa = c.wait(ta, timeout=120)
+        sb = c.wait(tb, timeout=120)
+        assert sa["status"] == "failed", sa
+        assert sa["health"] & NONFINITE, sa
+        assert sa["verdict"], sa
+        assert sb["status"] == "finished", sb
+        assert sb["health"] == 0, sb
+        frames_b = c.stream(tb)["frames"]
+        assert len(frames_b) >= 2, len(frames_b)
+        stats = c.stats()
+        assert stats["faults"].get("chaos_nan") == 1, stats["faults"]
+        assert stats["faults"].get("lane_failed") == 1, stats["faults"]
+        print(f"chaos smoke act 1 ok: {ta} failed "
+              f"(verdict {sa['verdict']}), {tb} finished with "
+              f"{len(frames_b)} frames")
+
+        # ---- act 2: SIGKILL mid-flight, journal recovery
+        tc = c.submit(toml_of(_scene(0.5)), t_final=0.5)["tenant"]
+        # let it run a couple of rounds (journal checkpoints every 2)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = c.status(tc)
+            if st["status"] == "running" and st["steps"] >= 2:
+                break
+            time.sleep(0.05)
+        assert st["status"] == "running", st
+        # SIGKILL while the client is still CONNECTED: a graceful
+        # disconnect would evict tc (by design) — the crash must beat it
+        srv.kill()
+    print(f"chaos smoke: server SIGKILLed with {tc} at t={st['t']:g}")
+
+    srv2 = SpawnedServer(path, args=cache)
+    try:
+        with srv2.client() as c:
+            # live tenant re-admitted from the journal...
+            sc = c.wait(tc, timeout=120)
+            assert sc["status"] == "finished", sc
+            assert abs(sc["t"] - 0.5) < 1e-9, sc
+            # ...and the failed/finished records survived the crash
+            assert c.status(ta)["status"] == "failed", c.status(ta)
+            assert c.status(tb)["status"] == "finished", c.status(tb)
+            stats = c.stats()
+            assert stats["journal"], stats
+        rc = srv2.stop()
+        assert rc == 0, f"restarted server exited rc={rc}"
+    finally:
+        if srv2._proc.poll() is None:
+            srv2._proc.kill()
+    print(f"chaos smoke act 2 ok: {tc} recovered from journal and "
+          f"finished after SIGKILL; terminal records intact")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

@@ -1,0 +1,106 @@
+"""The packed solver health word: bit layout + device/host helpers.
+
+One int32 word per member, computed inside the jitted solver loops and
+carried next to `loss_of_accuracy` through every step-info surface
+(`solver.gmres.GmresResult.health`, `system.StepInfo.health`,
+`ensemble.runner.EnsembleStepInfo.health`). Bits are ORed as conditions
+are observed within one solve attempt; a guard-ladder retry
+(`guard.escalate`) REPLACES the word with the retried attempt's — so
+``health == 0`` always means "the step that advanced was healthy", and
+``StepInfo.guard_retries`` records that escalation happened. ``0`` is a
+healthy solve.
+
+Import discipline: jax-free at module import (the bit constants and
+`decode` serve jax-free surfaces — the serve client, `obs summarize`);
+the two device-side predicates import jax.numpy lazily.
+
+Bit layout (docs/robustness.md):
+
+======  ============  =====================================================
+bit     name          set when
+======  ============  =====================================================
+0x1     nonfinite     NaN/Inf in the RHS, the explicit residual, or the
+                      post-advance fiber error — the poisoned-lane signal
+0x2     stagnation    the solve exited without reaching tol: the explicit
+                      residual stopped improving across a restart (< 2x
+                      per cycle with the implicit test converged) or the
+                      iteration/refinement budget ran out
+0x4     breakdown     the s-step cycle's Cholesky-ridge column recovery
+                      hit its noise-floor breakdown and ended a cycle
+                      early (`solver.gmres._chol_ridge` path)
+0x8     dt_underflow  the adaptive dt ladder fell below `Params.dt_min`
+                      (stamped by the step/ensemble layer, not the solver)
+======  ============  =====================================================
+
+``terminal`` verdicts (`is_terminal`) quarantine a lane: ``nonfinite`` and
+``dt_underflow`` — no retry at any dt can repair a poisoned state or a
+vanished timestep. ``stagnation``/``breakdown`` are retryable: the
+escalation ladder (`guard.escalate`) and the host adaptive-dt loop both
+get a shot before the member is declared failed.
+"""
+
+from __future__ import annotations
+
+HEALTH_OK = 0
+NONFINITE = 1 << 0
+STAGNATION = 1 << 1
+BREAKDOWN = 1 << 2
+DT_UNDERFLOW = 1 << 3
+
+#: name -> bit, in bit order (the decode table; docs/robustness.md)
+HEALTH_BITS = {
+    "nonfinite": NONFINITE,
+    "stagnation": STAGNATION,
+    "breakdown": BREAKDOWN,
+    "dt_underflow": DT_UNDERFLOW,
+}
+
+#: verdicts no retry can repair (quarantine triggers)
+TERMINAL_MASK = NONFINITE | DT_UNDERFLOW
+
+
+def decode(word) -> list:
+    """Host-side: the set bit names of one health word, bit order.
+    ``decode(0) == []`` (healthy)."""
+    w = int(word)
+    return [name for name, bit in HEALTH_BITS.items() if w & bit]
+
+
+def describe(word) -> str:
+    """Host-side log/status spelling: ``"stagnation|breakdown"`` or
+    ``"ok"``."""
+    names = decode(word)
+    return "|".join(names) if names else "ok"
+
+
+def nonfinite_word(value):
+    """Device-side (traced): an int32 word carrying NONFINITE where
+    ``value`` is not finite, else 0 — THE one spelling of the
+    nonfinite-stamp rule, shared by the solver entry/exit checks
+    (`solver.gmres`), the step-level fiber-error check
+    (`system._solve_once`), and the SPMD step (`parallel.spmd`), so the
+    rule cannot drift between them. OR it into a health word:
+    ``health | nonfinite_word(resid)``."""
+    import jax.numpy as jnp
+
+    return jnp.where(jnp.isfinite(value), jnp.int32(0),
+                     jnp.int32(NONFINITE))
+
+
+def is_terminal(word):
+    """Device-side (traced) or host-side: True where the word carries a
+    verdict quarantine must act on (nonfinite / dt_underflow)."""
+    import jax.numpy as jnp
+
+    return (jnp.asarray(word, dtype=jnp.int32) & TERMINAL_MASK) != 0
+
+
+def retryable(word):
+    """Device-side (traced) or host-side: True where the word is bad but
+    NOT terminal — the escalation ladder's retry predicate. A nonfinite
+    or underflowed member is past saving; everything else gets the
+    ladder."""
+    import jax.numpy as jnp
+
+    w = jnp.asarray(word, dtype=jnp.int32)
+    return (w != 0) & ((w & TERMINAL_MASK) == 0)

@@ -27,7 +27,8 @@ from .trajectory import TrajectoryWriter
 ENSEMBLE_STEP_FIELDS = ("event", "member", "lane", "round", "step", "t",
                         "dt", "iters", "gmres_cycles", "residual",
                         "residual_true", "fiber_error", "accepted",
-                        "refines", "loss_of_accuracy", "wall_s", "wall_ms",
+                        "refines", "loss_of_accuracy", "health",
+                        "guard_retries", "wall_s", "wall_ms",
                         "gmres_history")
 
 #: keys of an ``event == "start"`` record (member entered a lane);
@@ -36,8 +37,13 @@ ENSEMBLE_STEP_FIELDS = ("event", "member", "lane", "round", "step", "t",
 ENSEMBLE_START_FIELDS = ("event", "member", "lane", "t", "t_final",
                          "queue_wait_s")
 
-#: keys of an ``event == "retire"`` / ``"dt_underflow"`` record (lane freed)
+#: keys of an ``event == "retire"`` record (lane freed at t_final)
 ENSEMBLE_RETIRE_FIELDS = ("event", "member", "lane", "t", "steps", "frames")
+
+#: keys of an ``event == "failed"`` / ``"dt_underflow"`` record (lane
+#: quarantined/frozen): the retire keys plus the packed health word and
+#: its decoded bit names (`guard.verdict` — docs/robustness.md)
+ENSEMBLE_FAILURE_FIELDS = ENSEMBLE_RETIRE_FIELDS + ("health", "verdict")
 
 
 class EnsembleMetricsWriter:

@@ -31,6 +31,11 @@ class Summary:
     def __init__(self):
         self.spans: dict[str, list[float]] = {}
         self.compiles: list[dict] = []
+        #: fault events by kind (`ev == "fault"` — solver health verdicts,
+        #: lane quarantines, chaos injections, wire-frame rejects,
+        #: fused-ring fallbacks; docs/robustness.md)
+        self.faults: dict[str, int] = {}
+        self.fault_verdicts: dict[str, int] = {}
         self.lane_events: dict[str, int] = {}
         self.lane_rounds: list[dict] = []
         #: admission latencies from lane admit/backfill events
@@ -70,6 +75,12 @@ class Summary:
                 self.lane_rounds.append(rec)
         elif ev == "compile":
             self.compiles.append(rec)
+        elif ev == "fault":
+            kind = rec.get("kind", "?")
+            self.faults[kind] = self.faults.get(kind, 0) + 1
+            if rec.get("verdict"):
+                v = str(rec["verdict"])
+                self.fault_verdicts[v] = self.fault_verdicts.get(v, 0) + 1
         elif ev == "lane":
             action = rec.get("action", "?")
             self.lane_events[action] = self.lane_events.get(action, 0) + 1
@@ -123,6 +134,20 @@ class Summary:
         if retraced:
             out.append("RETRACES: " + ", ".join(
                 f"{n} x{c}" for n, c in sorted(retraced.items())))
+        out.append("")
+
+    def _fault_section(self, out: list[str]):
+        if not self.faults:
+            return
+        out.append("== faults ==")
+        rows = [("kind", "count")]
+        rows += [(k, str(v)) for k, v in sorted(self.faults.items())]
+        widths = [max(len(r[i]) for r in rows) for i in range(2)]
+        out.extend("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                   for r in rows)
+        if self.fault_verdicts:
+            out.append("verdicts: " + ", ".join(
+                f"{v}={n}" for v, n in sorted(self.fault_verdicts.items())))
         out.append("")
 
     def _lane_section(self, out: list[str]):
@@ -184,6 +209,15 @@ class Summary:
         loa = sum(1 for s in self.steps if s.get("loss_of_accuracy"))
         if loa:
             out.append(f"LOSS-OF-ACCURACY steps: {loa}")
+        # a SUCCESSFUL escalation replaces the health word with the healed
+        # attempt's 0 (guard/verdict.py), so retries must be reported even
+        # when no step stayed flagged — those are exactly the runs where
+        # the ladder paid extra solves
+        unhealthy = sum(1 for s in self.steps if s.get("health"))
+        retries = sum(int(s.get("guard_retries", 0)) for s in self.steps)
+        if unhealthy or retries:
+            out.append(f"HEALTH-FLAGGED steps: {unhealthy}  "
+                       f"(guard retries: {retries})")
         # ensemble step records share one batched round's wall across every
         # live lane (io.ensemble_io schema) — dedupe by (stream, round) so
         # the total is the drain's wall, not lanes x wall, while rounds
@@ -222,6 +256,7 @@ class Summary:
             out.append("")
         self._span_section(out)
         self._compile_section(out)
+        self._fault_section(out)
         self._lane_section(out)
         self._convergence_section(out)
         if self.unparsed:

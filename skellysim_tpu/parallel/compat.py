@@ -11,9 +11,12 @@ against the modern API and the fallback logic lives in exactly one place.
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
 
 import jax
+
+logger = logging.getLogger("skellysim_tpu")
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -48,6 +51,15 @@ def fused_ring_mode(impl: str = "pallas") -> str:
     remote-DMA API. ``SKELLY_FUSED_RING=0`` forces the ppermute ring
     (escape hatch); ``SKELLY_FUSED_RING=interpret`` opts the interpreter
     in off-TPU (where its remote-DMA emulation supports it).
+
+    Every ENVIRONMENTAL fallback from a pallas request — the build lacks
+    pallas, ships no `make_async_remote_copy`, or the backend is not a
+    compiled TPU — is a clean degrade, never a crash, and is logged as a
+    structured ``fault`` telemetry event (kind ``fused_ring_fallback``
+    with the reason) so a production run that silently lost its fused
+    rings shows up in `obs summarize`'s fault table (docs/robustness.md).
+    Explicit opt-outs (env override, non-pallas tile) are intentional and
+    emit nothing.
     """
     override = os.environ.get("SKELLY_FUSED_RING", "").strip().lower()
     if override in ("0", "off", "ppermute"):
@@ -57,12 +69,25 @@ def fused_ring_mode(impl: str = "pallas") -> str:
     try:
         from jax.experimental.pallas import tpu as pltpu
     except Exception:  # pallas not shipped on this build
-        return "ppermute"
+        return _fused_fallback("pallas-unavailable")
     if not hasattr(pltpu, "make_async_remote_copy"):
-        return "ppermute"
+        return _fused_fallback("no-remote-dma")
     if override == "interpret":
         return "fused-interpret"
-    return "fused" if jax.default_backend() == "tpu" else "ppermute"
+    if jax.default_backend() != "tpu":
+        return _fused_fallback(f"backend-{jax.default_backend()}")
+    return "fused"
+
+
+def _fused_fallback(reason: str) -> str:
+    """Log + emit the structured fault for an environmental fused-ring
+    fallback; always returns "ppermute"."""
+    from ..obs import tracer as obs_tracer
+
+    logger.warning("fused ring unavailable (%s): falling back to the "
+                   "lax.ppermute ring", reason)
+    obs_tracer.emit("fault", kind="fused_ring_fallback", reason=reason)
+    return "ppermute"
 
 
 def use_mesh(mesh):

@@ -680,6 +680,14 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
             [fc.fiber_error(g) for g in fiber_buckets(new_state.fibers)]))
         fiber_error = lax.pmax(err_local, axis)
 
+        # the guard health word rides the mesh program too: the solver's
+        # bits are replicated (psum'd reductions), the fiber-error check is
+        # on the pmax'd global error — every shard computes the identical
+        # word, keeping replicated outputs bitwise in lockstep
+        from ..guard.verdict import nonfinite_word
+
+        health = (jnp.asarray(result.health, dtype=jnp.int32)
+                  | nonfinite_word(fiber_error))
         info = StepInfo(
             converged=result.converged, iters=result.iters,
             residual=result.residual, fiber_error=fiber_error,
@@ -690,7 +698,8 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
             # skelly-scope gmres_cycles ride along; the convergence ring
             # buffer stays None in the mesh program (a replicated [N,3]
             # carry per shard buys nothing over the single-chip history)
-            cycles=jnp.asarray(result.cycles, dtype=jnp.int32))
+            cycles=jnp.asarray(result.cycles, dtype=jnp.int32),
+            health=health, dt_used=st.dt, guard_retries=jnp.int32(0))
         return new_state, (tuple(sol_fibs), sol_shell, sol_body), info
 
     # -------------------------------------------------------------- assembly
@@ -705,7 +714,8 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
         lambda _: P(), StepInfo(converged=0, iters=0, residual=0.0,
                                 fiber_error=0.0, residual_true=0.0,
                                 loss_of_accuracy=False, refines=0,
-                                cycles=0, history=None))
+                                cycles=0, history=None, health=0,
+                                dt_used=0.0, guard_retries=0))
     # check_vma off: the 0.4.x replication checker has no while-loop rule
     # (every solver loop is lax.while_loop), and replicated-output
     # correctness is guaranteed by construction here (psum-or-replicated

@@ -76,6 +76,33 @@ class Params:
     # [N,3] carry vanishes from the lowered program entirely).
     gmres_history: int = 16
     fiber_error_tol: float = 1e-1
+    # --- skelly-guard escalation ladder (guard.escalate,
+    # docs/robustness.md): on a RETRYABLE solver health verdict
+    # (stagnation/breakdown — never a poisoned nonfinite state) the trial
+    # re-solves DEVICE-SIDE, inside the same jitted program, before the
+    # member is declared failed. Stages run in order; each is a bounded
+    # lax.while_loop, so a healthy solve pays zero extra trips (and under
+    # vmap a healthy BATCH pays zero — the batched while_loop's cond is
+    # any-member). All stages default OFF: the default program is the
+    # pre-guard one, and every golden/parity pin stays bitwise. Applies to
+    # the single-chip solve and the vmapped ensemble; `step_spmd` threads
+    # the health WORD only and warns at build time if these are armed
+    # (in-mesh escalation is a follow-up — docs/robustness.md).
+    #
+    # guard_dt_halvings: retry up to N times at dt/2, dt/4, ... (floored
+    # at dt_min under the adaptive gate); the successful retry's dt is
+    # reported via StepInfo.dt_used and advances time.
+    guard_dt_halvings: int = 0
+    # then fall back gmres_block_s -> 1 (the sequential Arnoldi cycle):
+    # the s-step monomial basis trades conditioning for fewer collectives
+    # — its breakdowns resolve on the exact cycle (no-op at block_s=1)
+    guard_block_fallback: bool = False
+    # then route the Krylov interior through the full-precision f64 dense
+    # path (the role-gated `pair=None` operator): the last resort when the
+    # f32 interior's noise floor is the stall (no-op for "full" states;
+    # NOTE on TPU this stage pays the emulated-f64 cliff — it is a
+    # correctness stage, not a fast path)
+    guard_f64_fallback: bool = False
     seed: int = 1
     # pairwise-kernel backend, mirroring the reference's params.pair_evaluator
     # ("CPU"/"GPU"/"FMM", `include/params.hpp:50`): "direct" = dense blocked

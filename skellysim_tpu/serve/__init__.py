@@ -24,7 +24,12 @@ Layers (see docs/serving.md):
                tenants joining and leaving, never retracing);
 * `metrics`  — SLO counters derived from obs events (admission latency,
                lane occupancy, steps/s + frames per tenant, compile events
-               after warmup), exported as telemetry JSONL + `/stats`;
+               after warmup, faults by kind), exported as telemetry JSONL
+               + `/stats`;
+* `journal`  — the crash-safe write-ahead tenant journal (skelly-guard,
+               docs/robustness.md): append-only snapshots on admit /
+               every-K-rounds / retire, replayed on restart so `kill -9`
+               loses no tenant;
 * `client`   — `ServeClient` / `SpawnedServer` for driving a server;
 * `cli`      — `python -m skellysim_tpu.serve`.
 

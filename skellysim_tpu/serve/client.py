@@ -46,6 +46,10 @@ class ServeClient:
                 raise ConnectionError("serve server closed the connection")
             frames = self._decoder.feed(data)
             if frames:
+                if isinstance(frames[0], protocol.OversizedFrame):
+                    raise ConnectionError(
+                        f"server answered an oversized frame "
+                        f"({frames[0].size} bytes)")
                 return protocol.unpack_message(frames[0])
 
     def _checked(self, req: dict) -> dict:
@@ -95,6 +99,13 @@ class ServeClient:
 
     def stats(self) -> dict:
         return self._checked(protocol.make_request("stats"))["stats"]
+
+    def chaos(self, action: str, tenant: Optional[str] = None) -> dict:
+        """Fault injection (`guard.chaos`) — the server refuses unless its
+        config sets ``[serve] chaos_enabled``."""
+        fields = {"tenant": tenant} if tenant is not None else {}
+        return self._checked(protocol.make_request(
+            "chaos", action=action, **fields))
 
     def shutdown(self) -> dict:
         return self._checked(protocol.make_request("shutdown"))
@@ -168,6 +179,19 @@ class SpawnedServer:
 
     def client(self, **kw) -> ServeClient:
         return ServeClient(port=self.port, **kw)
+
+    def kill(self) -> None:
+        """SIGKILL the server — the crash-recovery injector (guard.chaos):
+        no shutdown request, no graceful teardown, exactly what the
+        write-ahead journal must survive. Pair with a fresh
+        `SpawnedServer` on the same config/journal to test recovery."""
+        import signal
+
+        if self._proc.poll() is None:
+            self._proc.send_signal(signal.SIGKILL)
+            self._proc.wait()
+        if os.path.exists(self.port_file):
+            os.unlink(self.port_file)
 
     def stop(self, timeout: float = 30.0) -> int:
         if self._proc.poll() is None:

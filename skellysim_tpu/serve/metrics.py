@@ -43,6 +43,13 @@ class ServeMetrics:
         self.compiles_after_warm = 0
         self.warm = False
         self.frames_streamed: dict[str, int] = {}
+        #: fault events by kind (`ev == "fault"`: lane_failed /
+        #: dt_underflow / chaos_nan / frame_oversized / ... —
+        #: docs/robustness.md)
+        self.faults: dict[str, int] = {}
+        #: steps flagged loss_of_accuracy across every tenant (server
+        #: increments via `note_loss_of_accuracy`)
+        self.loss_of_accuracy_steps = 0
 
     # ------------------------------------------------------------ ingest
 
@@ -71,6 +78,9 @@ class ServeMetrics:
             self.compiles += 1
             if self.warm:
                 self.compiles_after_warm += 1
+        elif ev == "fault":
+            kind = fields.get("kind", "?")
+            self.faults[kind] = self.faults.get(kind, 0) + 1
 
     def mark_warm(self):
         """Every bucket has compiled + completed a round: from here on a
@@ -84,6 +94,9 @@ class ServeMetrics:
 
     def note_rejected(self):
         self.rejected += 1
+
+    def note_loss_of_accuracy(self):
+        self.loss_of_accuracy_steps += 1
 
     # ------------------------------------------------------------ report
 
@@ -110,6 +123,8 @@ class ServeMetrics:
             "compiles": self.compiles,
             "compiles_after_warm": self.compiles_after_warm,
             "warm": self.warm,
+            "faults": dict(self.faults),
+            "loss_of_accuracy_steps": self.loss_of_accuracy_steps,
             "frames_streamed": dict(self.frames_streamed),
             "frames_streamed_total": sum(self.frames_streamed.values()),
         }

@@ -123,6 +123,23 @@ def read_message(stream) -> Optional[dict]:
     return unpack_message(buf)
 
 
+class OversizedFrame:
+    """Sentinel yielded by `FrameDecoder.feed` for a frame whose header
+    exceeds the decoder's byte bound: the server answers a structured
+    error and the CONNECTION SURVIVES (the decoder consumes the frame's
+    declared bytes as they arrive, then resynchronizes on the next
+    header) — before skelly-guard, any oversized header dropped the
+    client outright."""
+
+    __slots__ = ("size",)
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __repr__(self):
+        return f"OversizedFrame(size={self.size})"
+
+
 class FrameDecoder:
     """Incremental framing for non-blocking sockets.
 
@@ -131,21 +148,45 @@ class FrameDecoder:
     ``b""``); partial frames stay buffered until the next feed. The serve
     event loop reads whatever a socket has ready and feeds it here — the
     blocking read loop of `read_frame`, inverted.
+
+    A header claiming more than ``max_frame_bytes`` yields one
+    `OversizedFrame` sentinel IMMEDIATELY (so the server can answer a
+    structured error before the body even arrives) and puts the decoder
+    into skip mode: the declared bytes are discarded as they stream in,
+    after which framing resynchronizes. Note a GARBAGE byte stream whose
+    fake header claims an astronomical size therefore parks the
+    connection in skip mode — framing cannot resync inside arbitrary
+    garbage — but the server stays up and the client gets the error
+    reply, which is the robustness contract (docs/robustness.md).
     """
 
-    def __init__(self):
+    def __init__(self, max_frame_bytes: int = MAX_FRAME_BYTES):
         self._buf = bytearray()
+        self.max_frame_bytes = int(max_frame_bytes)
+        #: bytes of the current oversized frame still to discard
+        self._skip = 0
+        #: oversized frames seen (telemetry/debugging)
+        self.oversized = 0
 
-    def feed(self, data: bytes) -> list[bytes]:
+    def feed(self, data: bytes) -> list:
         self._buf.extend(data)
-        frames = []
+        frames: list = []
         while True:
+            if self._skip:
+                take = min(self._skip, len(self._buf))
+                del self._buf[:take]
+                self._skip -= take
+                if self._skip:
+                    return frames
             if len(self._buf) < HEADER.size:
                 return frames
             (size,) = HEADER.unpack(self._buf[:HEADER.size])
-            if size > MAX_FRAME_BYTES:
-                raise ValueError(f"incoming frame header claims {size} "
-                                 f"bytes (> MAX_FRAME_BYTES)")
+            if size > self.max_frame_bytes:
+                del self._buf[:HEADER.size]
+                self._skip = size
+                self.oversized += 1
+                frames.append(OversizedFrame(size))
+                continue
             if len(self._buf) < HEADER.size + size:
                 return frames
             frames.append(bytes(self._buf[HEADER.size:HEADER.size + size]))
@@ -173,13 +214,19 @@ REQUEST_FIELDS = {
     "cancel": (("tenant",), ()),
     # server-wide SLO counters (serve.metrics)
     "stats": ((), ()),
+    # fault injection (guard.chaos; REFUSED unless the server config sets
+    # [serve] chaos_enabled — a production server must not expose it).
+    # action: "nan_lane" poisons the tenant's lane state between rounds
+    "chaos": (("action",), ("tenant",)),
     # stop the event loop after answering
     "shutdown": ((), ()),
 }
 
-#: tenant lifecycle states (`serve.tenants`)
+#: tenant lifecycle states (`serve.tenants`); ``failed`` = quarantined on
+#: a terminal solver health verdict (the `status` response carries the
+#: decoded verdict — docs/robustness.md)
 TENANT_STATES = ("queued", "running", "finished", "evicted", "cancelled",
-                 "dt_underflow")
+                 "dt_underflow", "failed")
 
 
 def make_request(rtype: str, **fields) -> dict:

@@ -62,9 +62,18 @@ class Tenant:
     #: the admission-time dump stays current)
     rng_state: Optional[object] = None
     #: monotonic timestamp of entry into a terminal state (finished /
-    #: evicted / cancelled / dt_underflow) — the `[serve] record_ttl_s`
-    #: retention clock; None while queued/running (never expires)
+    #: evicted / cancelled / dt_underflow / failed) — the `[serve]
+    #: record_ttl_s` retention clock; None while queued/running (never
+    #: expires)
     retired_at: Optional[float] = None
+    #: accumulated packed health word (`guard.verdict` bit layout), ORed
+    #: over every step record + the terminal verdict; surfaced (with its
+    #: decoded bit names) in `status` responses — docs/robustness.md
+    health: int = 0
+    #: steps whose solve converged implicitly but drifted explicitly
+    #: (Belos' loss-of-accuracy analogue) — previously died in the
+    #: metrics JSONL, now surfaced in `status`/`stats`
+    loss_of_accuracy_steps: int = 0
 
     def snapshot_pending(self) -> int:
         return len(self.frames)

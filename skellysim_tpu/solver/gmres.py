@@ -38,6 +38,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..guard.verdict import (BREAKDOWN, NONFINITE, STAGNATION,
+                             nonfinite_word)
+
 
 class GmresResult(NamedTuple):
     x: jnp.ndarray          # solution
@@ -71,6 +74,13 @@ class GmresResult(NamedTuple):
     #: (cumulative inner iters, the sweep's inner implicit exit residual,
     #: the f64 explicit residual after the update).
     history: jnp.ndarray | None = None
+    #: int32 packed health word (`guard.verdict` bit layout: nonfinite /
+    #: stagnation / breakdown), ORed together INSIDE the solver loops with
+    #: `jnp.isfinite` + masked int ops — no host sync, so skelly-audit's
+    #: host-sync contract stays empty and the word batches under `vmap`
+    #: like every other carry. 0 = healthy. Plain int default for the same
+    #: import-time reason as ``refines``.
+    health: int | jnp.ndarray = 0
 
 
 def _icgs(V, w, k, n_restart, rdot):
@@ -185,7 +195,8 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
 
     def arnoldi_cycle(x0, r0):
         """One restart cycle from x0 with precomputed residual r0 = b - A x0;
-        returns (x, implicit_resid, inner_iters)."""
+        returns (x, implicit_resid, inner_iters, breakdown=False — only the
+        s-step cycle has a Cholesky-ridge breakdown path)."""
         beta = _norm(r0)
         safe_beta = jnp.where(beta > 0.0, beta, 1.0)
 
@@ -244,7 +255,7 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
         y = lax.fori_loop(0, m, back_sub, jnp.zeros(m, dtype=dtype))
         dx = M(y @ V[:m])
         resid = jnp.abs(g[jnp.minimum(k, m)]) / safe_b_norm
-        return x0 + dx, resid, k
+        return x0 + dx, resid, k, jnp.asarray(False)
 
     def arnoldi_cycle_block(x0, r0):
         """Communication-avoiding restart cycle (``block_s`` > 1).
@@ -287,11 +298,11 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
         rows = jnp.asarray(m + 1 + s, dtype=dtype)
 
         def cond(state):
-            k, *_, done = state
-            return (k < m) & ~done
+            k, *rest = state
+            return (k < m) & ~rest[-1]
 
         def body(state):
-            k, V, Hr, H, cs, sn, g, done = state
+            k, V, Hr, H, cs, sn, g, brk, done = state
 
             # ---- s preconditioned matvec powers (one matvec per trip)
             def gen(j, P):
@@ -372,6 +383,11 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
                                                          rdiag, 1.0)
                 col_ok = jnp.isfinite(hraw).all() & (rdiag > tiny)
                 acc = ~done & col_ok
+                # a rejected column while the cycle was still live is the
+                # Cholesky-ridge breakdown the health word reports (the
+                # outer loop's explicit residual decides whether the solve
+                # still converged; the BREAKDOWN bit survives either way)
+                brk = brk | (~done & ~col_ok)
                 hrot, cs_n, sn_n, g_n = givens_col(j, hraw, cs, sn, g)
                 Hr = jnp.where(acc, Hr.at[:, j].set(hraw), Hr)
                 H = jnp.where(acc, H.at[:, j].set(hrot), H)
@@ -382,11 +398,11 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
                 done = done | (~done & ~col_ok) \
                     | (acc & (jnp.abs(g[j + 1]) <= tol_abs))
                 prev_e = e_t
-            return k + accepted, V, Hr, H, cs, sn, g, done
+            return k + accepted, V, Hr, H, cs, sn, g, brk, done
 
-        k, V, Hr, H, cs, sn, g, done = lax.while_loop(
+        k, V, Hr, H, cs, sn, g, brk, done = lax.while_loop(
             cond, body, (jnp.int32(0), V0, Hr0, H0, cs0, sn0, g0,
-                         beta <= tol_abs))
+                         jnp.asarray(False), beta <= tol_abs))
 
         # identical masked back-substitution to the sequential cycle
         idx = jnp.arange(m, dtype=jnp.int32)
@@ -403,14 +419,14 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
         y = lax.fori_loop(0, m, back_sub, jnp.zeros(m, dtype=dtype))
         dx = M(y @ V[:m])
         resid = jnp.abs(g[jnp.minimum(k, m)]) / safe_b_norm
-        return x0 + dx, resid, k
+        return x0 + dx, resid, k, brk
 
     cycle = arnoldi_cycle if block_s == 1 else arnoldi_cycle_block
 
     def outer_cond(state):
         (x, r, resid_true, prev_true, resid_impl, total_iters, cycles,
-         hist) = state
-        del x, r, cycles, hist
+         hist, health) = state
+        del x, r, cycles, hist, health
         # acceptance on the EXPLICIT residual: with restarts + a right
         # preconditioner the implicit (Givens) residual drifts from the true
         # one, and Belos' loss-of-accuracy warning (`solver_hydro.cpp:85-92`)
@@ -423,11 +439,22 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
         return (resid_true > tol) & (total_iters < maxiter) & ~stalled
 
     def outer_body(state):
-        x, r, resid_true, _, _, total_iters, cycles, hist = state
-        x, resid_impl, k = cycle(x, r)
+        x, r, resid_true, _, _, total_iters, cycles, hist, health = state
+        x, resid_impl, k, brk = cycle(x, r)
         r = b - matvec(x)
         prev_true = resid_true
         resid_true = _norm(r) / safe_b_norm
+        # the health word (guard.verdict bit layout), built from values the
+        # loop already carries — pure int/bool ops, no host sync, vmaps
+        # like every other carry. The stall predicate here is EXACTLY what
+        # outer_cond will exit on next trip, so the bit and the early exit
+        # can never disagree.
+        health = health | nonfinite_word(resid_true)
+        health = health | jnp.where(brk, jnp.int32(BREAKDOWN), jnp.int32(0))
+        stall_next = ((resid_impl <= tol) & (resid_true > 0.5 * prev_true)
+                      & (resid_true > tol))
+        health = health | jnp.where(stall_next, jnp.int32(STAGNATION),
+                                    jnp.int32(0))
         if debug:
             jax.debug.print(
                 "gmres restart {c}: iters={i} implicit={ri:.3e} "
@@ -438,21 +465,33 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
                              resid_true])
             hist = hist.at[lax.rem(cycles, jnp.int32(history))].set(row)
         return (x, r, resid_true, prev_true, resid_impl, total_iters + k,
-                cycles + 1, hist)
+                cycles + 1, hist, health)
 
     x0 = jnp.zeros_like(b)
     init_resid = jnp.where(b_norm > 0.0, jnp.array(jnp.inf, dtype=dtype), jnp.array(0.0, dtype=dtype))
     hist0 = jnp.full((max(history, 0), 3), jnp.nan, dtype=dtype)
-    x, _, resid_true, _, resid_impl, iters, cycles, hist = lax.while_loop(
+    # a nonfinite RHS short-circuits the loop through the b_norm guards
+    # (NaN > 0.0 is False -> init_resid 0.0 -> zero trips, "converged"
+    # with x = 0) — the exact silent-poisoning mode the health word
+    # exists to surface, so stamp it at entry
+    health0 = nonfinite_word(b_norm)
+    (x, _, resid_true, _, resid_impl, iters, cycles, hist,
+     health) = lax.while_loop(
         outer_cond, outer_body,
         (x0, b, init_resid, init_resid, init_resid, jnp.int32(0),
-         jnp.int32(0), hist0))
+         jnp.int32(0), hist0, health0))
+    # iteration budget exhausted without reaching tol = stagnation too
+    # (the "burns the full restart budget with no escalation" mode)
+    health = health | jnp.where((resid_true > tol) & (resid_impl > tol)
+                                & (iters >= maxiter),
+                                jnp.int32(STAGNATION), jnp.int32(0))
     # converged like Belos (either measure passed); residual_true lets the
     # caller's loss-of-accuracy gate flag implicit-only convergence
     return GmresResult(x=x, iters=iters, residual=resid_impl,
                        converged=(resid_true <= tol) | (resid_impl <= tol),
                        residual_true=resid_true, cycles=cycles,
-                       history=hist if history > 0 else None)
+                       history=hist if history > 0 else None,
+                       health=health)
 
 
 @partial(jax.jit, static_argnames=("matvec_hi", "matvec_lo", "precond_lo",
@@ -500,36 +539,54 @@ def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
     safe_b_norm = jnp.where(b_norm > 0.0, b_norm, 1.0)
 
     def cond(state):
-        x, r, r_rel, outer, total, hist = state
-        del x, r, hist
+        x, r, r_rel, outer, total, hist, health = state
+        del x, r, hist, health
         return (r_rel > tol) & (outer < max_refine)
 
     def body(state):
-        x, r, _, outer, total, hist = state
+        x, r, _, outer, total, hist, health = state
         d = gmres(matvec_lo, r, precond=M, tol=inner_tol,
                   restart=restart, maxiter=maxiter, rdot=rdot,
                   block_s=block_s)
         x = x + d.x
         r = b - matvec_hi(x)
         r_rel = _norm(r) / safe_b_norm
+        # accumulate the inner solves' verdicts, plus a nonfinite check on
+        # the f64 explicit residual (a poisoned correction shows up here
+        # even when the f32 inner loop "converged"). The inner STAGNATION
+        # bit is deliberately masked off: an f32 inner loop stalling at its
+        # noise floor is the NORMAL mixed-precision exit (see the stall
+        # note in `gmres.outer_cond`) — refinement-level stagnation is
+        # judged on the f64 sweep contraction below, not the f32 interior.
+        health = health | (jnp.asarray(d.health, dtype=jnp.int32)
+                           & jnp.int32(~STAGNATION))
+        health = health | nonfinite_word(r_rel)
         if history > 0:
             row = jnp.stack([(total + d.iters).astype(b.dtype), d.residual,
                              r_rel])
             hist = hist.at[lax.rem(outer, jnp.int32(history))].set(row)
-        return x, r, r_rel, outer + 1, total + d.iters, hist
+        return x, r, r_rel, outer + 1, total + d.iters, hist, health
 
     x0 = jnp.zeros_like(b)
     init_rel = jnp.where(b_norm > 0.0, jnp.asarray(jnp.inf, dtype=b.dtype),
                          jnp.asarray(0.0, dtype=b.dtype))
     hist0 = jnp.full((max(history, 0), 3), jnp.nan, dtype=b.dtype)
-    x, _, r_rel, outers, iters, hist = lax.while_loop(
-        cond, body, (x0, b, init_rel, jnp.int32(0), jnp.int32(0), hist0))
+    health0 = nonfinite_word(b_norm)
+    x, _, r_rel, outers, iters, hist, health = lax.while_loop(
+        cond, body, (x0, b, init_rel, jnp.int32(0), jnp.int32(0), hist0,
+                     health0))
+    # refinement budget exhausted above tol = stagnation (each sweep
+    # should contract by ~inner_tol; when it doesn't, more sweeps won't
+    # help — the escalation ladder's cue to change the program instead)
+    health = health | jnp.where((r_rel > tol) & (outers >= max_refine),
+                                jnp.int32(STAGNATION), jnp.int32(0))
     # `cycles` == ring rows written, for BOTH solvers (`history_rows`
     # decodes on that invariant): here each refinement sweep writes one row
     return GmresResult(x=x, iters=iters, residual=r_rel,
                        converged=r_rel <= tol, residual_true=r_rel,
                        refines=outers, cycles=outers,
-                       history=hist if history > 0 else None)
+                       history=hist if history > 0 else None,
+                       health=health)
 
 
 def collective_rounds(iters, cycles, block_s: int = 1,

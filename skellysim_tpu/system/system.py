@@ -26,6 +26,7 @@ logger = logging.getLogger("skellysim_tpu")
 
 from ..bodies import bodies as bd
 from ..fibers import container as fc
+from ..guard import verdict as _verdict
 from ..obs import tracer as obs_tracer
 from ..obs.compile_log import observed_jit
 from ..params import Params, REFINE_PAIR_IMPLS
@@ -91,7 +92,8 @@ def _rewrap_fibers(fibers, new_buckets: tuple):
 METRICS_FIELDS = ("step", "t", "dt", "iters", "gmres_cycles",
                   "collective_rounds", "residual", "residual_true",
                   "fiber_error", "accepted", "refines", "loss_of_accuracy",
-                  "wall_s", "wall_ms", "gmres_history")
+                  "health", "guard_retries", "wall_s", "wall_ms",
+                  "gmres_history")
 
 
 def crossed_write_boundary(t_new: float, dt: float, dt_write: float) -> bool:
@@ -133,6 +135,17 @@ class StepInfo(NamedTuple):
     #: cumulative iters / implicit / explicit; `solver.gmres` docstring) or
     #: None when Params.gmres_history == 0
     history: jnp.ndarray | None = None
+    #: int32 packed health word (`guard.verdict`: nonfinite / stagnation /
+    #: breakdown from the solver, dt_underflow stamped by the stepping
+    #: layer) — computed device-side next to `loss_of_accuracy`, 0 = healthy
+    health: int | jnp.ndarray = 0
+    #: the dt this trial actually solved with — equals the input
+    #: ``state.dt`` unless the guard escalation ladder (`guard.escalate`,
+    #: `Params.guard_dt_halvings`) retried at a halved dt; the run
+    #: loop/ensemble advance ``time`` by THIS, not the entry dt
+    dt_used: float | jnp.ndarray = 0.0
+    #: guard-ladder retries this trial paid (0 with the ladder off)
+    guard_retries: int | jnp.ndarray = 0
 
 
 def solution_from_state(state: SimState):
@@ -768,7 +781,29 @@ class System:
 
     def _solve_impl(self, state: SimState, pair=None,
                     pair_anchors=None):
+        """One trial solve, with the guard escalation ladder around it when
+        any `Params.guard_*` stage is enabled (docs/robustness.md). The
+        ladder lives HERE — below every jit/vmap entry point — so
+        sequential `System.run`, the vmapped ensemble, and the donating
+        run-loop twin all share one implementation."""
+        out = self._solve_once(state, pair=pair, pair_anchors=pair_anchors)
         p = self.params
+        if not (p.guard_dt_halvings or p.guard_block_fallback
+                or p.guard_f64_fallback):
+            return out
+        from ..guard.escalate import escalate
+
+        return escalate(self, state, out, pair=pair,
+                        pair_anchors=pair_anchors)
+
+    def _solve_once(self, state: SimState, pair=None, pair_anchors=None,
+                    block_s: int | None = None, force_full: bool = False):
+        """The bare prep/GMRES/advance pipeline. ``block_s``/``force_full``
+        are trace-time overrides for the guard ladder's fallback stages
+        (`guard.escalate`): re-solve with the sequential Arnoldi cycle /
+        the full-precision f64 operator instead of the configured ones."""
+        p = self.params
+        bs = p.gmres_block_s if block_s is None else block_s
         state, caches, body_caches, shell_rhs, body_rhs = self._prep(
             state, pair=pair, pair_anchors=pair_anchors)
 
@@ -783,7 +818,8 @@ class System:
             raise ValueError("state has no implicit components to solve")
         rhs = jnp.concatenate(rhs_parts)
 
-        if self._precision_for(state) == "mixed":
+        precision = "full" if force_full else self._precision_for(state)
+        if precision == "mixed":
             # f64 state/assembly/refinement residuals; the Krylov loop's
             # expensive interior (kernel flows, shell/body dense ops, LU
             # preconditioners) evaluates through f32 copies via the lo seam
@@ -808,7 +844,7 @@ class System:
                 tol=p.gmres_tol, inner_tol=p.inner_tol,
                 restart=p.gmres_restart, maxiter=p.gmres_maxiter,
                 max_refine=p.max_refine, history=p.gmres_history,
-                block_s=p.gmres_block_s)
+                block_s=bs)
         else:
             result = gmres(
                 lambda v: self._apply_matvec(state, caches, body_caches, v,
@@ -820,7 +856,7 @@ class System:
                     pair_anchors=pair_anchors),
                 tol=p.gmres_tol, restart=p.gmres_restart,
                 maxiter=p.gmres_maxiter, history=p.gmres_history,
-                block_s=p.gmres_block_s)
+                block_s=bs)
 
         fib_size, shell_size, body_size = self._sizes(state)
         new_state = state
@@ -870,6 +906,12 @@ class System:
                 [fc.fiber_error(g)
                  for g in fiber_buckets(new_state.fibers)]))
 
+        # the packed health word (guard.verdict): the solver's own bits,
+        # plus a nonfinite check on the post-advance fiber error — a
+        # poisoned state (injected NaN, overflow blow-up) shows up here
+        # even when the solver's residual arithmetic short-circuited
+        health = (jnp.asarray(result.health, dtype=jnp.int32)
+                  | _verdict.nonfinite_word(fiber_error))
         info = StepInfo(converged=result.converged, iters=result.iters,
                         residual=result.residual, fiber_error=fiber_error,
                         residual_true=result.residual_true,
@@ -877,7 +919,8 @@ class System:
                                           & (result.residual_true
                                              > 10.0 * p.gmres_tol)),
                         refines=result.refines, cycles=result.cycles,
-                        history=result.history)
+                        history=result.history, health=health,
+                        dt_used=state.dt, guard_retries=jnp.int32(0))
         return new_state, result.x, info
 
     # -------------------------------------------------------- velocity field
@@ -1133,6 +1176,21 @@ class System:
 
         from ..parallel.spmd import build_spmd_step
 
+        p = self.params
+        if (p.guard_dt_halvings or p.guard_block_fallback
+                or p.guard_f64_fallback):
+            # trace-time (not per-step) diagnostic, like _ring_active's:
+            # the mesh program threads the HEALTH WORD but not the
+            # escalation ladder (build_spmd_step assembles its own
+            # pipeline below _solve_impl; in-mesh retries are a follow-up)
+            # — silent inertness here would surprise a user who armed
+            # guard_* expecting device-side retries (docs/robustness.md)
+            import warnings
+
+            warnings.warn("Params.guard_* escalation is not applied on the "
+                          "step_spmd path: the mesh program reports health "
+                          "verdicts but does not retry; escalation runs on "
+                          "the single-chip and ensemble paths only")
         buckets = fiber_buckets(state.fibers)
         pair = anchors = None
         if self.params.pair_evaluator == "tree" and all(
@@ -1263,6 +1321,12 @@ class System:
             n_steps += 1
             converged = bool(info.converged)
             fiber_error = float(info.fiber_error)
+            health = int(info.health)
+            # the guard ladder may have retried this trial at a halved dt
+            # (Params.guard_dt_halvings): the dt that actually advanced the
+            # state is info.dt_used — identical to `dt` when the ladder is
+            # off or never fired, so the pre-guard arithmetic is unchanged
+            dt = float(info.dt_used)
 
             dt_new = dt
             accept = True
@@ -1307,6 +1371,20 @@ class System:
                     "but explicit ||b-Ax||/||b|| = %.3e (> 10x tol %.1e)",
                     residual, float(info.residual_true),
                     p.gmres_tol)
+            if health:
+                # the device-side verdict, surfaced host-side exactly once
+                # per trial: a structured `fault` telemetry event (the obs
+                # summarize fault table) plus the log line the reference
+                # would have aborted with
+                verdict_s = _verdict.describe(health)
+                obs_tracer.emit("fault", kind="solver_health",
+                                verdict=verdict_s, health=health,
+                                t=t_cur, dt=dt,
+                                retries=int(info.guard_retries))
+                logger.warning(
+                    "solver health verdict at t=%.6g: %s (health=%#x, "
+                    "guard retries=%d)", t_cur, verdict_s, health,
+                    int(info.guard_retries))
             if metrics_fh is not None:
                 # key set == METRICS_FIELDS (schema-pinned; docs/performance.md)
                 metrics_fh.write(json.dumps({
@@ -1325,6 +1403,8 @@ class System:
                     "fiber_error": fiber_error, "accepted": accept,
                     "refines": int(info.refines),
                     "loss_of_accuracy": bool(info.loss_of_accuracy),
+                    "health": health,
+                    "guard_retries": int(info.guard_retries),
                     "wall_s": round(wall_s, 4),
                     "wall_ms": round(wall_s * 1e3, 3),
                     "gmres_history": history_rows(info.history,

@@ -129,6 +129,7 @@ def test_prolate_spheroid_perrin_mobility():
     assert v_par > v_perp
 
 
+@pytest.mark.slow  # heavy coupled-solve integration; sibling fast tests keep the seam covered (ISSUE-9 870s-budget re-triage)
 def test_oblate_spheroid_perrin_mobility():
     """Oblate spheroid (a < b = c) mobility vs the exact result
     F_par = 8 pi eta c e^3 v / (e sqrt(1-e^2) - (1-2e^2) asin(e)) along the

@@ -109,6 +109,52 @@ def test_fused_ring_mode_tpu_selects_fused(monkeypatch):
     assert fused_ring_mode("exact") == "ppermute"
 
 
+def test_fused_ring_fallback_emits_fault_event(monkeypatch):
+    """ISSUE-9 satellite pin: an ENVIRONMENTAL fallback from a pallas
+    fused-ring request (CPU backend here — CI's case) degrades cleanly to
+    ppermute AND logs a structured `fault` telemetry event, so a
+    production run that silently lost its fused rings shows up in
+    `obs summarize`'s fault table. Explicit opt-outs stay silent."""
+    from skellysim_tpu.obs import tracer as obs_tracer
+
+    monkeypatch.delenv("SKELLY_FUSED_RING", raising=False)
+    tr = obs_tracer.Tracer()
+    with obs_tracer.use(tr):
+        assert fused_ring_mode("pallas") == "ppermute"
+    faults = [e for e in tr.events if e["ev"] == "fault"]
+    assert len(faults) == 1
+    assert faults[0]["kind"] == "fused_ring_fallback"
+    assert faults[0]["reason"]  # names WHY (backend-cpu / no-remote-dma)
+
+    # the deliberate opt-out emits nothing (it is not a fault)
+    monkeypatch.setenv("SKELLY_FUSED_RING", "0")
+    tr2 = obs_tracer.Tracer()
+    with obs_tracer.use(tr2):
+        assert fused_ring_mode("pallas") == "ppermute"
+    assert not [e for e in tr2.events if e["ev"] == "fault"]
+    monkeypatch.delenv("SKELLY_FUSED_RING", raising=False)
+    tr3 = obs_tracer.Tracer()
+    with obs_tracer.use(tr3):
+        assert fused_ring_mode("exact") == "ppermute"
+    assert not [e for e in tr3.events if e["ev"] == "fault"]
+
+
+def test_fused_ring_fallback_without_remote_dma(monkeypatch):
+    """`pltpu.make_async_remote_copy` missing at build time (older pallas
+    builds) must fall back with the no-remote-dma reason, not crash."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    from skellysim_tpu.obs import tracer as obs_tracer
+
+    monkeypatch.delenv("SKELLY_FUSED_RING", raising=False)
+    monkeypatch.delattr(pltpu, "make_async_remote_copy", raising=False)
+    tr = obs_tracer.Tracer()
+    with obs_tracer.use(tr):
+        assert fused_ring_mode("pallas") == "ppermute"
+    faults = [e for e in tr.events if e["ev"] == "fault"]
+    assert faults and faults[0]["reason"] == "no-remote-dma"
+
+
 @pytest.mark.skipif(jax.default_backend() != "tpu",
                     reason="compiled fused ring needs a TPU backend")
 def test_fused_ring_executes_on_tpu():

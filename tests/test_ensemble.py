@@ -231,6 +231,67 @@ def test_dt_underflow_policies(scene):
     assert any(r["event"] == "dt_underflow" for r in records)
 
 
+def test_nan_lane_isolation_bitwise(scene, runners):
+    """ISSUE-9 satellite pin — the seed behavior skelly-guard's quarantine
+    builds on: NaN injected into one lane's state leaves every SIBLING
+    lane's trajectory bitwise unchanged (frozen/failed lanes are masked
+    selects, and batched row operations never mix members)."""
+    from skellysim_tpu.guard import chaos, verdict
+
+    _, members = scene
+    runner = runners["vmap"]
+    states = [m.state for m in members[:B_LANES]]
+    ens = runner.make_ensemble(states, [0.004] * B_LANES)
+
+    clean_rounds = []
+    e = ens
+    for _ in range(3):
+        e, _ = runner.step(e)
+        clean_rounds.append(e.states)
+
+    e2 = chaos.poison_lane(ens, 0)
+    info2 = None
+    for i in range(3):
+        e2, info2 = runner.step(e2)
+        for la, lb in zip(jax.tree_util.tree_leaves(clean_rounds[i]),
+                          jax.tree_util.tree_leaves(e2.states)):
+            a, b = np.asarray(la), np.asarray(lb)
+            assert np.array_equal(a[1:], b[1:], equal_nan=True), \
+                "sibling lane perturbed by a poisoned neighbor"
+    health = np.asarray(info2.health)
+    failed = np.asarray(info2.failed)
+    assert health[0] & verdict.NONFINITE and bool(failed[0])
+    assert not failed[1:].any() and not health[1:].any()
+
+
+def test_failed_lane_quarantine_policies(scene, runners):
+    """Terminal verdicts quarantine: on_failure='retire' retires JUST the
+    poisoned member (reason 'failed', verdict attached) and the sweep
+    completes; the default mirrors the sequential abort."""
+    from skellysim_tpu.guard import chaos, verdict
+
+    _, members = scene
+    runner = runners["vmap"]
+    events = []
+    sched = EnsembleScheduler(runner, members[:2], B_LANES,
+                              metrics=events.append, on_failure="retire")
+    sched.ens = chaos.poison_lane(sched.ens, sched.lane_of("m0"))
+    retired = sched.run()
+    fails = [r for r in events if r.get("event") == "failed"]
+    assert [f["member"] for f in fails] == ["m0"]
+    assert fails[0]["health"] & verdict.NONFINITE
+    assert fails[0]["verdict"] == "nonfinite"
+    from skellysim_tpu.io.ensemble_io import ENSEMBLE_FAILURE_FIELDS
+
+    assert set(fails[0]) == set(ENSEMBLE_FAILURE_FIELDS)
+    assert "m1" in retired and "m0" in retired
+
+    sched2 = EnsembleScheduler(runner, members[:2], B_LANES)
+    sched2.ens = chaos.poison_lane(sched2.ens, sched2.lane_of("m0"))
+    with pytest.raises(RuntimeError, match="terminal solver health"):
+        sched2.run()
+
+
 def test_degenerate_t_final_member_retires_instead_of_hanging(scene, runners):
     """A member seated at or past its t_final (degenerate swept value,
     resumed state beyond it) must retire unstepped — an inert occupied lane

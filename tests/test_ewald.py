@@ -178,6 +178,7 @@ def test_ewald_mixed_target_set():
     assert rel < 1e-5, rel
 
 
+@pytest.mark.slow  # heavy coupled-solve integration; sibling fast tests keep the seam covered (ISSUE-9 870s-budget re-triage)
 def test_system_solve_with_ewald_evaluator():
     """pair_evaluator="ewald": the coupled implicit solve matches the direct
     evaluator's solution to the Ewald tolerance."""

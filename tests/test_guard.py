@@ -1,0 +1,367 @@
+"""skelly-guard: health verdicts, escalation ladder, quarantine, chaos.
+
+Pins the ISSUE-9 robustness contracts (docs/robustness.md):
+
+* the packed health word's bit semantics on real solver failure modes —
+  nonfinite poisoning, zero-preconditioner stagnation, s-step
+  Cholesky-ridge breakdown — computed device-side (no host sync) and
+  batching under vmap;
+* the escalation ladder's mechanics (bounded retries, dt_min floor,
+  block_s/f64 fallbacks) on a scripted stub system — cheap and exact —
+  plus one real-system integration (slow tier);
+* chaos injectors: lane poisoning preserves shapes/dtypes, frame
+  garbling/truncation/oversizing produce the documented wire behavior;
+* `obs summarize`'s fault table and health-flagged step reporting.
+"""
+
+import json
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skellysim_tpu.guard import chaos, escalate, verdict
+from skellysim_tpu.solver.gmres import gmres, gmres_ir
+
+jax.config.update("jax_enable_x64", True)
+
+
+# ------------------------------------------------------------ verdict word
+
+def test_verdict_bits_disjoint_and_decodable():
+    bits = list(verdict.HEALTH_BITS.values())
+    assert len(set(bits)) == len(bits)
+    acc = 0
+    for b in bits:
+        assert b & acc == 0, "overlapping health bits"
+        acc |= b
+    assert verdict.decode(0) == []
+    assert verdict.describe(0) == "ok"
+    word = verdict.NONFINITE | verdict.STAGNATION
+    assert verdict.decode(word) == ["nonfinite", "stagnation"]
+    assert verdict.describe(word) == "nonfinite|stagnation"
+
+
+def test_verdict_terminal_vs_retryable():
+    assert bool(verdict.is_terminal(verdict.NONFINITE))
+    assert bool(verdict.is_terminal(verdict.DT_UNDERFLOW))
+    assert not bool(verdict.is_terminal(verdict.STAGNATION))
+    assert not bool(verdict.retryable(0))
+    assert bool(verdict.retryable(verdict.STAGNATION))
+    assert bool(verdict.retryable(verdict.BREAKDOWN | verdict.STAGNATION))
+    # terminal bits poison retryability even when combined with retryable
+    assert not bool(verdict.retryable(verdict.NONFINITE
+                                      | verdict.STAGNATION))
+
+
+# ------------------------------------------------------ solver health word
+
+def _problem(n=16, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    A = jnp.asarray(np.eye(n) + 0.1 * rng.standard_normal((n, n)),
+                    dtype=dtype)
+    b = jnp.asarray(rng.standard_normal(n), dtype=dtype)
+    return A, b
+
+
+def test_gmres_health_zero_on_healthy_solve():
+    A, b = _problem()
+    r = gmres(lambda x: A @ x, b, tol=1e-4, restart=8, maxiter=32)
+    assert int(r.health) == 0 and bool(r.converged)
+
+
+def test_gmres_health_nonfinite_rhs():
+    """A NaN RHS short-circuits the solve through the b_norm guards (zero
+    trips, x=0, 'converged') — exactly the silent poisoning the health
+    word must surface."""
+    A, b = _problem()
+    r = gmres(lambda x: A @ x, b.at[0].set(jnp.nan), tol=1e-4, restart=8,
+              maxiter=32)
+    assert int(r.health) & verdict.NONFINITE
+
+
+def test_gmres_health_stagnation_zero_preconditioner():
+    """M=0 collapses the implicit residual through degenerate Givens
+    rotations while x never moves: the implicit/explicit divergence Belos
+    warns about, now a STAGNATION verdict."""
+    A, b = _problem()
+    r = gmres(lambda x: A @ x, b, precond=lambda v: v * 0.0, tol=1e-4,
+              restart=4, maxiter=8)
+    assert int(r.health) & verdict.STAGNATION
+    assert float(r.residual_true) > 0.1  # x really did not move
+
+
+def test_gmres_health_breakdown_rank_deficient_block():
+    """A rank-1 operator kills the s-step monomial basis at the second
+    candidate: the Cholesky-ridge column recovery must flag BREAKDOWN,
+    not fabricate directions."""
+    rng = np.random.default_rng(0)
+    u = rng.standard_normal(16)
+    u /= np.linalg.norm(u)
+    A = jnp.asarray(np.outer(u, u), dtype=jnp.float32)
+    b = jnp.asarray(rng.standard_normal(16), dtype=jnp.float32)
+    r = gmres(lambda x: A @ x, b, tol=1e-6, restart=8, maxiter=16,
+              block_s=4)
+    assert int(r.health) & verdict.BREAKDOWN
+    assert not bool(r.converged)
+
+
+def test_gmres_health_batches_under_vmap():
+    """One poisoned member must not flag its batched siblings — the word
+    is an ordinary per-member carry."""
+    A, b = _problem()
+    bb = jnp.stack([b, b.at[0].set(jnp.nan), b])
+    rr = jax.vmap(lambda bi: gmres(lambda x: A @ x, bi, tol=1e-4,
+                                   restart=8, maxiter=32))(bb)
+    health = np.asarray(rr.health)
+    assert health[0] == 0 and health[2] == 0
+    assert health[1] & verdict.NONFINITE
+
+
+def test_gmres_ir_health():
+    """gmres_ir: healthy == 0; poisoned RHS flags NONFINITE; the inner
+    f32 loop's routine noise-floor stall must NOT mark the sweep
+    stagnant when refinement still converges."""
+    A, b = _problem(dtype=jnp.float64)
+    r = gmres_ir(lambda x: A @ x, lambda x: A @ x, b, tol=1e-10,
+                 inner_tol=1e-5, restart=16, maxiter=64)
+    assert bool(r.converged) and int(r.health) == 0
+    r = gmres_ir(lambda x: A @ x, lambda x: A @ x, b.at[0].set(jnp.nan),
+                 tol=1e-10, inner_tol=1e-5, restart=16, maxiter=64)
+    assert int(r.health) & verdict.NONFINITE
+
+
+# -------------------------------------------------------- escalation ladder
+
+class _StubParams:
+    """Just the knobs `escalate` reads."""
+
+    def __init__(self, **kw):
+        self.guard_dt_halvings = kw.get("guard_dt_halvings", 0)
+        self.guard_block_fallback = kw.get("guard_block_fallback", False)
+        self.guard_f64_fallback = kw.get("guard_f64_fallback", False)
+        self.gmres_block_s = kw.get("gmres_block_s", 1)
+        self.adaptive_timestep_flag = kw.get("adaptive_timestep_flag", True)
+        self.dt_min = kw.get("dt_min", 1e-4)
+        self.gmres_tol = kw.get("gmres_tol", 1e-10)
+
+
+class _StubState(NamedTuple):
+    """Minimal pytree with `.dt` and `._replace(dt=...)`."""
+
+    dt: jnp.ndarray
+
+
+class _StubSystem:
+    """Scripted solve: unhealthy until dt < `heal_below` (and/or until a
+    requested fallback), so ladder mechanics are testable exactly and
+    cheaply. `_solve_once` mirrors the real signature."""
+
+    def __init__(self, params, heal_below=None, heal_on=None):
+        self.params = params
+        self.heal_below = heal_below
+        self.heal_on = heal_on      # "block" | "full" | None
+        self.calls = []
+
+    def _precision_for(self, state):
+        return "mixed"
+
+    def _solve_once(self, state, pair=None, pair_anchors=None,
+                    block_s=None, force_full=False):
+        from skellysim_tpu.system.system import StepInfo
+
+        self.calls.append((block_s, force_full))
+        healed = False
+        if self.heal_below is not None:
+            healed = healed | (state.dt < self.heal_below)
+        if self.heal_on == "block":
+            healed = healed or (block_s == 1)
+        if self.heal_on == "full":
+            healed = healed or force_full
+        health = jnp.where(jnp.asarray(healed), jnp.int32(0),
+                           jnp.int32(verdict.STAGNATION))
+        # an unhealthy attempt also shows an unconverged explicit residual
+        # (the ladder's needs_retry gates on residual_true > gmres_tol, so
+        # a breakdown-bit-with-converged-restart solve is NOT retried)
+        resid_true = jnp.where(jnp.asarray(healed), jnp.float64(0.0),
+                               jnp.float64(1.0))
+        info = StepInfo(converged=health == 0, iters=jnp.int32(1),
+                        residual=jnp.float64(0.0),
+                        fiber_error=jnp.float64(0.0),
+                        residual_true=resid_true,
+                        loss_of_accuracy=jnp.asarray(False),
+                        health=health, dt_used=state.dt)
+        return _StubState(jnp.asarray(state.dt)), state.dt * 0.0, info
+
+
+def _run_ladder(system, dt=0.1):
+    state = _StubState(jnp.asarray(dt, dtype=jnp.float64))
+    first = system._solve_once(state)
+    return escalate.escalate(system, state, first)
+
+
+def test_ladder_healthy_pays_nothing():
+    sys_ = _StubSystem(_StubParams(guard_dt_halvings=3), heal_below=1.0)
+    _, _, info = _run_ladder(sys_, dt=0.1)
+    assert int(info.guard_retries) == 0
+    assert float(info.dt_used) == 0.1
+    assert int(info.health) == 0
+
+
+def test_ladder_halves_dt_until_healthy():
+    sys_ = _StubSystem(_StubParams(guard_dt_halvings=4), heal_below=0.03)
+    _, _, info = _run_ladder(sys_, dt=0.1)
+    # 0.1 -> 0.05 -> 0.025 (< 0.03: healed)
+    assert int(info.guard_retries) == 2
+    assert np.isclose(float(info.dt_used), 0.025)
+    assert int(info.health) == 0
+
+
+def test_ladder_bounded_and_verdict_survives():
+    sys_ = _StubSystem(_StubParams(guard_dt_halvings=2), heal_below=0.0)
+    _, _, info = _run_ladder(sys_, dt=0.1)
+    assert int(info.guard_retries) == 2
+    assert int(info.health) & verdict.STAGNATION
+
+
+def test_ladder_respects_dt_min_floor():
+    sys_ = _StubSystem(_StubParams(guard_dt_halvings=8, dt_min=0.04),
+                       heal_below=0.0)
+    _, _, info = _run_ladder(sys_, dt=0.1)
+    # 0.1 -> 0.05; halving again would cross dt_min=0.04: stop
+    assert int(info.guard_retries) == 1
+    assert np.isclose(float(info.dt_used), 0.05)
+
+
+def test_ladder_block_and_full_fallbacks():
+    sys_ = _StubSystem(_StubParams(guard_block_fallback=True,
+                                   gmres_block_s=4), heal_on="block")
+    _, _, info = _run_ladder(sys_)
+    assert int(info.health) == 0 and int(info.guard_retries) == 1
+    assert (1, False) in sys_.calls
+
+    sys_ = _StubSystem(_StubParams(guard_f64_fallback=True), heal_on="full")
+    _, _, info = _run_ladder(sys_)
+    assert int(info.health) == 0 and int(info.guard_retries) == 1
+    assert any(ff for _, ff in sys_.calls)
+
+
+def test_ladder_skips_breakdown_that_still_converged():
+    """A BREAKDOWN bit can ride a solve whose restart converged anyway
+    (gmres sets it 'either way'); re-solving those would waste full
+    solves and perturb dt on healthy steps — the retry gate is the
+    explicit residual, and the bit survives for telemetry."""
+    class _ConvergedBrk(_StubSystem):
+        def _solve_once(self, state, **kw):
+            out = super()._solve_once(state, **kw)
+            info = out[2]._replace(health=jnp.int32(verdict.BREAKDOWN),
+                                   converged=jnp.asarray(True),
+                                   residual_true=jnp.float64(0.0))
+            return out[0], out[1], info
+
+    sys_ = _ConvergedBrk(_StubParams(guard_dt_halvings=4,
+                                     guard_block_fallback=True,
+                                     gmres_block_s=4))
+    _, _, info = _run_ladder(sys_)
+    assert int(info.guard_retries) == 0
+    assert int(info.health) & verdict.BREAKDOWN
+
+
+def test_ladder_nonfinite_is_not_retried():
+    """Terminal verdicts skip the ladder entirely: no dt can repair a
+    poisoned state, and burning retries on it would delay quarantine."""
+    class _Nan(_StubSystem):
+        def _solve_once(self, state, **kw):
+            out = super()._solve_once(state, **kw)
+            info = out[2]._replace(health=jnp.int32(verdict.NONFINITE))
+            return out[0], out[1], info
+
+    sys_ = _Nan(_StubParams(guard_dt_halvings=4, guard_block_fallback=True,
+                            gmres_block_s=4, guard_f64_fallback=True))
+    _, _, info = _run_ladder(sys_)
+    assert int(info.guard_retries) == 0
+    assert int(info.health) & verdict.NONFINITE
+
+
+# ------------------------------------------------------------ real system
+
+@pytest.mark.slow
+def test_guard_ladder_on_real_system_stagnation():
+    """Integration: a zero-preconditioner (stagnant) solve on a real
+    System exhausts its dt halvings inside ONE jitted step; a poisoned
+    state is terminal with zero retries."""
+    from skellysim_tpu.audit import fixtures
+
+    system = fixtures.make_system(guard_dt_halvings=2)
+    chaos.zero_preconditioner(system)
+    state = fixtures.free_state(system)
+    _, _, info = system.step(state)
+    assert int(info.guard_retries) == 2
+    assert int(info.health) & verdict.STAGNATION
+    assert np.isclose(float(info.dt_used), float(state.dt) / 4.0)
+
+    system2 = fixtures.make_system(guard_dt_halvings=2)
+    _, _, info2 = system2.step(chaos.poison_state(
+        fixtures.free_state(system2)))
+    assert int(info2.health) & verdict.NONFINITE
+    assert int(info2.guard_retries) == 0
+
+
+# ------------------------------------------------------------ chaos wire
+
+def test_chaos_garble_and_truncate_and_oversize():
+    from skellysim_tpu.serve import protocol
+
+    payload = protocol.pack_message({"type": "stats"})
+    garbled = chaos.garble_frame(payload, seed=3)
+    assert garbled != payload and len(garbled) == len(payload)
+    framed = protocol.HEADER.pack(len(payload)) + payload
+    assert chaos.truncate_frame(framed, 5) == framed[:5]
+    hdr = chaos.oversized_header(1 << 40)
+    (size,) = protocol.HEADER.unpack(hdr)
+    assert size == 1 << 40
+
+
+def test_chaos_poison_state_keeps_shapes():
+    """The poisoned state must still ride the same compiled program."""
+    import jax.tree_util as jtu
+
+    from skellysim_tpu.audit import fixtures
+
+    system = fixtures.make_system()
+    state = fixtures.free_state(system)
+    bad = chaos.poison_state(state)
+    la, lb = jtu.tree_leaves(state), jtu.tree_leaves(bad)
+    assert [(x.shape, x.dtype) for x in map(jnp.asarray, la)] \
+        == [(x.shape, x.dtype) for x in map(jnp.asarray, lb)]
+    assert jtu.tree_structure(state) == jtu.tree_structure(bad)
+    from skellysim_tpu.fibers import container as fc
+
+    assert all(bool(jnp.isnan(g.x).all()) for g in fc.as_buckets(bad.fibers))
+
+
+# ------------------------------------------------------------- summarize
+
+def test_summarize_fault_table(tmp_path):
+    from skellysim_tpu.obs.summarize import summarize_files
+
+    p = tmp_path / "trace.jsonl"
+    lines = [
+        {"ev": "telemetry", "version": 1},
+        {"ev": "fault", "kind": "lane_failed", "verdict": "nonfinite"},
+        {"ev": "fault", "kind": "lane_failed", "verdict": "nonfinite"},
+        {"ev": "fault", "kind": "fused_ring_fallback",
+         "reason": "backend-cpu"},
+        {"iters": 4, "accepted": True, "health": verdict.STAGNATION,
+         "guard_retries": 2, "residual": 1e-5},
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in lines) + "\n")
+    out = summarize_files([str(p)])
+    assert "== faults ==" in out
+    assert "lane_failed" in out and "2" in out
+    assert "fused_ring_fallback" in out
+    assert "nonfinite=2" in out
+    assert "HEALTH-FLAGGED steps: 1" in out
+    assert "guard retries: 2" in out

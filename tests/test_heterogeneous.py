@@ -15,6 +15,7 @@ resolution is a dense vmapped bucket and `SimState.fibers` is a tuple of
   serialization.
 """
 
+import pytest
 import numpy as np
 import jax.numpy as jnp
 
@@ -197,6 +198,7 @@ def _sphere_body(n_nodes, position, radius=0.5, force=(0.0, 0.0, 1.0),
         config_rank=None if rank is None else np.array([rank]), dtype=dtype)
 
 
+@pytest.mark.slow  # heavy coupled-solve integration; sibling fast tests keep the seam covered (ISSUE-9 870s-budget re-triage)
 def test_same_kind_body_bucket_split_is_exact():
     """Two same-resolution sphere bodies as one batch == two buckets."""
     from skellysim_tpu.bodies import bodies as bd
@@ -326,6 +328,7 @@ def test_mixed_bodies_trajectory_roundtrip():
                                [[-1.0, 0.0, 0.0]])
 
 
+@pytest.mark.slow  # heavy coupled-solve integration; sibling fast tests keep the seam covered (ISSUE-9 870s-budget re-triage)
 def test_mixed_resolution_solve_through_pallas_seam():
     """kernel_impl="pallas" serves the multi-bucket union evaluator pass
     (`fc.flow_multi`) — interpret mode on CPU. f32 state so the f64

@@ -283,6 +283,7 @@ def test_stresslet_mxu_impl_matches_exact():
     np.testing.assert_allclose(np.asarray(mxu_c), np.asarray(mxu), atol=1e-12)
 
 
+@pytest.mark.slow  # heavy coupled-solve integration; sibling fast tests keep the seam covered (ISSUE-9 870s-budget re-triage)
 def test_system_solve_with_mxu_kernels_matches_exact():
     """A full coupled solve with kernel_impl='mxu' agrees with the exact
     tiles (well-separated walkthrough geometry — the MXU tiles' regime)."""

@@ -40,6 +40,7 @@ def test_gmres_ir_reaches_f64_tol_with_f32_inner():
     assert float(jnp.linalg.norm(res.x - x_true) / jnp.linalg.norm(x_true)) < 1e-10
 
 
+@pytest.mark.slow  # heavy coupled-solve integration; sibling fast tests keep the seam covered (ISSUE-9 870s-budget re-triage)
 def test_mixed_coupled_solve_hits_reference_tol():
     """Walkthrough-style coupled scene: mixed mode reaches gmres_tol=1e-10
     (the reference's tolerance class) with f32 LU preconditioners."""

@@ -119,6 +119,7 @@ def test_gs_equals_jacobi_without_shell():
     assert res["gs"][1] == res["jacobi"][1]
 
 
+@pytest.mark.slow  # heavy coupled-solve integration; sibling fast tests keep the seam covered (ISSUE-9 870s-budget re-triage)
 def test_mixed_precision_solve_through_gs():
     """The mixed solver's f32 inner precond also takes the GS correction."""
     dtype = jnp.float64

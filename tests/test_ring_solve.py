@@ -5,6 +5,7 @@ the virtual 8-device mesh, compared against the single-program ground truth —
 no mocks.
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -74,6 +75,7 @@ def _coupled_state(system):
     return system.make_state(fibers=fibers, shell=shell, bodies=bodies)
 
 
+@pytest.mark.slow  # heavy coupled-solve integration; sibling fast tests keep the seam covered (ISSUE-9 870s-budget re-triage)
 def test_ring_coupled_solve_matches_direct_solve():
     """The ring evaluator must serve coupled (fiber+shell+body) states — the
     reference's FMM serves all components through one evaluator seam

@@ -114,6 +114,7 @@ def test_protocol_roundtrip_every_request_type():
         "snapshot": dict(tenant="t1"),
         "cancel": dict(tenant="t1"),
         "stats": {},
+        "chaos": dict(action="nan_lane", tenant="t1"),
         "shutdown": {},
     }
     assert set(samples) == set(protocol.REQUEST_FIELDS)
@@ -466,6 +467,307 @@ def test_padded_bucket_admission_parity():
 
 
 # ------------------------------------------------------------ socket + CLI
+
+# ------------------------------------------------ skelly-guard robustness
+
+def test_frame_decoder_oversized_header_survives():
+    """A header past the bound yields the OversizedFrame sentinel
+    IMMEDIATELY, the declared bytes are skipped as they arrive, and
+    framing resynchronizes on the next real frame — byte-at-a-time."""
+    dec = protocol.FrameDecoder(max_frame_bytes=64)
+    payload = protocol.pack_message({"type": "stats"})
+    wire = (protocol.HEADER.pack(100) + b"x" * 100
+            + protocol.HEADER.pack(len(payload)) + payload)
+    out = []
+    for i in range(len(wire)):
+        out.extend(dec.feed(wire[i:i + 1]))
+    assert isinstance(out[0], protocol.OversizedFrame)
+    assert out[0].size == 100
+    assert protocol.unpack_message(out[1]) == {"type": "stats"}
+    assert dec.oversized == 1
+
+
+def test_frame_decoder_boundary_sizes():
+    """Exactly-at-bound frames pass; one byte over trips the sentinel."""
+    dec = protocol.FrameDecoder(max_frame_bytes=32)
+    exact = b"a" * 32
+    assert dec.feed(protocol.HEADER.pack(32) + exact) == [exact]
+    out = dec.feed(protocol.HEADER.pack(33) + b"b" * 33)
+    assert len(out) == 1 and isinstance(out[0], protocol.OversizedFrame)
+    # after the skip the decoder is clean again
+    assert dec.feed(protocol.HEADER.pack(32) + exact) == [exact]
+
+
+def test_frame_decoder_truncated_then_completed():
+    dec = protocol.FrameDecoder()
+    payload = protocol.pack_message({"type": "stats"})
+    framed = protocol.HEADER.pack(len(payload)) + payload
+    assert dec.feed(framed[:5]) == []
+    assert dec.feed(framed[5:]) == [payload]
+
+
+def test_frame_decoder_garbage_stream_does_not_raise():
+    """Random bytes whose fake header claims an absurd size park the
+    decoder in skip mode (framing cannot resync inside garbage) — but
+    never raise: the server answers an error and stays up."""
+    from skellysim_tpu.guard import chaos as chaos_mod
+
+    dec = protocol.FrameDecoder()
+    garbage = chaos_mod.garble_frame(bytes(64), seed=7, flips=64)
+    out = dec.feed(garbage)
+    assert all(isinstance(f, (bytes, protocol.OversizedFrame))
+               for f in out)
+
+
+class _StubConn:
+    """Scripted socket for `_service_conn` (recv once, capture sends)."""
+
+    def __init__(self, data: bytes):
+        self._data = data
+        self.sent = b""
+
+    def recv(self, n):
+        d, self._data = self._data, b""
+        return d
+
+    def sendall(self, b):
+        self.sent += b
+
+    def close(self):
+        pass
+
+
+class _StubSel:
+    def unregister(self, c):
+        pass
+
+
+def _served_responses(server, wire: bytes, max_frame_bytes=None):
+    conn = _StubConn(wire)
+    dec = (protocol.FrameDecoder(max_frame_bytes=max_frame_bytes)
+           if max_frame_bytes else protocol.FrameDecoder())
+    decoders = {conn: dec}
+    server._service_conn(conn, decoders, _StubSel())
+    out = protocol.FrameDecoder().feed(conn.sent)
+    return conn, decoders, [protocol.unpack_message(f) for f in out]
+
+
+def test_server_survives_garbled_frame(server):
+    """Satellite pin: a well-framed but undecodable request answers a
+    structured error and the connection survives."""
+    from skellysim_tpu.guard import chaos as chaos_mod
+
+    garbled = chaos_mod.garble_frame(
+        protocol.pack_message({"type": "stats"}), seed=1)
+    wire = protocol.HEADER.pack(len(garbled)) + garbled
+    conn, decoders, resps = _served_responses(server, wire)
+    assert resps and resps[0]["ok"] is False
+    assert "undecodable" in resps[0]["error"]
+    assert conn in decoders  # NOT dropped
+    # and a valid request on the same (surviving) connection still works
+    valid = protocol.pack_message({"type": "stats"})
+    conn2 = _StubConn(protocol.HEADER.pack(len(valid)) + valid)
+    decoders[conn2] = decoders.pop(conn)
+    server._service_conn(conn2, decoders, _StubSel())
+    ok = protocol.unpack_message(protocol.FrameDecoder().feed(conn2.sent)[0])
+    assert ok["ok"] is True
+
+
+def test_server_survives_oversized_frame(server):
+    """Satellite pin: an oversized header answers a structured error
+    (flagged ``oversized``) without waiting for the body, and the
+    connection survives."""
+    wire = protocol.HEADER.pack(1 << 40)
+    conn, decoders, resps = _served_responses(server, wire)
+    assert resps and resps[0]["ok"] is False
+    assert resps[0].get("oversized") is True
+    assert conn in decoders
+    assert server.metrics.faults.get("frame_oversized", 0) >= 1
+
+
+def test_chaos_request_gated_off_by_default(server):
+    resp = server.handle_request({"type": "chaos", "action": "nan_lane",
+                                  "tenant": "whatever"})
+    assert resp["ok"] is False and "chaos_enabled" in resp["error"]
+
+
+def _nan_pair(server, shift_a, shift_b):
+    """Submit two tenants into one bucket, run one healthy round, poison
+    A's lane; returns (tenant_a, tenant_b) after the drain."""
+    from skellysim_tpu.guard import chaos as chaos_mod
+
+    ra = _submit(server, _tenant_cfg(shift_a))
+    rb = _submit(server, _tenant_cfg(shift_b))
+    server.tick()   # one healthy round for both
+    chaos_mod.nan_lane_of(server.buckets[0].scheduler, ra["tenant"])
+    _drain(server)
+    return ra["tenant"], rb["tenant"]
+
+
+def test_nan_tenant_fails_sibling_finishes(server):
+    """ISSUE-9 acceptance pin, fast half: a NaN injected into tenant A's
+    lane yields status=failed for A with a nonzero nonfinite verdict —
+    surfaced in status/stats, a structured terminal stream, never a hang
+    — while its bucket sibling finishes healthy. (The sibling's BITWISE
+    sequential parity is the slow half below; cross-lane bitwise
+    isolation is also pinned cheaply in test_ensemble.py.)"""
+    from skellysim_tpu.guard import verdict
+
+    ta, tb = _nan_pair(server, 0.25, 0.45)
+    sa = server.handle_request({"type": "status", "tenant": ta})
+    assert sa["status"] == "failed"
+    assert sa["health"] & verdict.NONFINITE
+    assert "nonfinite" in sa["verdict"]
+    sb = server.handle_request({"type": "status", "tenant": tb})
+    assert sb["status"] == "finished" and sb["health"] == 0
+    # failed tenant: structured terminal stream, not a hang
+    resp = server.handle_request({"type": "stream", "tenant": ta})
+    assert resp["ok"] and resp["eof"] is True
+    stats = server.metrics.stats()
+    assert stats["retire_reasons"].get("failed", 0) >= 1
+    assert stats["faults"].get("lane_failed", 0) >= 1
+    assert stats["compiles_after_warm"] == 0
+
+
+@pytest.mark.slow  # sequential-reference System build + run
+def test_nan_tenant_sibling_streams_bitwise(server):
+    """ISSUE-9 acceptance pin, slow half: the surviving sibling's streamed
+    trajectory is BITWISE equal to its uninterrupted sequential
+    `System.run` output."""
+    cfg_b = _tenant_cfg(0.65)
+    ta, tb = _nan_pair(server, 0.6, 0.65)
+    sa = server.handle_request({"type": "status", "tenant": ta})
+    assert sa["status"] == "failed"
+    assert _stream(server, tb) == _sequential_frames(cfg_b)
+    assert server.metrics.stats()["compiles_after_warm"] == 0
+
+
+def test_status_surfaces_loss_of_accuracy_and_dt_underflow_fields(server):
+    """Satellite pin: the `/status` schema carries the solver-health
+    fields (they used to die in the metrics JSONL)."""
+    r = _submit(server, _tenant_cfg(0.55))
+    _drain(server)
+    st = server.handle_request({"type": "status", "tenant": r["tenant"]})
+    assert st["ok"]
+    for key in ("health", "verdict", "loss_of_accuracy_steps",
+                "dt_underflow"):
+        assert key in st, key
+    assert st["health"] == 0 and st["verdict"] == []
+    assert st["dt_underflow"] is False
+
+
+def test_journal_roundtrip_and_torn_tail(tmp_path):
+    """Write-ahead journal: latest-wins replay, terminal entries inherit
+    the last snapshot, and a torn final frame (crash mid-append) loses
+    only that frame."""
+    from skellysim_tpu.serve.journal import TenantJournal, replay
+
+    p = tmp_path / "j.bin"
+    with TenantJournal(str(p)) as j:
+        j.record("admit", "tA", bucket=1, t_final=0.5, status="queued",
+                 frame=b"F0")
+        j.record("checkpoint", "tA", bucket=1, t_final=0.5,
+                 status="running", frame=b"F1", t=0.25)
+        j.record("admit", "tB", bucket=1, t_final=0.5, status="queued",
+                 frame=b"G0")
+        j.record("retire", "tB", bucket=1, t_final=0.5, status="finished",
+                 t=0.5, health=0)
+    entries = replay(str(p))
+    assert entries["tA"]["status"] == "running"
+    assert bytes(entries["tA"]["frame"]) == b"F1"
+    assert entries["tB"]["status"] == "finished"
+    # terminal entry without a frame inherits the last snapshot
+    assert bytes(entries["tB"]["frame"]) == b"G0"
+
+    data = p.read_bytes()
+    p.write_bytes(data[:-3])  # tear the final frame
+    entries2 = replay(str(p))
+    assert entries2["tA"]["status"] == "running"
+    assert entries2["tB"]["status"] == "queued"  # retire entry was torn
+
+    assert replay(str(tmp_path / "missing.bin")) == {}
+
+
+def _journal_entry_count(path) -> int:
+    n = 0
+    with open(path, "rb") as fh:
+        while True:
+            try:
+                buf = protocol.read_frame(fh)
+            except ValueError:
+                break
+            if not buf:
+                break
+            n += 1
+    return n
+
+
+def test_journal_recovery_evicts_unreadmittable_live_records(tmp_path):
+    """A live-status journal record whose bucket no longer exists on the
+    restarted server must restore as terminal `evicted` — never a zombie
+    `running` tenant no scheduler drives (clients would poll it
+    forever)."""
+    from skellysim_tpu.serve.journal import TenantJournal
+
+    wal = tmp_path / "wal.bin"
+    with TenantJournal(str(wal)) as j:
+        # bucket that does not exist on the restarted server
+        j.record("checkpoint", "ghost", bucket=999, t_final=1.0,
+                 status="running", frame=b"not-a-real-frame", t=0.5)
+        # right bucket (capacity 1 = the base fiber count), junk snapshot:
+        # the decode failure must degrade, not make the server unbootable
+        j.record("checkpoint", "junk", bucket=1, t_final=1.0,
+                 status="running", frame=b"also-not-a-frame", t=0.5)
+    srv = SimulationServer(
+        _tenant_cfg(), warmup=False,
+        serve_cfg=schema.ServeConfig(max_lanes=2, batch_impl="unroll",
+                                     journal_path=str(wal)))
+    for tid in ("ghost", "junk"):
+        st = srv.handle_request({"type": "status", "tenant": tid})
+        assert st["ok"] and st["status"] == "evicted", (tid, st)
+    assert not srv.any_live()
+    # and the compacted journal carries exactly one record per tenant
+    assert _journal_entry_count(str(wal)) == 2
+
+
+@pytest.mark.slow  # builds two fresh servers (cold compiles)
+def test_journal_crash_recovery_matches_unkilled_run(tmp_path):
+    """ISSUE-9 acceptance pin, in-process: abandon a journaling server
+    mid-flight (the kill -9 analogue — nothing is flushed beyond what the
+    WAL already wrote), restart on the same journal, and the re-admitted
+    tenant finishes with a final state BITWISE equal to the uninterrupted
+    run's; terminal records survive too."""
+    cfg = _tenant_cfg(0.35)
+    scfg = schema.ServeConfig(max_lanes=2, batch_impl="unroll",
+                              journal_path=str(tmp_path / "wal.bin"),
+                              journal_every=2)
+    srv = SimulationServer(cfg, serve_cfg=scfg)
+    r = _submit(srv, cfg)
+    tid = r["tenant"]
+    done = _submit(srv, _tenant_cfg(0.05))
+    srv.tick()
+    srv.tick()
+    srv.tick()   # tenant mid-flight, >= 1 checkpoint written
+    st = srv.handle_request({"type": "status", "tenant": tid})
+    assert st["status"] == "running" and 0.0 < st["t"] < st["t_final"]
+    srv.journal.close()   # abandon srv: its in-memory state dies here
+
+    srv2 = SimulationServer(cfg, serve_cfg=scfg)
+    # recovery COMPACTED the journal: exactly one entry per known tenant
+    assert _journal_entry_count(scfg.journal_path) == 2
+    st2 = srv2.handle_request({"type": "status", "tenant": tid})
+    assert st2["ok"] and st2["status"] in ("queued", "running")
+    assert st2["t"] <= st["t"]  # replays from the checkpoint, never ahead
+    _drain(srv2)
+    st3 = srv2.handle_request({"type": "status", "tenant": tid})
+    assert st3["status"] == "finished"
+    # the resumed final state == the uninterrupted run's final state
+    snap = srv2.handle_request({"type": "snapshot", "tenant": tid})
+    assert bytes(snap["frame"]) == _sequential_frames(cfg)[-1]
+    assert srv2.handle_request(
+        {"type": "stats"})["stats"]["journal"] is True
+    del done
+
 
 @pytest.mark.slow  # subprocess server boot (compile) + TCP round-trips
 def test_socket_end_to_end(tmp_path):

@@ -85,6 +85,7 @@ def test_spmd_free_fiber_solve_matches_single_program():
     assert len(s_sp.fibers.x.sharding.device_set) == N_DEV
 
 
+@pytest.mark.slow  # heavy coupled-solve integration; sibling fast tests keep the seam covered (ISSUE-9 870s-budget re-triage)
 def test_spmd_coupled_solve_matches_single_program(coupled_parts):
     sys_ref = System(Params(**PARAMS), shell_shape=SHAPE)
     s_ref, sol_ref, info_ref = sys_ref.step(
