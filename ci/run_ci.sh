@@ -31,8 +31,13 @@ echo "== audit: skelly-audit lowered-program contracts (docs/audit.md) =="
 # meshes, ensemble vmap step, bare GMRES) is traced + lowered and checked
 # against audit/contracts/*.toml — collective inventory (incl. the
 # density-bounded all-gather), dtype promotion edges, host callbacks,
-# donation markers, retrace budgets. Fails on any unsuppressed finding or
-# unused suppression. (Bootstraps its own 8-device CPU + x64 backend.)
+# donation markers, retrace budgets, AND the skelly-rep replication-flow
+# analysis (`--check replication`, docs/parallel.md "Replication
+# discipline"): the d2/d4/d8 mesh programs must statically PROVE they
+# cannot deadlock (no varying while/cond predicates, no collectives under
+# divergence, replicated outputs verified) with zero suppressions. Fails
+# on any unsuppressed finding or unused suppression. (Bootstraps its own
+# 8-device CPU + x64 backend.)
 python -m skellysim_tpu.audit
 
 echo "== obs: skelly-scope cost baselines (docs/observability.md) =="
@@ -139,9 +144,15 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 # newly-expensive tests (pytest.ini `slow`) instead of letting the tier
 # creep into the timeout and fail far from the offending commit
 TIER_BUDGET_WARN_S=780
+TIER_LOG=$(mktemp)
+trap 'rm -f "$TIER_LOG"' EXIT   # a red fast tier exits mid-case via set -e
 tier_t0=$(date +%s)
 case "$TIER" in
-  fast)    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow and not tpu" ;;
+  # fast tier tees through a log and records per-test durations so a
+  # budget trip below comes WITH the measurements the re-triage needs
+  # (CHANGES.md PR 9 collected them by hand)
+  fast)    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow and not tpu" \
+             --durations=25 --durations-min=1.0 2>&1 | tee "$TIER_LOG" ;;
   full)    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not tpu" ;;
   nightly) python -m pytest tests/ -q ;;
   *) echo "unknown tier '$TIER' (use fast|full|nightly)" >&2; exit 2 ;;
@@ -153,6 +164,8 @@ if [ "$TIER" = fast ] && [ "$tier_wall" -gt "$TIER_BUDGET_WARN_S" ]; then
   echo "!! WARNING: not-slow tier took ${tier_wall}s (> ${TIER_BUDGET_WARN_S}s warning line," >&2
   echo "!! 870s hard timeout). Slow-mark the newly-expensive tests NOW —" >&2
   echo "!! see pytest.ini 'slow' and ROADMAP.md's tier-1 budget note."     >&2
+  echo "!! Slowest tests this run (from pytest --durations=25):"           >&2
+  sed -n '/slowest .* durations/,/^=\{10,\}/p' "$TIER_LOG" | sed 's/^/!!   /' >&2
   echo "!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!!" >&2
 fi
 

@@ -23,6 +23,12 @@ contract file (`audit/contracts/<name>.toml`):
   at lowering time, per contract.
 * ``retrace-budget`` — `testing.trace_counting_jit` pins the compile count
   across same-structure calls of the entry point.
+* ``replication`` — skelly-rep, the replication-flow analyzer
+  (`audit.repflow`): abstract interpretation over each `shard_map` region
+  statically proves the manual-SPMD programs cannot deadlock (no varying
+  `while`/`cond` predicates, no collectives under divergence, replicated
+  outputs provably replicated, no ppermute-order accumulation escaping to
+  a replicated consumer) and pins the replicated-output surface.
 
 CLI: ``python -m skellysim_tpu.audit [--list-checks] [--list-programs]
 [--program NAME] [--dump-contract NAME]`` — exit 0 only when every program

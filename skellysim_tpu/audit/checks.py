@@ -127,6 +127,23 @@ def callback_inventory(closed_jaxpr):
 DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
 
 
+def replication_summary(closed_jaxpr):
+    """(report, observed) — the repflow analysis plus its contract-shaped
+    summary dict (what ``--dump-contract`` emits as ``[replication]``)."""
+    from . import repflow
+
+    report = repflow.analyze(closed_jaxpr)
+    observed = None
+    if report.regions:
+        observed = {
+            "mesh_axes": report.mesh_axes,
+            "replicated_outputs": sum(r.replicated_outputs
+                                      for r in report.regions),
+            "varying_outputs": sum(r.varying_outputs for r in report.regions),
+        }
+    return report, observed
+
+
 # ------------------------------------------------------------------ checks
 
 def check_collective_contract(name, built, contract, probe):
@@ -265,6 +282,54 @@ def check_retrace_budget(name, built, contract, probe):
     return []
 
 
+def check_replication(name, built, contract, probe):
+    """Replication-flow analysis (`audit.repflow`, docs/parallel.md):
+    statically prove the program's `shard_map` regions cannot deadlock —
+    no varying `while_loop`/`cond` predicates, no collectives under
+    divergence, every replicated-declared output provably replicated, no
+    ppermute-fed accumulation escaping to a replicated consumer — and pin
+    the replicated-output surface against ``[replication]``."""
+    out = []
+    cid = "replication"
+    report, observed = replication_summary(built.closed_jaxpr)
+    for f in report.findings:
+        out.append(Finding(name, cid, f.message))
+    spec = contract.get("replication")
+    if observed is None:
+        if spec is not None:
+            out.append(Finding(name, cid, (
+                "stale contract: a [replication] section is pinned but the "
+                "lowered program has no shard_map region")))
+        return out
+    if spec is None:
+        out.append(Finding(name, cid, (
+            f"sharded program with no [replication] section: "
+            f"{len(report.regions)} shard_map region(s) over mesh axes "
+            f"{observed['mesh_axes']} — pin mesh_axes / replicated_outputs "
+            "/ varying_outputs (run --dump-contract for the observed "
+            "surface)")))
+        return out
+    pinned_axes = list(spec.get("mesh_axes", []))
+    if pinned_axes != observed["mesh_axes"]:
+        out.append(Finding(name, cid, (
+            f"mesh axes drifted: contract pins {pinned_axes}, the program "
+            f"shards over {observed['mesh_axes']}")))
+    for key, what in (("replicated_outputs", "replicated"),
+                      ("varying_outputs", "varying (sharded)")):
+        pinned = spec.get(key)
+        if pinned is None:
+            out.append(Finding(name, cid, (
+                f"[replication] has no `{key}` pin — the {what} output "
+                "surface must pin its static count")))
+        elif pinned != observed[key]:
+            out.append(Finding(name, cid, (
+                f"{key} drifted: contract pins {pinned}, the analyzed "
+                f"program has {observed[key]} — an output moved across the "
+                "replicated/sharded boundary; re-derive the contract "
+                "deliberately")))
+    return out
+
+
 @dataclass(frozen=True)
 class Check:
     id: str
@@ -295,4 +360,9 @@ CHECKS = (
           "trace_counting_jit compile count across same-structure calls "
           "stays within the contract budget",
           check_retrace_budget, wants_probe=True),
+    Check("replication",
+          "replication-flow analysis over shard_map regions: no varying "
+          "while/cond predicates (the manual-SPMD deadlock), no collectives "
+          "under divergence, replicated outputs provably replicated",
+          check_replication),
 )

@@ -27,7 +27,7 @@ CONTRACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: contract sections the engine understands; anything else is drift (a
 #: typo'd section would otherwise silently stop gating)
 _KNOWN_SECTIONS = ("program", "collectives", "dtype", "host_sync",
-                   "donation", "retrace", "suppress")
+                   "donation", "retrace", "replication", "suppress")
 
 
 @dataclass(frozen=True)
@@ -144,7 +144,8 @@ def run_program_audit(prog, contract=None, checks=None):
 def dump_contract(prog) -> str:
     """The observed inventory of ``prog`` in contract TOML — the starting
     point for writing (or deliberately updating) its contract file."""
-    from .checks import callback_inventory, collective_inventory, dtype_flow
+    from .checks import (callback_inventory, collective_inventory, dtype_flow,
+                         replication_summary)
 
     built = prog.build()
     sites = collective_inventory(built.lowered_text)
@@ -170,6 +171,9 @@ def dump_contract(prog) -> str:
                                        for m in DONATION_MARKERS)}
     if prog.retrace_probe is not None:
         data["retrace"] = {"max_traces": 1}
+    _, replication = replication_summary(built.closed_jaxpr)
+    if replication is not None:
+        data["replication"] = replication
     text = toml_io.dumps(data)
     if weak:
         text += ("\n# NOTE: weak-typed promotions observed (always findings;"

@@ -358,11 +358,11 @@ def flow_multi_local(buckets, caches_list, forces_list, r_loc, r_rep, eta, *,
       neighbor hops, O(N/D) peak memory, identical to `parallel.ring`.
     * ``r_rep`` — targets REPLICATED across shards (body nodes, a
       replicated shell). Evaluated as one local source block partial whose
-      `psum` is the caller's job — summing partials is what keeps the
-      replicated rows bitwise identical on every shard (a ring accumulation
-      would add the same terms in a different order per shard, and
-      ulp-level divergence in replicated values desynchronizes the
-      solver's convergence control flow across devices).
+      `psum` is the caller's job — the replication discipline
+      (docs/parallel.md "Replication discipline", statically enforced by
+      the `replication` audit check): a ring accumulation onto replicated
+      rows is the deadlock anti-pattern the analyzer flags as
+      ring-order-accumulation.
 
     Returns ``(v_loc, v_rep_partial)`` (``None`` for an absent class); when
     ``subtract_self`` the leading rows of ``r_loc`` must be this shard's

@@ -355,6 +355,72 @@ def check_host_sync(mod: ModuleInfo, ctx: RepoContext):
     return out
 
 
+# ------------------------------------------------------- rule: axis-name
+
+#: mesh-axis-taking collective callables, with the positional slot of their
+#: axis-name argument: psum(x, axis_name), ppermute(x, axis_name, perm),
+#: all_gather(x, axis_name, ...), axis_index(axis_name), ...
+COLLECTIVE_AXIS_ARG_POS = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "ppermute": 1,
+    "pshuffle": 1, "all_gather": 1, "all_to_all": 1, "psum_scatter": 1,
+    "axis_index": 0,
+}
+#: keyword spellings of the same argument across the lax collective family
+_AXIS_KWARGS = ("axis_name", "axes")
+
+
+def _axis_literal(node):
+    """The ast node of a hardcoded axis-name string in ``node`` (a string
+    constant, possibly inside a tuple/list of axis names), or None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                return e
+    return None
+
+
+def check_axis_name(mod: ModuleInfo, ctx: RepoContext):
+    """Collective calls with a hardcoded axis-name string literal.
+
+    The mesh axes are defined ONCE (`parallel.mesh.FIBER_AXIS` /
+    `MEMBER_AXIS`); a collective spelled `lax.psum(x, "fib")` keeps working
+    until someone renames the mesh axis, then hangs or mis-reduces with no
+    error pointing at the drifted literal. Jit-reachable code only — the
+    replication analyzer (`audit.repflow`, docs/parallel.md) checks the
+    lowered twin of the same discipline.
+    """
+    out = []
+    rid = "axis-name"
+    for qual, fi in mod.functions.items():
+        if not ctx.is_reachable(mod, qual):
+            continue
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            pos = COLLECTIVE_AXIS_ARG_POS.get(name)
+            if pos is None:
+                continue
+            candidates = [kw.value for kw in node.keywords
+                          if kw.arg in _AXIS_KWARGS]
+            if len(node.args) > pos:
+                candidates.append(node.args[pos])
+            for cand in candidates:
+                if _axis_literal(cand) is not None:
+                    out.append(Finding(
+                        mod.path, node.lineno, node.col_offset, rid,
+                        f"{name}() with a hardcoded axis-name string "
+                        "literal in jit-reachable code: a mesh-axis rename "
+                        "silently strands this collective — use "
+                        "parallel.mesh.FIBER_AXIS / MEMBER_AXIS"))
+                    break
+    return out
+
+
 # ----------------------------------------------- rule: sharding-annotation
 
 def check_sharding_annotation(mod: ModuleInfo, ctx: RepoContext):
@@ -402,6 +468,11 @@ RULES = (
          ".item()/float()/int()/np.asarray on traced values in "
          "jit-reachable code (device->host transfer at trace time)",
          check_host_sync),
+    Rule("axis-name",
+         "collective calls (psum/ppermute/all_gather/...) with a hardcoded "
+         "axis-name string literal instead of parallel.mesh.FIBER_AXIS in "
+         "jit-reachable code",
+         check_axis_name),
     Rule("sharding-annotation",
          "shard_map without explicit in_specs/out_specs; device_put in "
          "parallel/ without an explicit sharding",

@@ -36,13 +36,13 @@ inventory; tests/test_spmd.py pins it against the lowered HLO):
 * one density-sized (3N) `all_gather` per shell operator/preconditioner
   application — the Scatterv analogue, never an operand of fiber-cache size.
 
-Replicated values are kept BITWISE identical across shards by construction:
-anything replicated is computed either from replicated inputs by the single
-compiled program or via a `psum` of per-shard partials (deterministic, same
-result everywhere). A ring accumulation would add the same terms in a
-different order on each shard; ulp-level divergence in a replicated scalar
-would desynchronize the solver's `while_loop` convergence decisions across
-devices — the classic manual-SPMD deadlock.
+Replicated values are kept BITWISE identical across shards by the
+replication discipline (docs/parallel.md "Replication discipline"):
+replicated-inputs-only computation or psum-of-partials, never a ring
+accumulation. This is no longer a prose convention — the `replication`
+audit check (`audit.repflow`) statically verifies it on every registered
+step_spmd program, with the replicated-output surface pinned in
+`audit/contracts/step_spmd_d*.toml`.
 
 The spectral-Ewald evaluator is not served here (its plan is built
 host-side per step and is a different scaling regime); `pair_evaluator`
@@ -184,6 +184,22 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
     audit layer's retrace-probe seam (`testing.trace_counting_jit`).
     """
     p = system.params
+    if (p.guard_dt_halvings or p.guard_block_fallback
+            or p.guard_f64_fallback):
+        # once per BUILD (System.step_spmd caches the program): the mesh
+        # program threads the HEALTH WORD but not the escalation ladder —
+        # silent inertness would surprise a user who armed guard_*
+        # expecting device-side retries. The replication analyzer
+        # (audit.repflow) proves the guard-armed build AND the ladder's
+        # retry pattern replication-safe (tests/test_guard.py), so what
+        # remains for in-mesh escalation is wiring and compile cost, not a
+        # correctness unknown — docs/robustness.md "In-mesh escalation".
+        import warnings
+
+        warnings.warn("Params.guard_* escalation is not applied on the "
+                      "step_spmd path: the mesh program reports health "
+                      "verdicts but does not retry; escalation runs on "
+                      "the single-chip and ensemble paths only")
     axis = FIBER_AXIS
     n_dev = mesh.size
     shell_mode = spmd_shell_mode(

@@ -86,8 +86,13 @@ class Params:
     # any-member). All stages default OFF: the default program is the
     # pre-guard one, and every golden/parity pin stays bitwise. Applies to
     # the single-chip solve and the vmapped ensemble; `step_spmd` threads
-    # the health WORD only and warns at build time if these are armed
-    # (in-mesh escalation is a follow-up — docs/robustness.md).
+    # the health WORD only and warns at build time if these are armed.
+    # In-mesh escalation remains a follow-up, but no longer a folkloric
+    # one: the replication analyzer (audit.repflow, `--check replication`)
+    # proves both the guard-armed mesh build and the ladder's retry
+    # while_loop pattern replication-safe (tests/test_guard.py), so the
+    # blocker is wiring + per-stage compile cost, not deadlock risk —
+    # docs/robustness.md "In-mesh escalation".
     #
     # guard_dt_halvings: retry up to N times at dt/2, dt/4, ... (floored
     # at dt_min under the adaptive gate); the successful retry's dt is

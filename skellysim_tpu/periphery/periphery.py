@@ -280,8 +280,10 @@ def flow_local(shell: PeripheryState, r_loc, r_rep, density, eta, *,
     ``r_loc`` (shard-resident rows — fiber nodes) accumulates over the
     rotating shell source blocks with `lax.ppermute`; ``r_rep``
     (replicated rows — body nodes) is one local source-block partial for
-    the caller to `psum`, which keeps replicated values bitwise identical
-    across shards. Returns ``(v_loc, v_rep_partial)``. The shell
+    the caller to `psum` — the replication discipline (docs/parallel.md,
+    enforced by the `replication` audit check: ringing replicated rows is
+    the ring-order-accumulation finding). Returns ``(v_loc,
+    v_rep_partial)``. The shell
     SELF-interaction is not computed in any mode — it lives in the dense
     stored operator (`System._apply_matvec`)."""
     from ..parallel.ring import ring_flow_local

@@ -109,7 +109,16 @@ def _reductions(rdot):
     (``[n, s]`` — the s-step cycle's batched Gram reduction rides the SAME
     seam: one psum of an ``[rows, s]`` block instead of ``s`` sequential
     ``[rows]`` reductions). The default path keeps `jnp.linalg.norm`
-    bit-for-bit (golden trajectories pin it)."""
+    bit-for-bit (golden trajectories pin it).
+
+    Every reduction through this seam is REPLICATION-RESTORING under the
+    SPMD layout: the sharded head rows contract into one psum (identical
+    result on every shard) and the replicated tail contributes the same
+    product everywhere — which is why the replication analyzer
+    (`audit.repflow`, docs/parallel.md "Replication discipline") can prove
+    the solver's while_loop predicates replicated and the mesh programs
+    deadlock-free, for the sequential AND the s-step batched-Gram cycles.
+    """
     if rdot is None:
         return (lambda A, w: A @ w), jnp.linalg.norm
     return rdot, lambda v: jnp.sqrt(rdot(v, v))

@@ -1176,21 +1176,10 @@ class System:
 
         from ..parallel.spmd import build_spmd_step
 
-        p = self.params
-        if (p.guard_dt_halvings or p.guard_block_fallback
-                or p.guard_f64_fallback):
-            # trace-time (not per-step) diagnostic, like _ring_active's:
-            # the mesh program threads the HEALTH WORD but not the
-            # escalation ladder (build_spmd_step assembles its own
-            # pipeline below _solve_impl; in-mesh retries are a follow-up)
-            # — silent inertness here would surprise a user who armed
-            # guard_* expecting device-side retries (docs/robustness.md)
-            import warnings
-
-            warnings.warn("Params.guard_* escalation is not applied on the "
-                          "step_spmd path: the mesh program reports health "
-                          "verdicts but does not retry; escalation runs on "
-                          "the single-chip and ensemble paths only")
+        # guard_* inertness on this path is diagnosed by build_spmd_step
+        # itself (once per BUILD, not per step_spmd call): the mesh program
+        # threads the health WORD but not the escalation ladder — see the
+        # analyzer-backed follow-up note there and in docs/robustness.md
         buckets = fiber_buckets(state.fibers)
         pair = anchors = None
         if self.params.pair_evaluator == "tree" and all(

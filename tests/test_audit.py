@@ -195,6 +195,222 @@ def test_retrace_budget_flags_over_budget_and_missing_probe():
     assert len(f) == 1 and "no retrace probe" in f[0].message
 
 
+# -------------------------------------------------------------- replication
+
+def _shmap_prog(inner, in_specs, out_specs, *args, name="synthetic"):
+    """A shard_map program on the 8-device mesh, registered audit-style."""
+    from skellysim_tpu.parallel.compat import shard_map
+    from skellysim_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+
+    def fn(*xs):
+        return shard_map(inner, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)(*xs)
+
+    return _prog(fn, *args, name=name)
+
+
+def _fib_P():
+    from jax.sharding import PartitionSpec as P
+
+    from skellysim_tpu.parallel.mesh import FIBER_AXIS
+    return FIBER_AXIS, P
+
+
+#: the four documented anti-patterns (ISSUE 11) as tiny shard_map programs,
+#: each next to its disciplined twin — these pin the analyzer's SEMANTICS
+#: independently of the real registered programs
+def _divergent_while_prog(psum_pred: bool):
+    ax, P = _fib_P()
+
+    def inner(s):
+        def cond(c):
+            i, v = c
+            local = jnp.sum(v)
+            quant = jax.lax.psum(local, ax) if psum_pred else local
+            return (i < 3) & (quant < 100.0)
+
+        def body(c):
+            i, v = c
+            return i + 1, v + jax.lax.psum(v, ax)
+
+        return jax.lax.while_loop(cond, body, (jnp.int32(0), s))[1]
+
+    return _shmap_prog(inner, (P(ax),), P(ax), jnp.zeros(16, jnp.float64))
+
+
+def _collective_under_cond_prog():
+    ax, P = _fib_P()
+
+    def inner(s):
+        return jax.lax.cond(jnp.sum(s) > 0.0,           # local → varying
+                            lambda v: jax.lax.psum(v, ax), lambda v: v, s)
+
+    return _shmap_prog(inner, (P(ax),), P(ax), jnp.zeros(16, jnp.float64))
+
+
+def _unreduced_output_prog(reduced: bool):
+    ax, P = _fib_P()
+
+    def inner(s):
+        total = jnp.sum(s)                               # per-shard partial
+        return jax.lax.psum(total, ax) if reduced else total
+
+    return _shmap_prog(inner, (P(ax),), P(), jnp.zeros(16, jnp.float64))
+
+
+def _ring_accumulation_prog(psum_closed: bool):
+    ax, P = _fib_P()
+
+    def inner(s):
+        if psum_closed:
+            return jax.lax.psum(jnp.sum(s), ax)          # the discipline
+        perm = [(i, (i + 1) % 8) for i in range(8)]
+        acc, blk = s, s
+        for _ in range(7):                               # the anti-pattern
+            blk = jax.lax.ppermute(blk, ax, perm)
+            acc = acc + blk
+        return jnp.sum(acc)
+
+    return _shmap_prog(inner, (P(ax),), P(), jnp.zeros(16, jnp.float64))
+
+
+def _rep_contract(replicated: int, varying: int):
+    """A correct [replication] pin for the one-in/one-out fixtures above."""
+    return {"replication": {"mesh_axes": ["fib"], "replicated_outputs":
+                            replicated, "varying_outputs": varying}}
+
+
+def test_replication_flags_divergent_while_and_passes_psum_pred():
+    f = _audit(_divergent_while_prog(psum_pred=False),
+               _rep_contract(0, 1), checks=["replication"])
+    assert {x.check for x in f} == {"replication"}
+    msgs = " | ".join(x.message for x in f)
+    assert "divergent-control" in msgs
+    assert "collective-under-divergence" in msgs
+    assert _audit(_divergent_while_prog(psum_pred=True),
+                  _rep_contract(0, 1), checks=["replication"]) == []
+
+
+def test_replication_flags_axis_index_derived_predicate():
+    """axis_index is varying BY DEFINITION: a trip count keyed on the shard
+    id (`i < axis_index`) is a real per-shard divergence with a psum in the
+    body — the review-found soundness hole, regression-pinned."""
+    ax, P = _fib_P()
+
+    def inner(s):
+        def cond(c):
+            return c[0] < jax.lax.axis_index(ax)
+
+        def body(c):
+            return c[0] + 1, c[1] + jax.lax.psum(c[1], ax)
+
+        return jax.lax.while_loop(cond, body, (jnp.int32(0), s))[1]
+
+    prog = _shmap_prog(inner, (P(ax),), P(ax), jnp.zeros(16, jnp.float64))
+    f = _audit(prog, _rep_contract(0, 1), checks=["replication"])
+    msgs = " | ".join(x.message for x in f)
+    assert "divergent-control" in msgs
+    assert "collective-under-divergence" in msgs
+    # and axis_index itself is NOT a collective: outside any divergence it
+    # is legal, it just must propagate as varying
+    def inner_ok(s):
+        return s * (1.0 + jax.lax.axis_index(ax).astype(s.dtype))
+
+    ok = _shmap_prog(inner_ok, (P(ax),), P(ax), jnp.zeros(16, jnp.float64))
+    assert _audit(ok, _rep_contract(0, 1), checks=["replication"]) == []
+
+
+def test_replication_flags_collective_under_varying_cond():
+    f = _audit(_collective_under_cond_prog(), _rep_contract(0, 1),
+               checks=["replication"])
+    msgs = " | ".join(x.message for x in f)
+    assert "collective-under-divergence" in msgs
+    assert "divergent-control" in msgs     # the cond-of-collectives variant
+
+
+def test_replication_flags_unreduced_replicated_output():
+    f = _audit(_unreduced_output_prog(reduced=False), _rep_contract(1, 0),
+               checks=["replication"])
+    assert len(f) == 1 and "unreduced-replicated-output" in f[0].message
+    assert _audit(_unreduced_output_prog(reduced=True), _rep_contract(1, 0),
+                  checks=["replication"]) == []
+
+
+def test_replication_flags_ring_order_accumulation():
+    f = _audit(_ring_accumulation_prog(psum_closed=False),
+               _rep_contract(1, 0), checks=["replication"])
+    assert len(f) == 1 and "ring-order-accumulation" in f[0].message
+    assert "different ring order" in f[0].message
+    assert _audit(_ring_accumulation_prog(psum_closed=True),
+                  _rep_contract(1, 0), checks=["replication"]) == []
+
+
+def test_replication_contract_surface_drift_and_staleness():
+    prog = _unreduced_output_prog(reduced=True)
+    # a sharded program must carry the section
+    f = _audit(prog, {}, checks=["replication"])
+    assert len(f) == 1 and "no [replication] section" in f[0].message
+    # count drift: an output moved across the replicated/sharded boundary
+    f = _audit(prog, _rep_contract(2, 0), checks=["replication"])
+    assert len(f) == 1 and "replicated_outputs drifted" in f[0].message
+    # missing pins are findings (a pin-less section would rot silently)
+    f = _audit(prog, {"replication": {"mesh_axes": ["fib"]}},
+               checks=["replication"])
+    assert len(f) == 2 and all("pin" in x.message for x in f)
+    # axis drift
+    f = _audit(prog, {"replication": {"mesh_axes": ["member"],
+                                      "replicated_outputs": 1,
+                                      "varying_outputs": 0}},
+               checks=["replication"])
+    assert len(f) == 1 and "mesh axes drifted" in f[0].message
+    # and a single-device program with a pinned section is stale
+    plain = _prog(lambda x: x + 1.0, jnp.zeros(4, jnp.float64))
+    f = _audit(plain, _rep_contract(1, 0), checks=["replication"])
+    assert len(f) == 1 and "stale contract" in f[0].message
+
+
+def test_replication_violations_gate_the_cli_exit_code(tmp_path, monkeypatch):
+    """The acceptance pin: each seeded anti-pattern flips `--check
+    replication` to exit 1; the disciplined twins exit 0."""
+    import skellysim_tpu.audit.programs as programs_mod
+
+    def rc(prog, contract):
+        monkeypatch.setattr(programs_mod, "all_programs", lambda: [prog])
+        monkeypatch.setattr(engine, "CONTRACT_DIR", str(tmp_path))
+        path = tmp_path / f"{prog.name}.toml"
+        path.write_text(toml_io.dumps(dict({"program": {"name": prog.name}},
+                                           **contract)))
+        return audit_main(["--check", "replication"])
+
+    assert rc(_divergent_while_prog(False), _rep_contract(0, 1)) == 1
+    assert rc(_collective_under_cond_prog(), _rep_contract(0, 1)) == 1
+    assert rc(_unreduced_output_prog(False), _rep_contract(1, 0)) == 1
+    assert rc(_ring_accumulation_prog(False), _rep_contract(1, 0)) == 1
+    assert rc(_divergent_while_prog(True), _rep_contract(0, 1)) == 0
+    assert rc(_unreduced_output_prog(True), _rep_contract(1, 0)) == 0
+
+
+def test_replication_suppression_matches_on_kind():
+    contract = dict(_rep_contract(1, 0), suppress=[{
+        "check": "replication", "match": "ring-order-accumulation",
+        "reason": "fixture: deliberate ring accumulation under test"}])
+    assert _audit(_ring_accumulation_prog(psum_closed=False), contract,
+                  checks=["replication"]) == []
+
+
+def test_replication_dump_contract_roundtrips():
+    base = _unreduced_output_prog(reduced=True)
+    prog = AuditProgram(name="dumprep", layer="test", summary="synthetic",
+                        build=base.build)
+    text = engine.dump_contract(prog)
+    data = toml_io.loads(text)
+    assert data["replication"] == {"mesh_axes": ["fib"],
+                                   "replicated_outputs": 1,
+                                   "varying_outputs": 0}
+
+
 # ----------------------------------------------- contract file / suppression
 
 def test_contract_validation_findings(tmp_path, monkeypatch):

@@ -285,6 +285,83 @@ def test_ladder_nonfinite_is_not_retried():
     assert int(info.health) & verdict.NONFINITE
 
 
+# ---------------------------------------------- in-mesh escalation verdict
+
+def test_guard_armed_spmd_build_warns_and_analyzes_replication_safe():
+    """The params.py guard_* follow-up note, de-folklored (ISSUE 11): a
+    guard-armed `step_spmd_d2` build still warns (the ladder is NOT wired
+    into the mesh program), but the replication analyzer proves the program
+    it actually builds deadlock-free — zero findings, every replicated
+    output verified. The warning therefore documents missing escalation
+    WIRING, not a divergence risk; what runtime work remains is recorded in
+    docs/robustness.md ("In-mesh escalation")."""
+    from skellysim_tpu.audit import fixtures, repflow
+    from skellysim_tpu.parallel import shard_state
+    from skellysim_tpu.parallel.mesh import make_mesh
+    from skellysim_tpu.parallel.spmd import build_spmd_step
+
+    mesh = make_mesh(2)
+    system = fixtures.make_system(gmres_block_s=4, guard_dt_halvings=2,
+                                  guard_block_fallback=True)
+    state = shard_state(fixtures.free_state(system), mesh)
+    with pytest.warns(UserWarning, match="escalation is not applied"):
+        fn = build_spmd_step(system, mesh, state, donate=False)
+    report = repflow.analyze(fn.trace(state).jaxpr)
+    assert report.findings == []
+    assert len(report.regions) == 1
+    assert report.regions[0].axes == ("fib",)
+    assert report.regions[0].replicated_outputs > 0   # info word included
+
+
+def test_in_mesh_escalation_pattern_analyzes_replication_safe():
+    """The follow-up's open question, answered statically: the escalation
+    ladder's retry `while_loop` — predicate on a psum-derived health
+    verdict and residual (exactly `escalate.needs_retry`), body re-solving
+    at dt/2 with collectives inside — analyzes REPLICATED inside
+    `shard_map`. In-mesh escalation is provably replication-safe by the
+    same analyzer that gates the audited programs; the remaining work is
+    threading `_solve_once` overrides through `build_spmd_step` and paying
+    the per-stage compile cost (docs/robustness.md)."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from skellysim_tpu.audit import repflow
+    from skellysim_tpu.parallel.compat import shard_map
+    from skellysim_tpu.parallel.mesh import FIBER_AXIS, make_mesh
+
+    mesh = make_mesh(2)
+
+    def inner(v):
+        def solve(dt):
+            # stand-in for _solve_once: a psum'd reduction (the rdot seam)
+            # and a verdict word derived from the REPLICATED residual
+            resid = lax.psum(jnp.sum(v * v), FIBER_AXIS) * dt
+            health = jnp.where(resid > 0.5, jnp.int32(verdict.STAGNATION),
+                               jnp.int32(0))
+            return resid, health
+
+        resid, health = solve(jnp.float64(1.0))
+
+        def cond(c):
+            tries, dt, r, h = c
+            return (tries < 2) & verdict.retryable(h) & (r > 1e-3)
+
+        def body(c):
+            tries, dt, r, h = c
+            r2, h2 = solve(dt * 0.5)
+            return tries + 1, dt * 0.5, r2, h2
+
+        tries, dt, resid, health = lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.float64(1.0), resid, health))
+        return resid, health, tries
+
+    fn = shard_map(inner, mesh=mesh, in_specs=(P(FIBER_AXIS),),
+                   out_specs=(P(), P(), P()), check_vma=False)
+    report = repflow.analyze(jax.jit(fn).trace(jnp.ones(8)).jaxpr)
+    assert report.findings == []
+    assert report.regions[0].replicated_outputs == 3
+
+
 # ------------------------------------------------------------ real system
 
 @pytest.mark.slow

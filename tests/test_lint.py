@@ -196,6 +196,49 @@ def test_host_sync_suppressed_with_pragma(tmp_path):
     assert lint_paths([p]) == []
 
 
+# ----------------------------------------------------------- axis-name
+
+def test_axis_name_flags_literal_in_jit_reachable(tmp_path):
+    p = _write(tmp_path, "parallel/mod.py", (
+        "import jax\n"
+        "from jax import lax\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    a = lax.psum(x, 'fib')\n"
+        "    b = lax.ppermute(x, axis_name='fib', perm=[(0, 1)])\n"
+        "    c = lax.all_gather(x, ('fib',), tiled=True)\n"
+        "    d = lax.axis_index('fib')\n"
+        "    return a + b + c + d\n"))
+    assert _rules(lint_paths([p])) == ["axis-name"] * 4
+
+
+def test_axis_name_passes_symbolic_axis_and_host_code(tmp_path):
+    p = _write(tmp_path, "parallel/mod.py", (
+        "import jax\n"
+        "from jax import lax\n"
+        "FIBER_AXIS = 'fib'\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    return lax.psum(x, FIBER_AXIS) + helper(x, FIBER_AXIS)\n"
+        "def helper(x, axis_name):\n"
+        "    return lax.pmax(x, axis_name)\n"
+        "def host_only(x):\n"
+        "    # not jit-reachable: a literal here is test/tooling territory\n"
+        "    return lax.psum(x, 'fib')\n"))
+    assert lint_paths([p]) == []
+
+
+def test_axis_name_suppressed_with_pragma(tmp_path):
+    p = _write(tmp_path, "parallel/mod.py", (
+        "import jax\n"
+        "from jax import lax\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    return lax.psum(x, 'fib')  "
+        "# skelly-lint: ignore[axis-name] -- fixture reason\n"))
+    assert lint_paths([p]) == []
+
+
 # -------------------------------------------------- sharding-annotation
 
 def test_sharding_flags_shard_map_without_specs(tmp_path):
