@@ -1482,6 +1482,119 @@ def _group_treecode(extra, ck, on_acc):
     publish()  # always leave an artifact, even if every rung was skipped
 
 
+def _group_compile(extra, ck, on_acc):
+    """skelly-bucket (ISSUE 12): the cold → warm → bucket-hit compile
+    ladder. Three measured rungs per run entry point:
+
+      * ``cold``  — a fresh process with an EMPTY persistent cache pays
+        trace + full XLA compile for its scene's program;
+      * ``warm``  — a second fresh process on the SAME cache dir pays
+        trace + cache load only (the persistent-cache win every CLI now
+        gets by default);
+      * ``bucket_hit`` — a DIFFERENTLY-SHAPED scene landing in an
+        already-compiled capacity bucket inside a running process pays
+        neither: zero new `observed_jit` traces (the zero-compile pin),
+        just a solve. Recorded for the single-run step and the ensemble
+        batched step.
+    """
+    import subprocess
+    import tempfile
+
+    # per-rung bucket identities come from the measurements themselves
+    # (key.describe() in each row) — the cold/warm rungs and the in-process
+    # bucket-hit ladder deliberately use different fiber ladders
+    out = {"scenes": ["3x16", "5x24", "2x8"]}
+    extra["compile"] = out
+    ck()
+
+    # ---- cross-process cold vs warm (persistent cache) -----------------
+    child_src = r"""
+import json, os, sys, time
+from skellysim_tpu.utils.bootstrap import (enable_compilation_cache,
+                                           force_cpu_devices)
+force_cpu_devices(None)
+import jax
+jax.config.update("jax_enable_x64", True)
+enable_compilation_cache(os.environ["BENCH_COMPILE_CACHE"])
+import numpy as np
+from skellysim_tpu.audit import fixtures
+from skellysim_tpu.system import buckets as bucket_mod
+system = fixtures.make_system()
+state = fixtures.free_state(system)
+policy = bucket_mod.BucketPolicy(fiber_ladder=(16, 32), node_ladder=(32,))
+state, key = bucket_mod.bucketize(state, policy)
+t0 = time.perf_counter()
+new_state, _, info = system.step(state)
+float(info.residual)
+print(json.dumps({"step_wall_s": round(time.perf_counter() - t0, 3),
+                  "bucket": key.describe()}))
+"""
+    cache_dir = tempfile.mkdtemp(prefix="bench_compile_cache_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               BENCH_COMPILE_CACHE=cache_dir)
+    for rung in ("cold", "warm"):
+        if _remaining() < 60:
+            out[rung] = {"skipped_budget": int(_remaining())}
+            ck()
+            continue
+        try:
+            t0 = time.monotonic()
+            res = subprocess.run(
+                [sys.executable, "-c", child_src], env=env,
+                capture_output=True, text=True,
+                timeout=max(_remaining() - 10, 30))
+            line = res.stdout.strip().splitlines()[-1]
+            row = json.loads(line)
+            row["process_wall_s"] = round(time.monotonic() - t0, 2)
+            out[rung] = row
+        except Exception as e:
+            out[rung] = {"error": _short_err(e)}
+        ck()
+    if ("step_wall_s" in out.get("cold", {})
+            and "step_wall_s" in out.get("warm", {})):
+        out["warm_speedup"] = round(
+            out["cold"]["step_wall_s"] / max(out["warm"]["step_wall_s"],
+                                             1e-9), 2)
+
+    # ---- in-process bucket hits (the zero-compile pin, measured) -------
+    if _remaining() < 45:
+        out["bucket_hit"] = {"skipped_budget": int(_remaining())}
+        ck()
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        from skellysim_tpu.audit import fixtures
+        from skellysim_tpu.system import BackgroundFlow
+        from skellysim_tpu.system import buckets as bucket_mod
+
+        policy = bucket_mod.BucketPolicy(fiber_ladder=(8, 16),
+                                         node_ladder=(32,))
+        system = fixtures.make_system()
+        rows = []
+        for n_fib, n_nodes, seed in ((3, 16, 1), (5, 24, 2), (2, 8, 3)):
+            st = system.make_state(
+                fibers=fixtures.make_fibers(n_fibers=n_fib, n_nodes=n_nodes,
+                                            seed=seed),
+                background=BackgroundFlow.make(uniform=(1.0, 0.0, 0.0)))
+            st, key = bucket_mod.bucketize(st, policy)
+            t0 = time.perf_counter()
+            _, _, info = system.step(st)
+            float(info.residual)
+            rows.append({"scene": f"{n_fib}x{n_nodes}",
+                         "wall_s": round(time.perf_counter() - t0, 3),
+                         "traces": system._solve_jit.trace_count})
+        out["bucket_hit"] = {
+            "bucket": key.describe(), "steps": rows,
+            # the acceptance pin, as a measured artifact: every scene after
+            # the first rode the first's compiled program
+            "zero_compile_hits": rows[-1]["traces"] == rows[0]["traces"]}
+    except Exception as e:
+        out["bucket_hit"] = {"error": _short_err(e)}
+    ck()
+
+
 #: (name, budget weight) — children run in this order, each in its own
 #: subprocess; weights split the remaining wall budget
 GROUPS = [
@@ -1490,6 +1603,7 @@ GROUPS = [
     ("multichip", _group_multichip, 1.3),
     ("collectives", _group_collectives, 0.7),
     ("treecode", _group_treecode, 1.0),
+    ("compile", _group_compile, 0.8),
     ("solves", _group_solves, 1.0),
     ("coupled", _group_coupled, 2.6),
     ("cells", _group_cells, 1.8),
@@ -1524,11 +1638,12 @@ def _child_main(group: str, out_path: str):
     import jax
 
     jax.config.update("jax_enable_x64", True)
-    try:  # persistent compile cache: re-runs skip remote compiles
-        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 ".jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    try:  # persistent compile cache: re-runs skip remote compiles — the
+        # ONE implementation + min-compile-time threshold in
+        # utils.bootstrap (shared with every CLI and the obs cost gate)
+        from skellysim_tpu.utils.bootstrap import enable_compilation_cache
+
+        enable_compilation_cache("auto")
     except Exception:
         pass
     extra["backend"] = jax.default_backend()

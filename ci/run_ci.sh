@@ -70,6 +70,94 @@ python -m skellysim_tpu.obs summarize "$OBS_TMP"/metrics.jsonl "$OBS_TMP"/trace.
   || { echo "obs summarize smoke failed" >&2; rm -rf "$OBS_TMP"; exit 1; }
 rm -rf "$OBS_TMP"
 
+echo "== bucket: warm-cache + zero-compile smoke (docs/performance.md) =="
+# skelly-bucket acceptance, exit-code gated: (a) two CLI runs sharing one
+# persistent --jax-cache — the second run must add ZERO new entries to the
+# cache (every XLA compile served from disk) and stamp its compile events
+# persistent_cache=true; (b) in-process, a second differently-shaped scene
+# landing in an already-compiled capacity bucket must trigger ZERO new
+# observed_jit traces. ~60 s, dominated by the first run's one cold compile.
+BUCKET_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$BUCKET_TMP" <<'EOF'
+import json, os, subprocess, sys
+import numpy as np
+
+tmp = sys.argv[1]
+cache = os.path.join(tmp, "jax_cache")
+
+from skellysim_tpu.config import BackgroundSource, Config, Fiber
+
+def write_cfg(path, shift):
+    cfg = Config()
+    cfg.params.dt_initial = cfg.params.dt_write = 0.005
+    cfg.params.t_final = 0.01
+    cfg.params.gmres_tol = 1e-10
+    cfg.params.adaptive_timestep_flag = False
+    for i in range(2):
+        fib = Fiber(n_nodes=16, length=1.0, bending_rigidity=0.01)
+        fib.fill_node_positions(np.array([shift + 2.0 * i, 0.0, 0.0]),
+                                np.array([0.0, 0.0, 1.0]))
+        cfg.fibers.append(fib)
+    cfg.background = BackgroundSource(uniform=[1.0, 0.0, 0.0])
+    cfg.save(path)
+
+def cache_entries():
+    if not os.path.isdir(cache):
+        return set()
+    return {f for f in os.listdir(cache) if not f.startswith(".")}
+
+def run(tag):
+    cfgdir = os.path.join(tmp, tag)
+    os.makedirs(cfgdir)
+    cfg = os.path.join(cfgdir, "cfg.toml")
+    write_cfg(cfg, 0.0)
+    trace = os.path.join(cfgdir, "trace.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run([sys.executable, "-m", "skellysim_tpu",
+                    "--config-file", cfg, "--jax-cache", cache,
+                    "--trace-file", trace], env=env, check=True,
+                   timeout=600)
+    events = [json.loads(l) for l in open(trace)]
+    return [e for e in events if e.get("ev") == "compile"]
+
+c1 = run("run1")
+entries1 = cache_entries()
+assert entries1, "first run populated no persistent cache entries"
+c2 = run("run2")
+entries2 = cache_entries()
+assert entries2 == entries1, (
+    f"second run COMPILED fresh programs: {len(entries2 - entries1)} new "
+    "persistent-cache entries (warm start must be fully cache-served)")
+assert c2 and all(e.get("persistent_cache") for e in c2), (
+    "second run's compile events are not stamped persistent_cache=true")
+print(f"warm-cache smoke ok: run2 added 0/{len(entries1)} cache entries, "
+      f"{len(c2)} cache-served compile event(s)")
+
+# (b) in-process zero-compile bucket hit across differently-shaped scenes
+from skellysim_tpu.utils.bootstrap import force_cpu_devices
+force_cpu_devices(1)
+import jax
+jax.config.update("jax_enable_x64", True)
+from skellysim_tpu.audit import fixtures
+from skellysim_tpu.system import BackgroundFlow
+from skellysim_tpu.system import buckets as bucket_mod
+
+policy = bucket_mod.BucketPolicy(fiber_ladder=(8,), node_ladder=(32,))
+system = fixtures.make_system()
+for n_fib, n_nodes, seed in ((3, 16, 1), (5, 24, 2)):
+    st = system.make_state(
+        fibers=fixtures.make_fibers(n_fibers=n_fib, n_nodes=n_nodes,
+                                    seed=seed),
+        background=BackgroundFlow.make(uniform=(1.0, 0.0, 0.0)))
+    st, key = bucket_mod.bucketize(st, policy)
+    _, _, info = system.step(st)
+    assert bool(info.converged)
+assert system._solve_jit.trace_count == 1, (
+    f"bucket hit retraced: {system._solve_jit.trace_count} traces")
+print(f"bucket smoke ok: 2 scenes -> bucket {key.describe()}, 1 trace")
+EOF
+rm -rf "$BUCKET_TMP"
+
 echo "== serve: skelly-serve smoke (2 tenants over TCP, docs/serving.md) =="
 # the acceptance path end to end, in EVERY tier: boot the multi-tenant
 # service as a real subprocess, admit two tenants over the wire, stream

@@ -234,15 +234,12 @@ def build_simulation(config, config_dir: str = ".", dtype=jnp.float64,
             and mesh is not None):
         # round the fiber batch up to a mesh-divisible node count with inert
         # padding fibers so user configs never hit the ring divisibility
-        # ValueError (System._fiber_flow); each bucket is padded to a
-        # mesh-divisible node count, so the concatenated total divides too
-        if isinstance(fibers, fc.FiberGroup):
-            fibers = fc.grow_capacity(fibers, fibers.n_fibers,
-                                      node_multiple=mesh.size)
-        else:
-            fibers = tuple(fc.grow_capacity(g, g.n_fibers,
-                                            node_multiple=mesh.size)
-                           for g in fibers)
+        # ValueError (System._fiber_flow); re-homed onto the one bucket
+        # policy module (`system.buckets.pad_for_mesh`) — each bucket pads
+        # to a mesh-divisible node count, so the concatenated total divides
+        from .system.buckets import pad_for_mesh
+
+        fibers = pad_for_mesh(fibers, mesh.size)
 
     system = System(params, shell_shape=shape, mesh=mesh)
     state = system.make_state(

@@ -48,9 +48,29 @@ def run(config_file: str, resume: bool = False, overwrite: bool = False,
 
     system, state, rng = build_simulation(config_file)
 
+    # skelly-bucket: quantize the scene onto its capacity bucket BEFORE the
+    # first compile, so every scene sharing the bucket key hits one warm
+    # program (docs/performance.md). The default policy is the identity;
+    # [runtime] ladders opt into padding.
+    from .config.schema import load_runtime_config
+    from .system import buckets as bucket_mod
+
+    policy = bucket_mod.BucketPolicy.from_runtime(
+        load_runtime_config(config_file))
+    state, bucket_key = bucket_mod.bucketize(
+        state, policy, pair_evaluator=system.params.pair_evaluator)
+    import logging
+
+    logging.getLogger("skellysim_tpu").info(
+        "scene bucket: %s", bucket_key.describe())
+
     if resume:
         state, rng_state, reader = resume_state(traj, state)
         reader.close()
+        # resume rebuilds fibers from the frame (live rows only) — re-land
+        # on the same bucket so the warm program still serves the run
+        state, bucket_key = bucket_mod.bucketize(
+            state, policy, pair_evaluator=system.params.pair_evaluator)
         if rng_state:
             rng = SimRNG.from_state(rng_state)
         writer = TrajectoryWriter(traj, append=True)
@@ -79,6 +99,25 @@ def run(config_file: str, resume: bool = False, overwrite: bool = False,
     print(f"Finished at t={float(final.time):.6g}")
 
 
+def resolve_cache_dir(config_file: str, *, flag: str | None,
+                      off: bool) -> str:
+    """Persistent-cache resolution shared by the CLIs: ``--no-jax-cache`` >
+    ``--jax-cache DIR`` > the config's ``[runtime] jax_cache`` > "auto"
+    (default-on at `utils.bootstrap.default_cache_dir`). A missing/broken
+    config falls back to "auto" — cache wiring must never mask the real
+    config error the build step will report properly."""
+    if off:
+        return "off"
+    if flag:
+        return flag
+    try:
+        from .config.schema import load_runtime_config
+
+        return load_runtime_config(config_file).jax_cache
+    except Exception:
+        return "auto"
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(
         prog="skellysim-tpu",
@@ -101,8 +140,12 @@ def main(argv=None) -> None:
                          "perfetto/TensorBoard dumps of the whole loop")
     ap.add_argument("--jax-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache directory shared "
-                         "across runs/CLIs: re-runs skip prior compiles "
-                         "(bench.py's .jax_cache pattern)")
+                         "across runs/CLIs (default: [runtime] jax_cache, "
+                         "falling back to the package .jax_cache — the "
+                         "cache is ON unless --no-jax-cache)")
+    ap.add_argument("--no-jax-cache", action="store_true",
+                    help="disable the persistent compilation cache "
+                         "(equivalent to [runtime] jax_cache = 'off')")
     ap.add_argument("--log-level", default=os.environ.get("SKELLYSIM_LOG", "INFO"),
                     help="log level for the skellysim_tpu logger "
                          "(the reference reads SPDLOG_LEVEL similarly)")
@@ -128,7 +171,8 @@ def main(argv=None) -> None:
 
     from .utils.bootstrap import enable_compilation_cache
 
-    enable_compilation_cache(args.jax_cache)
+    enable_compilation_cache(resolve_cache_dir(
+        args.config_file, flag=args.jax_cache, off=args.no_jax_cache))
 
     # multi-host bring-up (no-op single-process; the analogue of the
     # reference's MPI_Init, `skelly_sim.cpp:14`) — must run before any JAX

@@ -3,8 +3,9 @@ from .schema import (BackgroundSource, Body, Config, ConfigEllipsoidal,
                      ConfigRevolution, ConfigSpherical, DynamicInstability,
                      EllipsoidalPeriphery, EnsembleSweep, Fiber, Params,
                      Periphery, PeripheryBinding, Point, RevolutionPeriphery,
-                     ServeConfig, SphericalPeriphery, SweepAxis,
-                     config_from_data, load_config, load_serve_config,
+                     RuntimeConfig, ServeConfig, SphericalPeriphery,
+                     SweepAxis, config_from_data, load_config,
+                     load_runtime_config, load_serve_config,
                      perturbed_fiber_positions, to_runtime_params, unpack)
 from .sweep import (MemberPlan, apply_overrides, expand_members,  # noqa: F401
                     load_members, load_sweep)

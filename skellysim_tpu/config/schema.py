@@ -31,8 +31,9 @@ __all__ = [
     "Periphery", "SphericalPeriphery", "EllipsoidalPeriphery",
     "RevolutionPeriphery", "Body", "Point", "BackgroundSource",
     "Config", "ConfigSpherical", "ConfigEllipsoidal", "ConfigRevolution",
-    "EnsembleSweep", "SweepAxis",
-    "perturbed_fiber_positions", "load_config", "unpack", "to_runtime_params",
+    "EnsembleSweep", "SweepAxis", "RuntimeConfig",
+    "perturbed_fiber_positions", "load_config", "load_runtime_config",
+    "unpack", "to_runtime_params",
 ]
 
 
@@ -506,6 +507,65 @@ def normalized_member_params(params: "Params") -> "Params":
 
 
 @dataclass
+class RuntimeConfig:
+    """`[runtime]` table: host-side execution policy (skelly-bucket +
+    compile cache), shared by every CLI front door (run, ensemble, serve,
+    listener — docs/performance.md "Warm programs and capacity buckets").
+
+    These knobs never enter the traced program: they decide which padded
+    capacity bucket a scene lands in (`system.buckets.BucketPolicy`) and
+    where compiled executables persist across processes.
+    """
+
+    #: persistent XLA compilation cache: "auto" (default) = the package
+    #: root's `.jax_cache` (shared with bench.py and the obs cost gate),
+    #: "off" = disabled, anything else = an explicit directory. CLIs also
+    #: take --jax-cache DIR / --no-jax-cache, which override this key.
+    jax_cache: str = "auto"
+    #: fiber-capacity ladder (ascending ints): scenes pad to the smallest
+    #: rung with inert masked fibers so differently-sized scenes share one
+    #: compiled program. [] = identity (no padding, the default); [-1] =
+    #: the built-in geometric x2 ladder (buckets.GEOMETRIC_FIBER_LADDER).
+    bucket_ladder: List[int] = field(default_factory=list)
+    #: nodes-per-fiber ladder (subset of the valid fiber resolutions
+    #: 8/16/24/32/48/64/96/128): scenes below a rung pad with masked node
+    #: rows whose matrices ride the state as data, so different live
+    #: resolutions share one program. [] = identity (no node padding).
+    node_ladder: List[int] = field(default_factory=list)
+    #: shell quadrature ladder: shells pad to the smallest rung with
+    #: masked quadrature rows (identity-padded operators). [] = off.
+    #: Incompatible with pair_evaluator = "ewald"/"tree".
+    shell_ladder: List[int] = field(default_factory=list)
+
+
+def load_runtime_config(path_or_data) -> RuntimeConfig:
+    """`[runtime]` table of a config TOML (path or parsed dict) ->
+    RuntimeConfig; defaults when absent, unknown keys rejected like
+    `[serve]` (a typo'd ladder silently running identity padding would
+    quietly forfeit every warm-program hit)."""
+    data = (toml_io.load(path_or_data) if isinstance(path_or_data, str)
+            else (path_or_data or {}))
+    table = data.get("runtime", {})
+    known = {f.name for f in dataclasses.fields(RuntimeConfig)}
+    unknown = set(table) - known
+    if unknown:
+        raise ValueError(f"unknown [runtime] keys {sorted(unknown)}; "
+                         f"valid keys: {sorted(known)}")
+    cfg = RuntimeConfig(**table)
+    for name in ("bucket_ladder", "node_ladder", "shell_ladder"):
+        lad = getattr(cfg, name)
+        if name == "bucket_ladder" and list(lad) == [-1]:
+            continue  # the "geometric" spelling
+        if any(int(v) < 1 for v in lad):
+            raise ValueError(f"[runtime] {name} entries must be >= 1 "
+                             "(or bucket_ladder = [-1] for the geometric "
+                             "ladder)")
+        if list(lad) != sorted(set(int(v) for v in lad)):
+            raise ValueError(f"[runtime] {name} must be strictly ascending")
+    return cfg
+
+
+@dataclass
 class ServeConfig:
     """`[serve]` table of a server config TOML (`python -m
     skellysim_tpu.serve`; see docs/serving.md).
@@ -522,8 +582,14 @@ class ServeConfig:
     #: listen port; 0 = ephemeral (pair with the CLI's --port-file)
     port: int = 0
     #: padded fiber capacities, one warm compiled program (bucket) each;
-    #: empty = one bucket at the base config's own fiber count
+    #: empty = derived from the bucket policy (`[runtime] bucket_ladder`
+    #: rungs starting at the base config's fiber count, `bucket_count`
+    #: rungs) — this list remains the manual override
     bucket_capacities: List[int] = field(default_factory=list)
+    #: number of policy-ladder rungs to derive buckets from when
+    #: `bucket_capacities` is empty (starting at the base scene's rung);
+    #: 1 = a single bucket at the base scene's own rung (the default)
+    bucket_count: int = 1
     #: concurrent tenant slots (compiled ensemble lanes) per bucket
     max_lanes: int = 4
     #: admission-queue bound per bucket; a submit beyond it is REJECTED
@@ -585,6 +651,8 @@ def load_serve_config(path: str) -> ServeConfig:
                          f"{cfg.batch_impl!r}; use 'vmap' or 'unroll'")
     if any(c < 1 for c in cfg.bucket_capacities):
         raise ValueError(f"{path}: [serve] bucket_capacities must be >= 1")
+    if cfg.bucket_count < 1:
+        raise ValueError(f"{path}: [serve] bucket_count must be >= 1")
     if cfg.send_timeout_s <= 0:
         raise ValueError(f"{path}: [serve] send_timeout_s must be > 0")
     if cfg.journal_path and cfg.journal_every < 1:

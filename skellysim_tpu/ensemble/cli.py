@@ -51,18 +51,37 @@ def _members_from_sweep(sweep_file: str):
     # one-compiled-program contract shared with skelly-serve admission
     norm = schema.normalized_member_params
 
+    # skelly-bucket: every member quantizes onto the base config's bucket
+    # policy BEFORE stacking, so heterogeneous members (different fiber
+    # counts / live resolutions under a configured ladder) ride one
+    # bucket's compiled program instead of failing the leaf-shape check
+    from ..system import buckets as bucket_mod
+
+    policy = bucket_mod.BucketPolicy.from_runtime(
+        schema.load_runtime_config(base_path))
+
     system = None
     members = []
+    base_key = None
     for plan in plans:
         cfg = apply_overrides(base, plan.overrides)
         sys_i, state_i, _ = build_simulation(cfg, config_dir=config_dir)
+        state_i, key_i = bucket_mod.bucketize(
+            state_i, policy, pair_evaluator=sys_i.params.pair_evaluator)
         if system is None:
             system = sys_i
             base_norm = norm(cfg.params)
+            base_key = key_i
         elif norm(cfg.params) != base_norm:
             sys.exit(f"member {plan.member_id}: overrides changed runtime "
                      "params; ensemble members must share one compiled "
                      "program (sweep state values, not params)")
+        elif key_i != base_key:
+            sys.exit(f"member {plan.member_id}: lands in bucket "
+                     f"{key_i.describe()} but member 0's program is "
+                     f"{base_key.describe()}; widen the [runtime] "
+                     "bucket_ladder/node_ladder so all members share one "
+                     "bucket")
         members.append(MemberSpec(
             member_id=plan.member_id, state=state_i, t_final=plan.t_final,
             rng=SimRNG(plan.seed).member(plan.index)))
@@ -147,8 +166,10 @@ def main(argv=None) -> None:
                          "summarize` reports lane occupancy from it)")
     ap.add_argument("--jax-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache directory shared "
-                         "across runs/CLIs: re-runs skip prior compiles "
-                         "(bench.py's .jax_cache pattern)")
+                         "across runs/CLIs (default-on: [runtime] jax_cache "
+                         "of the BASE config, else the package .jax_cache)")
+    ap.add_argument("--no-jax-cache", action="store_true",
+                    help="disable the persistent compilation cache")
     ap.add_argument("--log-level",
                     default=os.environ.get("SKELLYSIM_LOG", "INFO"))
     args = ap.parse_args(argv)
@@ -166,9 +187,22 @@ def main(argv=None) -> None:
 
     jax.config.update("jax_enable_x64", True)
 
+    from ..cli import resolve_cache_dir
     from ..utils.bootstrap import enable_compilation_cache
 
-    enable_compilation_cache(args.jax_cache)
+    # the [runtime] jax_cache key lives in the sweep's BASE config: resolve
+    # the base path through the sweep spec, then apply the ONE shared
+    # precedence chain (cli.resolve_cache_dir — --no-jax-cache > --jax-cache
+    # > [runtime] jax_cache > auto; unreadable specs fall back to "auto")
+    try:
+        from ..config.sweep import load_sweep, resolve_base_config
+
+        base_path = resolve_base_config(load_sweep(args.sweep_file),
+                                        args.sweep_file)
+    except Exception:
+        base_path = ""
+    enable_compilation_cache(resolve_cache_dir(
+        base_path, flag=args.jax_cache, off=args.no_jax_cache))
 
     run(args.sweep_file, output_dir=args.output_dir, batch=args.batch,
         batch_impl=args.batch_impl, overwrite=args.overwrite,

@@ -25,7 +25,7 @@ import numpy as np
 from ..ops import kernels
 from . import fd_fiber
 from .fd_fiber import FiberScalars
-from .matrices import FibMats, get_mats, typed
+from .matrices import FibMats, get_mats, padded_rt_mats, typed
 
 
 class FiberGroup(NamedTuple):
@@ -51,6 +51,14 @@ class FiberGroup(NamedTuple):
     #: fibers back to this rank so the wire stays reference-ordered
     #: (`trajectory_reader.cpp` reads fibers in config order).
     config_rank: jnp.ndarray = None
+    #: runtime node-capacity mats (`matrices.FibMatsRT`) or None. When set,
+    #: the trailing node rows beyond the live count are masked inert
+    #: capacity (skelly-bucket's node axis): the live resolution's
+    #: differentiation matrices ride the pytree as DATA, so scenes with
+    #: different live node counts share one compiled program at the same
+    #: node capacity. None (the default) keeps the static per-resolution
+    #: constants — bit-identical to the pre-bucket programs.
+    rt_mats: object = None
 
     @property
     def n_fibers(self) -> int:
@@ -61,7 +69,9 @@ class FiberGroup(NamedTuple):
         return self.x.shape[1]
 
     @property
-    def mats(self) -> FibMats:
+    def mats(self):
+        if self.rt_mats is not None:
+            return typed(self.rt_mats, self.x.dtype)
         # cast to the state dtype so f32 groups never promote to f64 under x64
         return typed(get_mats(self.n_nodes), self.x.dtype)
 
@@ -132,6 +142,42 @@ def as_buckets(fibers) -> tuple:
 def node_positions(group: FiberGroup) -> jnp.ndarray:
     """[nf * n, 3] flattened node positions (`get_local_node_positions`)."""
     return group.x.reshape(-1, 3)
+
+
+def live_node_count(group: FiberGroup) -> int:
+    """Host-side live node count per fiber (== n_nodes without node padding)."""
+    if group.rt_mats is None:
+        return group.n_nodes
+    return int(np.asarray(group.rt_mats.node_mask).sum())
+
+
+def node_mask_np(group: FiberGroup) -> np.ndarray:
+    """Host-side [n] bool node mask (all-True without node padding)."""
+    if group.rt_mats is None:
+        return np.ones(group.n_nodes, dtype=bool)
+    return np.asarray(group.rt_mats.node_mask)
+
+
+def strip_node_padding(group: FiberGroup) -> FiberGroup:
+    """Group with masked padding node rows removed (live prefix only) and
+    runtime mats dropped — the WIRE view: trajectory frames carry live
+    nodes only, exactly like they carry active fibers only, so a padded
+    run's output is byte-identical to an unpadded run's."""
+    if group.rt_mats is None:
+        return group
+    nl = live_node_count(group)
+    return group._replace(x=group.x[:, :nl], tension=group.tension[:, :nl],
+                          rt_mats=None)
+
+
+def node_active_flat(group: FiberGroup) -> jnp.ndarray:
+    """Traced [nf * n] bool: node row is live AND its fiber is active —
+    the per-node generalization of the `active` mask (masked-node
+    discipline; consumed by `_spread_inactive` and the fast planners)."""
+    act = jnp.repeat(group.active, group.n_nodes)
+    if group.rt_mats is not None:
+        act = act & jnp.tile(group.rt_mats.node_mask, group.n_fibers)
+    return act
 
 
 def update_cache(group: FiberGroup, dt, eta) -> FiberCaches:
@@ -241,8 +287,10 @@ def _spread_inactive(buckets, pos, fills):
     so the runtime fill set is exactly the first-n_fill sequence prefix the
     planner counted occupancy for — raw slot indices would select an
     arbitrary subsequence whose phases can locally align and overflow the
-    planned capacity (silent point eviction)."""
-    act = jnp.concatenate([jnp.repeat(g.active, g.n_nodes) for g in buckets])
+    planned capacity (silent point eviction). Padded node rows of ACTIVE
+    fibers (skelly-bucket's node axis) are fill slots too — same zero
+    weighted force, same occupancy-only role."""
+    act = jnp.concatenate([node_active_flat(g) for g in buckets])
     rank = jnp.clip(jnp.cumsum(~act) - 1, 0, None)
     return jnp.where(act[:, None], pos, fills[rank])
 
@@ -455,6 +503,13 @@ def step(group: FiberGroup, fiber_sol) -> FiberGroup:
     t_new = fiber_sol[:, 3 * n:]
     x_new = jnp.where(group.active[:, None, None], x_new, group.x)
     t_new = jnp.where(group.active[:, None], t_new, group.tension)
+    if group.rt_mats is not None:
+        # padded node entries solve the identity to exact zero; keep their
+        # far-point placeholder positions instead (distinct coordinates are
+        # what keeps the dense kernels and self-mobility finite)
+        nm = group.rt_mats.node_mask
+        x_new = jnp.where(nm[None, :, None], x_new, group.x)
+        t_new = jnp.where(nm[None, :], t_new, group.tension)
     return group._replace(x=x_new, tension=t_new, length_prev=group.length)
 
 
@@ -490,8 +545,12 @@ def sort_fibers_morton(group: FiberGroup) -> FiberGroup:
     if nf <= 1:
         return group
     # f64 centroids regardless of group dtype: a float32 span floored with a
-    # denormal underflows to 0 and NaN-poisons the Morton codes
-    cent = np.asarray(jnp.mean(group.x, axis=1), dtype=np.float64)  # [nf, 3]
+    # denormal underflows to 0 and NaN-poisons the Morton codes; node-padded
+    # groups centroid over LIVE nodes only (far-point pad rows would snap
+    # every centroid to one octant)
+    nm = node_mask_np(group)
+    cent = np.asarray(
+        jnp.mean(group.x[:, nm, :], axis=1), dtype=np.float64)  # [nf, 3]
     lo = cent.min(axis=0)
     span = np.maximum(cent.max(axis=0) - lo, np.finfo(np.float64).tiny)
     q = np.clip((cent - lo) / span * 1023.0, 0, 1023).astype(np.uint64)
@@ -508,13 +567,16 @@ def sort_fibers_morton(group: FiberGroup) -> FiberGroup:
         | (spread(q[:, 2]) << np.uint64(2))
     order = np.argsort(code, kind="stable")
 
-    def permute(leaf):
+    def permute(name, leaf):
+        if name == "rt_mats" or leaf is None:
+            return leaf  # group-level runtime mats carry no fiber axis
         leaf = np.asarray(leaf)
         if leaf.ndim >= 1 and leaf.shape[0] == nf:
             return leaf[order]
         return leaf
 
-    return type(group)(*[permute(l) for l in group])
+    return type(group)(*[permute(n, l)
+                         for n, l in zip(group._fields, group)])
 
 
 def grow_capacity(group: FiberGroup, new_cap: int,
@@ -539,7 +601,9 @@ def grow_capacity(group: FiberGroup, new_cap: int,
     if pad <= 0:
         return group
 
-    def pad_leaf(leaf):
+    def pad_leaf(name, leaf):
+        if name == "rt_mats" or leaf is None:
+            return leaf  # group-level runtime mats carry no fiber axis
         leaf = np.asarray(leaf)
         if leaf.ndim >= 1 and leaf.shape[0] == nf:
             if nf == 0:
@@ -549,9 +613,49 @@ def grow_capacity(group: FiberGroup, new_cap: int,
             return np.concatenate([leaf, fill], axis=0)
         return leaf
 
-    padded = type(group)(*[pad_leaf(l) for l in group])
+    padded = type(group)(*[pad_leaf(n, l)
+                           for n, l in zip(group._fields, group)])
     active = np.asarray(padded.active)
     active[nf:] = False
     binding_body = np.asarray(padded.binding_body)
     binding_body[nf:] = -1
     return padded._replace(active=active, binding_body=binding_body)
+
+
+def grow_node_capacity(group: FiberGroup, new_n: int) -> FiberGroup:
+    """Pad the NODE axis to ``new_n`` rows per fiber (padding masked inert).
+
+    `grow_capacity` extended to the second shape axis (skelly-bucket): the
+    live resolution's matrices become runtime data (`matrices.FibMatsRT`)
+    riding the group, padded node rows replicate the fiber's FIRST node
+    (the same placeholder discipline as `grow_capacity`'s replicated slot
+    0: zero quadrature weight makes them silent sources, exact-coincidence
+    pairs are dropped by every kernel impl, and staying inside the live
+    geometry keeps the f32 MXU tiles' recentering extent honest), and
+    every operator reduces to the live fiber's math on the live block.
+    ``new_n == n_nodes`` still ATTACHES runtime mats — an exact-fit scene
+    must share its bucket's pytree structure, or it would compile its own
+    program and defeat the bucket.
+    """
+    n = group.n_nodes
+    n_live = live_node_count(group)
+    if new_n < n:
+        raise ValueError(
+            f"grow_node_capacity: new_n {new_n} below current node capacity "
+            f"{n} (node capacity never shrinks)")
+    dtype = group.x.dtype
+    rt = padded_rt_mats(n_live, new_n, dtype)
+    pad = new_n - n
+    if pad == 0:
+        return group._replace(rt_mats=rt)
+    nf = group.n_fibers
+
+    x_np = np.asarray(group.x)
+    fill = np.repeat(x_np[:, :1, :], pad, axis=1)      # replicate node 0
+    x = np.concatenate([x_np, fill], axis=1)
+    tension = np.concatenate(
+        [np.asarray(group.tension),
+         np.zeros((nf, pad), dtype=np.asarray(group.tension).dtype)], axis=1)
+    return group._replace(x=jnp.asarray(x, dtype=dtype),
+                          tension=jnp.asarray(tension, dtype=dtype),
+                          rt_mats=rt)

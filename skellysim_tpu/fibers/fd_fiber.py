@@ -132,35 +132,54 @@ def build_RHS(x, xs, xss, dt, eta, sc: FiberScalars, mats, flow=None, f_external
     return jnp.concatenate([rhs_xyz[:, 0], rhs_xyz[:, 1], rhs_xyz[:, 2], rhs_T])
 
 
+def _last_node(mats):
+    """Last-LIVE-node selector: ``a[-1]`` with static mats, an ``e_last``
+    contraction with runtime node-padded mats (`matrices.FibMatsRT`) where
+    the last live node's index is data, not a static."""
+    e_last = getattr(mats, "e_last", None)
+    if e_last is None:
+        return lambda a: a[-1]
+    return lambda a: jnp.tensordot(e_last.astype(a.dtype), a, axes=1)
+
+
 def _bc_rows(x, xs, xss, dt, eta, sc: FiberScalars, mats,
              minus_clamped, plus_pinned, v_on_fiber, f_on_fiber):
     """The 14 boundary-condition rows B [14, 4n] and their RHS [14].
 
     Mirror of `apply_bc_rectangular` (`fiber_finite_difference.cpp:347-513`).
     Both branch variants are built densely and selected by the boolean flags so
-    the result is vmappable; per-row costs are O(n) so this is cheap.
-    """
+    the result is vmappable; per-row costs are O(n) so this is cheap. With
+    runtime node-padded mats the plus-end rows read the last LIVE node via
+    the `e_last` one-hot instead of the static ``[-1]`` (the padded suffix
+    rows are inert capacity, not the fiber's plus end)."""
     n = x.shape[0]
     dtype = x.dtype
     mats = matrices.typed(mats, dtype)
+    last = _last_node(mats)
     E = sc.bending_rigidity
     c0, _c1 = sbt_constants(sc.radius, sc.length, eta)
     s = 2.0 / sc.length
     d1_0, d2_0, d3_0 = s * mats.D1[0], s**2 * mats.D2[0], s**3 * mats.D3[0]
-    d1_e, d2_e, d3_e = s * mats.D1[-1], s**2 * mats.D2[-1], s**3 * mats.D3[-1]
+    d1_e, d2_e, d3_e = s * last(mats.D1), s**2 * last(mats.D2), \
+        s**3 * last(mats.D3)
 
     zero = jnp.zeros(n, dtype=dtype)
     e0 = jnp.zeros(n, dtype=dtype).at[0].set(1.0)
-    ee = jnp.zeros(n, dtype=dtype).at[-1].set(1.0)
+    ee = (jnp.zeros(n, dtype=dtype).at[-1].set(1.0)
+          if getattr(mats, "e_last", None) is None
+          else mats.e_last.astype(dtype))
+    x_e, xs_e, xss_e = last(x), last(xs), last(xss)
 
     def row(bx=None, by=None, bz=None, bt=None):
         parts = [zero if b is None else b for b in (bx, by, bz, bt)]
         return jnp.concatenate(parts)
 
     v0 = v_on_fiber[0] if v_on_fiber is not None else jnp.zeros(3, dtype=dtype)
-    ve = v_on_fiber[-1] if v_on_fiber is not None else jnp.zeros(3, dtype=dtype)
+    ve = (last(v_on_fiber) if v_on_fiber is not None
+          else jnp.zeros(3, dtype=dtype))
     f0 = f_on_fiber[0] if f_on_fiber is not None else jnp.zeros(3, dtype=dtype)
-    fe = f_on_fiber[-1] if f_on_fiber is not None else jnp.zeros(3, dtype=dtype)
+    fe = (last(f_on_fiber) if f_on_fiber is not None
+          else jnp.zeros(3, dtype=dtype))
 
     bod = sc.beta_tstep / dt
 
@@ -206,25 +225,25 @@ def _bc_rows(x, xs, xss, dt, eta, sc: FiberScalars, mats,
         row(bx=bod * ee),
         row(by=bod * ee),
         row(bz=bod * ee),
-        row(bx=6.0 * E * c0 * xss[-1, 0] * d3_e,
-            by=6.0 * E * c0 * xss[-1, 1] * d3_e,
-            bz=6.0 * E * c0 * xss[-1, 2] * d3_e,
+        row(bx=6.0 * E * c0 * xss_e[0] * d3_e,
+            by=6.0 * E * c0 * xss_e[1] * d3_e,
+            bz=6.0 * E * c0 * xss_e[2] * d3_e,
             bt=2.0 * c0 * d1_e),
     ])
     pinned_rhs = jnp.concatenate([
-        x[-1] / dt,
-        (-jnp.dot(xs[-1], ve) - 2.0 * c0 * jnp.dot(xs[-1], fe))[None],
+        x_e / dt,
+        (-jnp.dot(xs_e, ve) - 2.0 * c0 * jnp.dot(xs_e, fe))[None],
     ])
     freep_rows = jnp.stack([
-        row(bx=-E * d3_e, bt=xs[-1, 0] * ee),
-        row(by=-E * d3_e, bt=xs[-1, 1] * ee),
-        row(bz=-E * d3_e, bt=xs[-1, 2] * ee),
-        row(bx=E * xss[-1, 0] * d2_e,
-            by=E * xss[-1, 1] * d2_e,
-            bz=E * xss[-1, 2] * d2_e,
+        row(bx=-E * d3_e, bt=xs_e[0] * ee),
+        row(by=-E * d3_e, bt=xs_e[1] * ee),
+        row(bz=-E * d3_e, bt=xs_e[2] * ee),
+        row(bx=E * xss_e[0] * d2_e,
+            by=E * xss_e[1] * d2_e,
+            bz=E * xss_e[2] * d2_e,
             bt=ee),
     ])
-    freep_rhs = jnp.concatenate([fe, jnp.dot(fe, xs[-1])[None]])
+    freep_rhs = jnp.concatenate([fe, jnp.dot(fe, xs_e)[None]])
     rows_p1 = jnp.where(plus_pinned, pinned_rows, freep_rows)
     rhs_p1 = jnp.where(plus_pinned, pinned_rhs, freep_rhs)
 
@@ -242,12 +261,25 @@ def apply_bc_rectangular(A, RHS, x, xs, xss, dt, eta, sc: FiberScalars, mats,
     """Downsample A/RHS and overwrite the last 14 rows with BC rows.
 
     Mirror of `apply_bc_rectangular` (`fiber_finite_difference.cpp:347-513`).
-    """
+    With runtime node-padded mats, each padded solution entry's row is then
+    overwritten with its P_down one-hot (an exact unit row) and its RHS
+    zeroed: padded entries solve the identity, exactly like inactive fiber
+    SLOTS do in `container.update_rhs_and_bc` — the masked-node half of the
+    skelly-bucket discipline."""
     P = jnp.asarray(mats.P_down, dtype=A.dtype)
     B, B_rhs = _bc_rows(x, xs, xss, dt, eta, sc, mats,
                         minus_clamped, plus_pinned, v_on_fiber, f_on_fiber)
     A_bc = jnp.concatenate([P @ A, B], axis=0)
     RHS_bc = jnp.concatenate([P @ RHS, B_rhs])
+    rm = getattr(mats, "row_mask", None)
+    if rm is not None:
+        # the padded rows of P are one-hot at their own solution entry, so
+        # selecting P itself there makes those rows exact unit rows; the 14
+        # BC rows are always live (rm is True there)
+        unit = jnp.concatenate(
+            [P, jnp.zeros((14, P.shape[1]), dtype=A.dtype)], axis=0)
+        A_bc = jnp.where(rm[:, None], A_bc, unit)
+        RHS_bc = jnp.where(rm, RHS_bc, 0.0)
     return A_bc, RHS_bc
 
 
@@ -283,6 +315,13 @@ def matvec(A_bc, xvec, v, v_boundary, xs, sc: FiberScalars, mats, plus_pinned):
     n = xs.shape[0]
     mats = matrices.typed(mats, xvec.dtype)
     bc_start = 4 * n - 14
+    nm = getattr(mats, "node_mask", None)
+    if nm is not None:
+        # padded node rows carry whatever the flow evaluator computed at
+        # their far-point placeholders; they must contribute exactly zero
+        # so padded solution entries stay on the identity
+        v = jnp.where(nm[:, None], v, 0.0)
+    last = _last_node(mats)
     D1p = (2.0 / sc.length_prev) * mats.D1
     vT_tension = D1p @ jnp.sum(xs * v, axis=1)
     vT = jnp.concatenate([v[:, 0], v[:, 1], v[:, 2], vT_tension])
@@ -291,14 +330,22 @@ def matvec(A_bc, xvec, v, v_boundary, xs, sc: FiberScalars, mats, plus_pinned):
 
     res = A_bc @ xvec - vT_in
     res = res.at[bc_start + 3].add(jnp.dot(v[0], xs[0]))
-    res = res.at[bc_start + 10].add(jnp.where(plus_pinned, jnp.dot(v[-1], xs[-1]), 0.0))
+    res = res.at[bc_start + 10].add(
+        jnp.where(plus_pinned, jnp.dot(last(v), last(xs)), 0.0))
     if v_boundary is not None:
         res = res.at[bc_start:bc_start + 7].add(v_boundary)
     return res
 
 
 def fiber_error(x, length, mats):
-    """max_i | ||xs_i|| - 1 | — inextensibility violation (`fiber_error_local`)."""
+    """max_i | ||xs_i|| - 1 | — inextensibility violation (`fiber_error_local`).
+
+    Padded node rows (runtime mats) are excluded: their xs vanish
+    identically, which would read as a permanent error of 1."""
     mats = matrices.typed(mats, x.dtype)
     xs = (2.0 / length) * (mats.D1 @ x)
-    return jnp.max(jnp.abs(jnp.linalg.norm(xs, axis=1) - 1.0))
+    err = jnp.abs(jnp.linalg.norm(xs, axis=1) - 1.0)
+    nm = getattr(mats, "node_mask", None)
+    if nm is not None:
+        err = jnp.where(nm, err, 0.0)
+    return jnp.max(err)

@@ -16,6 +16,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from functools import lru_cache
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..ops.finite_diff import barycentric_matrix, finite_diff
@@ -45,6 +46,115 @@ class FibMats:
     weights0: np.ndarray       # [n] trapezoid weights on [-1, 1]
 
 
+from typing import NamedTuple
+
+
+class FibMatsRT(NamedTuple):
+    """Runtime (traced) fiber matrices for a node-capacity bucket.
+
+    The shape-polymorphism twin of `FibMats` (skelly-bucket): the live
+    resolution's matrices live as the top-left block of capacity-sized
+    ARRAYS that ride the `FiberGroup` pytree as data, so two scenes with
+    different live node counts but the same node capacity share one
+    compiled program — the live count is a value, not a static. Padded
+    (suffix) node rows/columns are exact zeros in every derivative
+    matrix, so derivatives of padded rows vanish identically and the
+    masked operators reduce to the live fiber's math bit-for-bit on the
+    live block.
+
+    A NamedTuple (hence a pytree): ensemble stacking, donation, and
+    sharding treat the matrices like any other state leaf. All leaves are
+    group-level (no [nf] axis) — the container's vmapped per-fiber
+    closures capture them broadcast, like the static mats they replace.
+    """
+
+    alpha: jnp.ndarray      # [n_cap] live alpha prefix (pad values unused)
+    D1: jnp.ndarray         # [n_cap, n_cap] live block top-left, zeros pad
+    D2: jnp.ndarray
+    D3: jnp.ndarray
+    D4: jnp.ndarray
+    #: [4n_cap-14, 4n_cap]: the live P_down blocks scattered into capacity
+    #: coordinates; each padded solution entry gets its own one-hot row, so
+    #: `where(row_mask, P @ A, P)` leaves padded rows as exact unit rows
+    P_down: jnp.ndarray
+    weights0: jnp.ndarray   # [n_cap] live trapezoid weights, zeros pad
+    #: [n_cap] one-hot at the LAST LIVE node — replaces every static
+    #: ``x[-1]`` / ``D[-1]`` boundary-condition read with a data-dependent
+    #: contraction
+    e_last: jnp.ndarray
+    node_mask: jnp.ndarray  # [n_cap] bool, True on live nodes
+    #: [4n_cap] bool over the BC'd row space [P_down rows | 14 BC rows]:
+    #: False exactly on the padded entries' one-hot rows
+    row_mask: jnp.ndarray
+    #: [4n_cap] bool over the solution layout [x | y | z | T]
+    sol_mask: jnp.ndarray
+
+    @property
+    def n_nodes(self) -> int:
+        return self.D1.shape[0]
+
+
+def padded_rt_mats(n_live: int, n_cap: int, dtype=np.float64) -> FibMatsRT:
+    """Host-side FibMatsRT for ``n_live`` live nodes in an ``n_cap`` bucket.
+
+    ``n_live == n_cap`` is valid (runtime mats with no padded rows — the
+    shape a bucket's program is traced for serves every smaller live
+    count). Both counts must be in `VALID_NODE_COUNTS`."""
+    if n_live > n_cap:
+        raise ValueError(f"n_live {n_live} exceeds node capacity {n_cap}")
+    live = get_mats(n_live)
+    if n_cap not in VALID_NODE_COUNTS:
+        raise ValueError(
+            f"node capacity must be one of {VALID_NODE_COUNTS}, got {n_cap}")
+    nl, nc = n_live, n_cap
+    pad = nc - nl
+
+    def pad_mat(m):
+        out = np.zeros((nc, nc))
+        out[:nl, :nl] = m
+        return out
+
+    alpha = np.zeros(nc)
+    alpha[:nl] = live.alpha
+    weights0 = np.zeros(nc)
+    weights0[:nl] = live.weights0
+    e_last = np.zeros(nc)
+    e_last[nl - 1] = 1.0
+    node_mask = np.zeros(nc, dtype=bool)
+    node_mask[:nl] = True
+
+    # P_down in capacity coordinates: per solution block (x, y, z, T) the
+    # live downsample rows come first, then one one-hot row per padded
+    # entry (rows land where `apply_bc_rectangular`'s padded-row overwrite
+    # expects exact unit rows)
+    P = np.zeros((4 * nc - 14, 4 * nc))
+    row_mask = np.ones(4 * nc, dtype=bool)
+    r = 0
+    for b, (blk, nrow) in enumerate(
+            [(live.P_X, nl - 4)] * 3 + [(live.P_T, nl - 2)]):
+        P[r:r + nrow, b * nc:b * nc + nl] = blk
+        r += nrow
+        for j in range(pad):
+            P[r, b * nc + nl + j] = 1.0
+            row_mask[r] = False
+            r += 1
+    assert r == 4 * nc - 14
+
+    sol_mask = np.tile(node_mask, 4)
+    c = np.dtype(dtype)
+    return FibMatsRT(
+        alpha=jnp.asarray(alpha, dtype=c), D1=jnp.asarray(pad_mat(live.D1), dtype=c),
+        D2=jnp.asarray(pad_mat(live.D2), dtype=c),
+        D3=jnp.asarray(pad_mat(live.D3), dtype=c),
+        D4=jnp.asarray(pad_mat(live.D4), dtype=c),
+        P_down=jnp.asarray(P, dtype=c),
+        weights0=jnp.asarray(weights0, dtype=c),
+        e_last=jnp.asarray(e_last, dtype=c),
+        node_mask=jnp.asarray(node_mask),
+        row_mask=jnp.asarray(row_mask),
+        sol_mask=jnp.asarray(sol_mask))
+
+
 def _cast_mats(m: FibMats, dtype_name: str) -> FibMats:  # skelly-lint: ignore-function[host-sync] — casts host NumPy FibMats constants (never traced values) with a static dtype name; runs at trace time by design (module docstring)
     def c(a):
         return np.asarray(a, dtype=dtype_name)
@@ -71,6 +181,16 @@ def typed(mats: FibMats, dtype) -> FibMats:
     a caller-customized FibMats is cast directly (never swapped for the
     pristine cached matrices).
     """
+    if isinstance(mats, FibMatsRT):
+        # runtime mats are traced data: constructed at the state dtype by
+        # `padded_rt_mats`, so the cast is a no-op on the hot path; a
+        # mismatched caller gets explicit converts rather than silent
+        # promotion
+        if mats.D1.dtype == jnp.dtype(dtype):
+            return mats
+        return FibMatsRT(*[
+            leaf.astype(dtype) if jnp.issubdtype(leaf.dtype, jnp.floating)
+            else leaf for leaf in mats])
     name = np.dtype(dtype).name
     if mats.D1.dtype == np.dtype(dtype):
         return mats

@@ -33,10 +33,15 @@ FIBER_TYPE_FINITE_DIFFERENCE = 1
 
 
 def _bucket_list(fibers) -> list:
-    """SimState.fibers (group | tuple of resolution buckets | None) -> list."""
-    from ..fibers.container import as_buckets
+    """SimState.fibers (group | tuple of resolution buckets | None) -> list.
 
-    return list(as_buckets(fibers))
+    Masked node padding (skelly-bucket) is stripped here — the ONE place
+    every frame encoder goes through — so the wire carries live node rows
+    only and a bucketized run's trajectory is byte-identical to an
+    unpadded run's (inactive fiber slots are already dropped per fiber)."""
+    from ..fibers.container import as_buckets, strip_node_padding
+
+    return [strip_node_padding(g) for g in as_buckets(fibers)]
 
 
 def _active_ranks(group) -> np.ndarray:
@@ -45,6 +50,19 @@ def _active_ranks(group) -> np.ndarray:
     if group.config_rank is None:
         return np.flatnonzero(active)
     return np.asarray(group.config_rank)[active]
+
+
+def _shell_wire_density(state) -> np.ndarray:
+    """Shell density as the wire carries it: live quadrature rows only —
+    masked padding rows (skelly-bucket) hold exact zeros and are sliced
+    off, keeping padded runs byte-identical to unpadded ones."""
+    if state.shell is None:
+        return np.zeros(0)
+    density = np.asarray(state.shell.density, dtype=np.float64)
+    if state.shell.node_mask is not None:
+        density = density[:3 * int(np.asarray(
+            state.shell.node_mask).sum())]
+    return density
 
 
 # ---------------------------------------------------------------- frame build
@@ -137,8 +155,7 @@ def state_to_frame(state, rng_state=None) -> dict:
                         [m for _, m in entries]]
     else:
         fibers_field = [FIBER_TYPE_NONE, []]
-    shell_sol = (np.asarray(state.shell.density, dtype=np.float64)
-                 if state.shell is not None else np.zeros(0))
+    shell_sol = _shell_wire_density(state)
     return {
         "time": float(state.time),
         "dt": float(state.dt),
@@ -294,8 +311,7 @@ def frame_bytes(state, rng_state=None) -> bytes:
                     + b"".join(c for _, c in entries))
     else:
         fibers_b = msgpack.packb([FIBER_TYPE_NONE, []])
-    shell_sol = (np.asarray(state.shell.density, dtype=np.float64)
-                 if state.shell is not None else np.zeros(0))
+    shell_sol = _shell_wire_density(state)
     return b"".join([
         eigen.mp_map_header(6),
         msgpack.packb("time"), msgpack.packb(float(state.time)),
@@ -612,6 +628,15 @@ def frame_to_state(frame: dict, template_state, dtype=None):
     if state.shell is not None and shell_sol.size == state.shell.density.shape[0]:
         state = state._replace(shell=state.shell._replace(
             density=jnp.asarray(shell_sol, dtype=dtype)))
+    elif (state.shell is not None and state.shell.node_mask is not None
+          and shell_sol.size == 3 * int(np.asarray(
+              state.shell.node_mask).sum())):
+        # live-rows wire density over a capacity-padded template: scatter
+        # into the live prefix, padded rows stay exact zero
+        full = np.zeros(state.shell.density.shape[0])
+        full[:shell_sol.size] = shell_sol.reshape(-1)
+        state = state._replace(shell=state.shell._replace(
+            density=jnp.asarray(full, dtype=dtype)))
 
     state = state._replace(
         time=jnp.asarray(frame["time"], dtype=dtype),

@@ -142,19 +142,27 @@ def _vel_fn_for(system, pair):
 
 
 def process_request(system, template_state, reader: TrajectoryReader,
-                    cmd: dict, vel_fn=None) -> dict | None:
+                    cmd: dict, vel_fn=None, policy=None) -> dict | None:
     """One request → response dict, or None for an invalid frame.
 
     ``vel_fn(pts, state, solution)`` must be a *stable* function across
     requests (created once per server); per-frame state/solution flow through
     `field_args` so the compiled streamline integrator is reused instead of
-    retraced on every request.
+    retraced on every request. ``policy`` (a `system.buckets.BucketPolicy`)
+    re-lands each decoded frame on the server's capacity bucket, so frames
+    whose live fiber count drifted (dynamic instability) still hit the warm
+    compiled field programs.
     """
     frame_no = int(cmd.get("frame_no", 0))
     if frame_no < 0 or frame_no >= len(reader):
         return None
     frame = reader.load_frame(frame_no)
     state = frame_to_state(frame, template_state)
+    if policy is not None:
+        from .system import buckets as bucket_mod
+
+        state, _ = bucket_mod.bucketize(
+            state, policy, pair_evaluator=system.params.pair_evaluator)
     solution = solution_from_state(state)
 
     sl_req = cmd.get("streamlines") or {}
@@ -211,6 +219,17 @@ def serve(config_file: str = "skelly_config.toml",
         os.path.dirname(os.path.abspath(config_file)) or ".", "skelly_sim.out")
 
     system, template_state, _ = build_simulation(config_file)
+    # skelly-bucket: the listener quantizes its template (and every decoded
+    # frame, see process_request) onto the config's capacity bucket before
+    # the first compile — post-processing over a long trajectory then runs
+    # one warm field program per evaluator
+    from .config.schema import load_runtime_config
+    from .system import buckets as bucket_mod
+
+    policy = bucket_mod.BucketPolicy.from_runtime(
+        load_runtime_config(config_file))
+    template_state, _ = bucket_mod.bucketize(
+        template_state, policy, pair_evaluator=system.params.pair_evaluator)
     reader = TrajectoryReader(traj)
     print(f"Entering listener mode ({len(reader)} frames)", file=sys.stderr)
 
@@ -237,7 +256,8 @@ def serve(config_file: str = "skelly_config.toml",
         # velocity-field fns are cached per (system, plan) in _vel_fn_for,
         # so an evaluator switch naturally rebinds while repeated frames on
         # one evaluator reuse the compiled integrator
-        response = process_request(system, template_state, reader, cmd)
+        response = process_request(system, template_state, reader, cmd,
+                                   policy=policy)
         if response is None:
             protocol.write_empty(stdout)
             continue

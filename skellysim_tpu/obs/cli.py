@@ -25,28 +25,20 @@ import sys
 
 
 def _bootstrap_backend():
-    import os
-
-    from ..utils.bootstrap import force_cpu_devices
+    from ..utils.bootstrap import enable_compilation_cache, force_cpu_devices
 
     force_cpu_devices(8)
     import jax
 
     jax.config.update("jax_enable_x64", True)
-    try:
-        # persistent compile cache (shared with bench.py): the cost gate
-        # compiles every registered program, and warm CI re-runs skip the
-        # XLA compile seconds — tracing/lowering (which the measurements
-        # come from) is unaffected, and cost/memory analyses read the same
-        # values off cache-loaded executables (pinned by the double-run in
-        # the CI gate's bring-up)
-        cache_dir = os.path.join(
-            os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    # persistent compile cache — the ONE implementation + min-compile-time
+    # threshold in utils.bootstrap, shared with bench.py and every CLI: the
+    # cost gate compiles every registered program, and warm CI re-runs skip
+    # the XLA compile seconds — tracing/lowering (which the measurements
+    # come from) is unaffected, and cost/memory analyses read the same
+    # values off cache-loaded executables (pinned by the double-run in the
+    # CI gate's bring-up)
+    enable_compilation_cache("auto")
 
 
 def _cmd_summarize(args) -> int:

@@ -37,6 +37,17 @@ _DTYPE_SHORT = {"float64": "f64", "float32": "f32", "bfloat16": "bf16",
 _SIG_MAX_LEAVES = 16
 
 
+def _cache_active() -> bool:
+    """True when a persistent XLA compilation cache directory is set —
+    the compile event's cold-vs-cache-served discriminator."""
+    import jax
+
+    try:
+        return bool(jax.config.jax_compilation_cache_dir)
+    except AttributeError:
+        return False
+
+
 def arg_signature(args, kwargs) -> str:
     """Compact shape/dtype signature of a call's pytree leaves, e.g.
     ``f64[16,16,3],f64[],i32[16]`` — the retrace-diagnosis payload."""
@@ -98,7 +109,11 @@ class ObservedJit:
                     wall_s=round(time.perf_counter() - t0, 6),
                     trace_s=round(self._trace_s, 6),
                     traces=self._count, donated=self._donated,
-                    arg_sig=arg_signature(args, kwargs))
+                    arg_sig=arg_signature(args, kwargs),
+                    # whether a persistent XLA cache dir was active for
+                    # this compile: `obs summarize` splits true cold
+                    # compiles from cache-served ones on this stamp
+                    persistent_cache=_cache_active())
         return out
 
     # audit/cost seam: `built_from` traces/lowers through the wrapper

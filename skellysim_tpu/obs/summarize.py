@@ -120,10 +120,18 @@ class Summary:
             return
         out.append("== compile events ==")
         for rec in self.compiles:
+            # cache stamp (skelly-bucket): "cached" = a persistent XLA
+            # cache dir was active, so the wall time is trace + cache
+            # load, not a true cold compile; older streams without the
+            # stamp render as "?"
+            cache = rec.get("persistent_cache")
+            cache_s = ("?" if cache is None
+                       else ("cached" if cache else "cold"))
             out.append(
                 f"{rec.get('name', '?')}: trace #{rec.get('traces', '?')} "
                 f"wall={rec.get('wall_s', '?')}s "
                 f"trace={rec.get('trace_s', '?')}s "
+                f"cache={cache_s} "
                 f"donated={rec.get('donated', [])} "
                 f"sig={str(rec.get('arg_sig', ''))[:120]}")
         by_name: dict[str, int] = {}

@@ -134,6 +134,7 @@ def shard_state(state, mesh: Mesh, *, allow_replicated_shell: bool = False):
         # replicate them first — peak per-device memory would be the full
         # matrix)
         shell = type(shell)(*[
+            leaf if leaf is None else
             jax.device_put(jax.numpy.asarray(leaf),
                            big if name in SHELL_ROW_SHARDED_FIELDS else
                            rep_sharding)

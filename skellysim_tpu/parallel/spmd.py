@@ -131,9 +131,11 @@ def _state_specs(state: SimState, shell_mode: str) -> SimState:
     if state.shell is not None:
         if shell_mode == "sharded":
             # every shell leaf is leading-axis sharded: nodes/normals [N, 3],
-            # weights [N], density [3N], and the dense operators' ROWS
+            # weights [N], density [3N], and the dense operators' ROWS;
+            # absent optional fields (node_mask) are empty subtrees
             shell_spec = type(state.shell)(
-                *[P(FIBER_AXIS) for _ in state.shell._fields])
+                *[None if leaf is None else P(FIBER_AXIS)
+                  for leaf in state.shell])
         else:
             shell_spec = rep(state.shell)
     return SimState(time=P(), dt=P(), fibers=fib_spec,

@@ -39,6 +39,11 @@ class PeripheryState(NamedTuple):
     M_inv: jnp.ndarray        # [3N, 3N] preconditioner
     stresslet_plus_complementary: jnp.ndarray  # [3N, 3N] operator
     density: jnp.ndarray      # [3N] current solution slice
+    #: [N] bool quadrature-row mask, or None (all rows live — the default).
+    #: Padded rows (skelly-bucket's shell axis, `grow_capacity`) carry zero
+    #: normals/weights and solve the identity: scenes with different shell
+    #: quadrature sizes share one compiled program at a capacity rung.
+    node_mask: jnp.ndarray = None
 
     @property
     def n_nodes(self) -> int:
@@ -182,10 +187,70 @@ def make_state(nodes, normals, weights, operator, M_inv, dtype=jnp.float64,
     )
 
 
+def grow_capacity(shell: PeripheryState, new_n: int) -> PeripheryState:
+    """Shell state padded to ``new_n`` quadrature rows (masked inert).
+
+    The shell leg of skelly-bucket's capacity discipline: padded rows
+    replicate node 0's position (silent sources — their normals are zero,
+    so the double-layer density f_dl vanishes there; exact-coincidence
+    pairs are dropped by the kernels anyway), weigh zero, and both dense
+    operators grow block-diagonally with the identity — so the padded
+    system's inverse IS the padded inverse and padded density entries
+    solve to exact zero. ``new_n == n_nodes`` still attaches the mask so
+    an exact-fit scene shares its bucket's pytree structure.
+    """
+    n = shell.n_nodes
+    if new_n < n:
+        raise ValueError(
+            f"periphery.grow_capacity: new_n {new_n} below current shell "
+            f"size {n} (capacity never shrinks)")
+    mask = np.zeros(new_n, dtype=bool)
+    live = (np.asarray(shell.node_mask) if shell.node_mask is not None
+            else np.ones(n, dtype=bool))
+    mask[:n] = live
+    pad = new_n - n
+    if pad == 0:
+        return shell._replace(node_mask=jnp.asarray(mask))
+
+    def pad_rows(a):
+        a = np.asarray(a)
+        fill = np.repeat(a[:1], pad, axis=0)
+        return np.concatenate([a, fill], axis=0)
+
+    def pad_op(m):
+        m = np.asarray(m)
+        out = np.eye(3 * new_n, dtype=m.dtype)
+        out[:3 * n, :3 * n] = m
+        return out
+
+    dtype = shell.nodes.dtype
+    normals = np.concatenate(
+        [np.asarray(shell.normals), np.zeros((pad, 3))], axis=0)
+    return PeripheryState(
+        nodes=jnp.asarray(pad_rows(shell.nodes), dtype=dtype),
+        normals=jnp.asarray(normals, dtype=dtype),
+        weights=jnp.asarray(np.concatenate(
+            [np.asarray(shell.weights), np.zeros(pad)]), dtype=dtype),
+        M_inv=jnp.asarray(pad_op(shell.M_inv), dtype=shell.M_inv.dtype),
+        stresslet_plus_complementary=jnp.asarray(
+            pad_op(shell.stresslet_plus_complementary),
+            dtype=shell.stresslet_plus_complementary.dtype),
+        density=jnp.asarray(np.concatenate(
+            [np.asarray(shell.density), np.zeros(3 * pad)]), dtype=dtype),
+        node_mask=jnp.asarray(mask))
+
+
 # ------------------------------------------------------------------ operators
 
 def matvec(shell: PeripheryState, x, v_on_shell):
-    """A_shell x = (S + N) x + v (`periphery.cpp:38-47`); v is [N, 3]."""
+    """A_shell x = (S + N) x + v (`periphery.cpp:38-47`); v is [N, 3].
+
+    Padded quadrature rows (``node_mask``) drop their v contribution so
+    they stay on the identity — the flow evaluators produce garbage values
+    at the padded placeholder targets."""
+    if shell.node_mask is not None:
+        v_on_shell = jnp.where(shell.node_mask[:, None],
+                               v_on_shell.reshape(-1, 3), 0.0)
     return shell.stresslet_plus_complementary @ x + v_on_shell.reshape(-1)
 
 
@@ -195,8 +260,12 @@ def apply_preconditioner(shell: PeripheryState, x):
     return (shell.M_inv @ x.astype(shell.M_inv.dtype)).astype(x.dtype)
 
 
-def update_RHS(v_on_shell):
-    """RHS = -v_on_shell (`periphery.cpp:86`)."""
+def update_RHS(v_on_shell, node_mask=None):
+    """RHS = -v_on_shell (`periphery.cpp:86`); padded quadrature rows
+    (``node_mask``) get exact-zero RHS so their density solves to zero."""
+    if node_mask is not None:
+        v_on_shell = jnp.where(node_mask[:, None],
+                               v_on_shell.reshape(-1, 3), 0.0)
     return -v_on_shell.reshape(-1)
 
 

@@ -36,8 +36,11 @@ def main(argv=None) -> None:
                          "events; `python -m skellysim_tpu.obs summarize`)")
     ap.add_argument("--jax-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache directory shared "
-                         "across runs/CLIs: cold server starts reuse prior "
-                         "compiles (bench.py's .jax_cache pattern)")
+                         "across runs/CLIs (default-on: [runtime] jax_cache, "
+                         "else the package .jax_cache) — cold server starts "
+                         "reuse prior compiles")
+    ap.add_argument("--no-jax-cache", action="store_true",
+                    help="disable the persistent compilation cache")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the startup bucket-program compile (programs "
                          "then compile on first admission)")
@@ -58,9 +61,11 @@ def main(argv=None) -> None:
 
     jax.config.update("jax_enable_x64", True)
 
+    from ..cli import resolve_cache_dir
     from ..utils.bootstrap import enable_compilation_cache
 
-    enable_compilation_cache(args.jax_cache)
+    enable_compilation_cache(resolve_cache_dir(
+        args.config_file, flag=args.jax_cache, off=args.no_jax_cache))
 
     from ..config import schema
     from .server import SimulationServer

@@ -41,12 +41,18 @@ logger = logging.getLogger("skellysim_tpu")
 
 
 class Bucket:
-    """One capacity bucket: a padded template + its compiled lanes."""
+    """One capacity bucket: a padded template + its compiled lanes.
 
-    def __init__(self, capacity: int, template, scheduler):
+    ``key`` (`system.buckets.BucketKey`) is the compiled program's shape
+    identity — per-group (fiber capacity, node capacity) pairs; admission
+    tests scenes against it with `buckets.admits`. ``capacity`` remains
+    the total fiber-slot count (the wire's integer bucket id)."""
+
+    def __init__(self, capacity: int, template, scheduler, key=None):
         self.capacity = capacity
         self.template = template
         self.scheduler = scheduler
+        self.key = key
         self.warmed = False
 
 
@@ -67,9 +73,11 @@ class SimulationServer:
         from ..ensemble.runner import EnsembleRunner
         from ..ensemble.scheduler import EnsembleScheduler
 
+        runtime_cfg = None
         if isinstance(config, (str, os.PathLike)):
             if serve_cfg is None:
                 serve_cfg = schema.load_serve_config(str(config))
+            runtime_cfg = schema.load_runtime_config(str(config))
             config_dir = os.path.dirname(os.path.abspath(config)) or "."
             config = schema.load_config(str(config))
         elif serve_cfg is None:
@@ -86,6 +94,9 @@ class SimulationServer:
         self.journal = None
         self._rounds_since_checkpoint = 0
 
+        from ..fibers import container as fc
+        from ..system import buckets as bucket_mod
+
         system, base_state, _ = build_simulation(config,
                                                  config_dir=config_dir)
         if base_state.fibers is None:
@@ -93,23 +104,56 @@ class SimulationServer:
                              "define the compiled-program contract tenants "
                              "admit against")
         self.system = system
+        # skelly-bucket: admission buckets derive from the ONE shape policy
+        # ([runtime] ladders of the server's config); [serve]
+        # bucket_capacities remains the manual single-resolution override
+        self.policy = bucket_mod.BucketPolicy.from_runtime(runtime_cfg)
         base_n = self._fiber_count(base_state)
-        caps = sorted(set(serve_cfg.bucket_capacities)) or [base_n]
-        if caps[0] < base_n:
+        single = isinstance(base_state.fibers, fc.FiberGroup)
+        caps = sorted(set(serve_cfg.bucket_capacities))
+        if caps and not single:
+            raise ValueError(
+                "[serve] bucket_capacities applies to single-resolution "
+                "base configs; a mixed-resolution base derives its one "
+                "bucket from the [runtime] ladders")
+        if caps and caps[0] < base_n:
             raise ValueError(
                 f"[serve] bucket_capacities {caps} below the base config's "
                 f"fiber count {base_n}; buckets PAD the base scene, so every "
                 "capacity must be >= it")
+        if not caps:
+            if single:
+                if (serve_cfg.bucket_count > 1
+                        and not self.policy.fiber_ladder):
+                    # identity policy: "the next rung" would be n+1, n+2...
+                    # — one warmup compile per single extra fiber slot, the
+                    # exact waste this subsystem exists to avoid
+                    raise ValueError(
+                        "[serve] bucket_count > 1 needs a fiber ladder to "
+                        "take rungs from; set [runtime] bucket_ladder "
+                        "(e.g. [-1] for the geometric ladder) or list "
+                        "[serve] bucket_capacities explicitly")
+                # bucket_count policy-ladder rungs, starting at the base
+                # scene's own rung
+                caps = [self.policy.fiber_capacity(base_n)]
+                for _ in range(serve_cfg.bucket_count - 1):
+                    caps.append(self.policy.fiber_capacity(caps[-1] + 1))
+            else:
+                caps = [None]   # one bucket at the tuple base's policy key
         self.buckets: list[Bucket] = []
         for cap in caps:
-            template = tenants_mod.pad_state_to_capacity(base_state, cap)
+            template, key = bucket_mod.bucketize(
+                base_state, self.policy, fiber_capacity=cap,
+                pair_evaluator=system.params.pair_evaluator)
             runner = EnsembleRunner(system, batch_impl=serve_cfg.batch_impl)
             sched = EnsembleScheduler(
                 runner, [], serve_cfg.max_lanes, template=template,
                 writer=self._on_frame, metrics=self._on_sched_event,
                 on_retire=self._on_retire, on_dt_underflow="retire",
                 on_failure="retire")
-            self.buckets.append(Bucket(cap, template, sched))
+            self.buckets.append(Bucket(
+                sum(c for c, _ in key.fibers), template, sched, key=key))
+        self.buckets.sort(key=lambda b: b.capacity)
         if warmup:
             self.warmup()
         if serve_cfg.journal_path:
@@ -268,7 +312,7 @@ class SimulationServer:
                         state, rng_state = tenants_mod.state_from_snapshot(
                             bytes(frame), bucket.template)
                         state = tenants_mod.pad_state_to_capacity(
-                            state, bucket.capacity)
+                            state, bucket.key)
                         mismatch = tenants_mod.bucket_mismatch(
                             bucket.template, state)
                         if mismatch:
@@ -412,30 +456,47 @@ class SimulationServer:
             return protocol.error(err)
         _, state, rng = build_simulation(cfg)
 
-        # capacity-bucket selection: smallest bucket the padded scene fits
-        n = self._fiber_count(state)
-        bucket = next((b for b in self.buckets if b.capacity >= n), None)
+        # capacity-bucket selection: smallest bucket whose key admits the
+        # scene (per-group fiber AND node capacities — `buckets.admits`)
+        from ..system import buckets as bucket_mod
+
+        nearest = self.buckets[-1]
+        bucket = next((b for b in self.buckets
+                       if bucket_mod.admits(b.key, state)), None)
         if bucket is not None:
-            state = tenants_mod.pad_state_to_capacity(state, bucket.capacity)
-            if req.get("resume_frame") is not None:
-                # rebuild from the snapshot frame over the fresh state, then
-                # re-pad (frames carry ACTIVE fibers only); the frame's
-                # serialized RNG streams resume too, like cli's --resume
-                state, rng_state = tenants_mod.state_from_snapshot(
-                    bytes(req["resume_frame"]), state)
-                if rng_state:
-                    rng = SimRNG.from_state(rng_state)
-                state = tenants_mod.pad_state_to_capacity(state,
-                                                         bucket.capacity)
-            mismatch = tenants_mod.bucket_mismatch(bucket.template, state)
+            try:
+                state = bucket_mod.bucketize_to(state, bucket.key)
+                if req.get("resume_frame") is not None:
+                    # rebuild from the snapshot frame over the fresh state,
+                    # then re-pad (frames carry ACTIVE fibers and LIVE node
+                    # rows only); the frame's serialized RNG streams resume
+                    # too, like cli's --resume
+                    state, rng_state = tenants_mod.state_from_snapshot(
+                        bytes(req["resume_frame"]), state)
+                    if rng_state:
+                        rng = SimRNG.from_state(rng_state)
+                    state = bucket_mod.bucketize_to(state, bucket.key)
+            except ValueError as e:
+                bucket, mismatch = None, str(e)
+            else:
+                mismatch = tenants_mod.bucket_mismatch(
+                    bucket.template, state,
+                    nearest=nearest.key.describe())
         else:
-            mismatch = (f"scene needs {n} fiber slots but the largest "
-                        f"bucket holds {self.buckets[-1].capacity}")
+            mismatch = (f"scene shape {bucket_mod.state_key(state).describe()}"
+                        f" fits no bucket")
         if bucket is None or mismatch:
             self.metrics.note_rejected()
+            # structured rejection: the nearest admissible bucket rides the
+            # error payload so clients can resize/re-target instead of
+            # parsing a raw leaf-shape string (docs/serving.md)
             return protocol.error(
                 "no capacity bucket matches this scene: " + mismatch
-                + f" (bucket capacities: {[b.capacity for b in self.buckets]})")
+                + f" (bucket capacities: {[b.capacity for b in self.buckets]})",
+                nearest_bucket={
+                    "capacity": nearest.capacity,
+                    "bucket": nearest.key.describe(),
+                    "fibers": [list(p) for p in nearest.key.fibers]})
 
         sched = bucket.scheduler
         if (sched.live >= sched.batch

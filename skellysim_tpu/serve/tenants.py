@@ -176,12 +176,18 @@ def check_params_contract(tenant_params: schema.Params,
             "(one-compiled-program contract)")
 
 
-def pad_state_to_capacity(state, capacity: int):
-    """State with its fiber batch grown to ``capacity`` slots (inert masked
-    padding); a no-op at or above capacity. Mixed-resolution (tuple) fiber
-    containers pass through — they must match a bucket template exactly."""
-    from ..fibers import container as fc
+def pad_state_to_capacity(state, capacity):
+    """State padded onto its admission bucket (inert masked padding).
 
+    ``capacity`` is either a `system.buckets.BucketKey` — the policy path,
+    covering mixed-resolution tuple containers and masked node/shell axes
+    via `buckets.bucketize_to` — or a plain int fiber capacity (the legacy
+    single-group spelling, kept for journal/readers that stored ints)."""
+    from ..fibers import container as fc
+    from ..system import buckets as bucket_mod
+
+    if isinstance(capacity, bucket_mod.BucketKey):
+        return bucket_mod.bucketize_to(state, capacity)
     if state.fibers is None or not isinstance(state.fibers, fc.FiberGroup):
         return state
     if state.fibers.n_fibers >= capacity:
@@ -189,11 +195,15 @@ def pad_state_to_capacity(state, capacity: int):
     return state._replace(fibers=fc.grow_capacity(state.fibers, capacity))
 
 
-def bucket_mismatch(template_state, state) -> Optional[str]:
+def bucket_mismatch(template_state, state,
+                    nearest: Optional[str] = None) -> Optional[str]:
     """None when ``state``'s leaves match the bucket template's static
     shapes/dtypes (admissible), else the mismatch text. Wraps the ensemble
     runner's member check — the SAME predicate that guards `set_lane`, so
-    admission can never admit a state the scheduler would later reject."""
+    admission can never admit a state the scheduler would later reject.
+    ``nearest`` (a bucket description from `BucketKey.describe`) is
+    appended so the raw leaf-shape text comes with an actionable next
+    step."""
     import jax
 
     from ..ensemble.runner import _check_member
@@ -201,7 +211,10 @@ def bucket_mismatch(template_state, state) -> Optional[str]:
     try:
         _check_member(0, jax.tree_util.tree_leaves(template_state), state)
     except ValueError as e:
-        return str(e)
+        msg = str(e)
+        if nearest:
+            msg += f"; nearest admissible bucket: {nearest}"
+        return msg
     return None
 
 

@@ -1,3 +1,5 @@
 from .sources import PointSources, BackgroundFlow  # noqa: F401
 from .system import SimState, System  # noqa: F401
 from .dynamic_instability import apply_dynamic_instability  # noqa: F401
+from .buckets import (BucketKey, BucketPolicy, bucketize,  # noqa: F401
+                      bucketize_to)

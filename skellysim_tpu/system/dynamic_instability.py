@@ -70,7 +70,7 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
             and not isinstance(state.fibers, fc.FiberGroup)):
         buckets = list(fc.as_buckets(state.fibers))
         idx = next((i for i, g in enumerate(buckets)
-                    if g.n_nodes == di.n_nodes), None)
+                    if fc.live_node_count(g) == di.n_nodes), None)
         if idx is None:
             raise NotImplementedError(
                 f"dynamic_instability.n_nodes={di.n_nodes} matches no fiber "
@@ -91,11 +91,13 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
     bodies = state.bodies
     dt = float(state.dt)
 
-    if fibers is not None and fibers.n_nodes != di.n_nodes:
+    if fibers is not None and fc.live_node_count(fibers) != di.n_nodes:
+        # LIVE resolution, not node capacity: a node-padded bucket
+        # (skelly-bucket) nucleates at its live resolution
         raise NotImplementedError(
-            "dynamic_instability.n_nodes must match the fiber group resolution "
-            f"({di.n_nodes} != {fibers.n_nodes}); use a tuple of buckets for "
-            "mixed resolutions")
+            "dynamic_instability.n_nodes must match the fiber group's live "
+            f"resolution ({di.n_nodes} != {fc.live_node_count(fibers)}); "
+            "use a tuple of buckets for mixed resolutions")
 
     # ---------------------------------------------- catastrophe + growth
     if fibers is not None and fibers.n_fibers > 0:
@@ -195,12 +197,18 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
         fibers = fc.grow_capacity(fibers, fibers.n_fibers, node_multiple)
         return state._replace(fibers=fibers)
 
-    # fill inactive slots; grow capacity geometrically when out of room
+    # fill inactive slots; grow capacity geometrically when out of room —
+    # onto the SAME rungs skelly-bucket admission uses (buckets.
+    # next_fiber_capacity), so a nucleation burst re-lands on a bucket
+    # capacity another warm program may already serve instead of drifting
+    # to an ad-hoc ceil(capacity_factor x) count
     active = np.asarray(fibers.active)
     slots = np.flatnonzero(~active)
     if slots.size < len(chosen):
+        from . import buckets as _buckets
+
         need = int(active.sum()) + len(chosen)
-        new_cap = max(int(np.ceil(fibers.n_fibers * capacity_factor)), need)
+        new_cap = _buckets.next_fiber_capacity(need)
         # node_multiple keeps the ring evaluator's mesh-divisibility invariant
         fibers = _grow_capacity(fibers, new_cap, node_multiple)
         active = np.asarray(fibers.active)
@@ -211,7 +219,8 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
 
     arr = {name: np.asarray(leaf).copy()
            for name, leaf in zip(fibers._fields, fibers)
-           if np.asarray(leaf).ndim >= 1
+           if name != "rt_mats" and leaf is not None
+           and np.asarray(leaf).ndim >= 1
            and np.asarray(leaf).shape[0] == fibers.n_fibers}
     handled = {"x", "tension", "length", "length_prev", "bending_rigidity",
                "radius", "penalty", "beta_tstep", "v_growth", "force_scale",
@@ -226,6 +235,14 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
     # carries the other buckets' max; a collision would scramble the wire
     # order)
     next_rank = max(int(arr["config_rank"].max(initial=-1)), _rank_floor) + 1
+    # node-capacity-padded groups (skelly-bucket): the nucleated geometry
+    # fills the LIVE prefix; masked padding rows replicate its first node,
+    # the same placeholder discipline as grow_node_capacity
+    n_cap = fibers.n_nodes
+    if n_cap > di.n_nodes:
+        new_x = [np.concatenate(
+            [xr, np.repeat(xr[:1], n_cap - di.n_nodes, axis=0)], axis=0)
+            for xr in new_x]
     for k, slot in enumerate(slots):
         arr["config_rank"][slot] = next_rank + k
         arr["x"][slot] = new_x[k]
@@ -254,6 +271,8 @@ def _as_device(fibers, state):
     dtype = state.time.dtype
 
     def conv(name, leaf):
+        if name == "rt_mats" or leaf is None:
+            return leaf  # group-level runtime mats / absent optional fields
         leaf = np.asarray(leaf)
         if leaf.dtype.kind == "f":
             return jnp.asarray(leaf, dtype=dtype)

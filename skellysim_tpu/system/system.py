@@ -157,9 +157,13 @@ def solution_from_state(state: SimState):
     """
     parts = []
     for f in fiber_buckets(state.fibers):
-        parts.append(jnp.concatenate(
-            [f.x[:, :, 0], f.x[:, :, 1], f.x[:, :, 2], f.tension],
-            axis=1).reshape(-1))
+        vec = jnp.concatenate(
+            [f.x[:, :, 0], f.x[:, :, 1], f.x[:, :, 2], f.tension], axis=1)
+        if f.rt_mats is not None:
+            # masked padding rows carry placeholder coordinates, but their
+            # solution entries are exact zeros (they solve the identity)
+            vec = jnp.where(f.rt_mats.sol_mask[None, :], vec, 0.0)
+        parts.append(vec.reshape(-1))
     if state.shell is not None:
         parts.append(state.shell.density)
     for g in bd.as_buckets(state.bodies):
@@ -465,14 +469,27 @@ class System:
             return state
         shape = self.shell_shape
 
-        def one(x):
-            tip = x[-1] / jnp.linalg.norm(x[-1])
-            angle = jnp.arccos(jnp.clip(tip[2], -1.0, 1.0))
-            in_window = (angle >= pb.polar_angle_start) & (angle <= pb.polar_angle_end)
-            near = peri.check_collision(shape, x, pb.threshold)
-            return in_window & near
+        def make_one(g):
+            rt = g.rt_mats
 
-        new = tuple(g._replace(plus_pinned=jax.vmap(one)(g.x))
+            def one(x):
+                if rt is None:
+                    tip = x[-1]
+                else:
+                    # the plus end is the last LIVE node; masked padding
+                    # rows replicate node 0 and must not read as contact
+                    tip = jnp.tensordot(rt.e_last.astype(x.dtype), x, axes=1)
+                    x = jnp.where(rt.node_mask[:, None], x, tip)
+                tip = tip / jnp.linalg.norm(tip)
+                angle = jnp.arccos(jnp.clip(tip[2], -1.0, 1.0))
+                in_window = ((angle >= pb.polar_angle_start)
+                             & (angle <= pb.polar_angle_end))
+                near = peri.check_collision(shape, x, pb.threshold)
+                return in_window & near
+
+            return one
+
+        new = tuple(g._replace(plus_pinned=jax.vmap(make_one(g))(g.x))
                     for g in buckets)
         return state._replace(fibers=_rewrap_fibers(state.fibers, new))
 
@@ -559,7 +576,8 @@ class System:
             caches = new_caches
         if state.shell is not None:
             v_shell = v_all[nf_nodes:nf_nodes + ns_nodes]
-            shell_rhs = peri.update_RHS(v_shell)
+            shell_rhs = peri.update_RHS(v_shell,
+                                        node_mask=state.shell.node_mask)
 
         return state, caches, body_caches, shell_rhs, body_rhs
 
@@ -1056,16 +1074,28 @@ class System:
             return collided
         shape = self.shell_shape
 
-        def one(x, mc):
-            # clamped fibers exclude their anchored first node
-            pts = jnp.where((jnp.arange(x.shape[0], dtype=jnp.int32)
-                             >= jnp.where(mc, 1, 0))[:, None],
-                            x, x[-1])
-            return peri.check_collision(shape, pts, 0.0)
+        def make_one(g):
+            rt = g.rt_mats
+
+            def one(x, mc):
+                # excluded rows (a clamped fiber's anchored first node, and
+                # any masked padding rows, which replicate node 0 and would
+                # inherit its wall contact) are replaced by the last LIVE
+                # node — interior by construction
+                safe = x[-1] if rt is None else jnp.tensordot(
+                    rt.e_last.astype(x.dtype), x, axes=1)
+                keep = (jnp.arange(x.shape[0], dtype=jnp.int32)
+                        >= jnp.where(mc, 1, 0))
+                if rt is not None:
+                    keep = keep & rt.node_mask
+                pts = jnp.where(keep[:, None], x, safe)
+                return peri.check_collision(shape, pts, 0.0)
+
+            return one
 
         for g in buckets:
             collided = collided | jnp.any(
-                jax.vmap(one)(g.x, g.minus_clamped))
+                jax.vmap(make_one(g))(g.x, g.minus_clamped))
         return collided
 
     # -------------------------------------------------------------- public API
@@ -1085,13 +1115,24 @@ class System:
         n_src = 0
         parts = []
         for g in fiber_buckets(state.fibers):
-            act = _np.asarray(g.active)
+            # per-NODE activity: inactive fiber slots and masked padding
+            # node rows (skelly-bucket) are both reserved as spread fill
+            # capacity — their placeholder coordinates replicate live nodes
+            # and would otherwise overflow a cell/leaf bucket
+            act = (_np.asarray(g.active)[:, None]
+                   & fc.node_mask_np(g)[None, :])
             x = _np.asarray(g.x)
-            parts.append(x[act].reshape(-1, 3))
-            n_fill += int((~act).sum()) * g.n_nodes
+            parts.append(x[act])
+            n_fill += int((~act).sum())
             n_src += parts[-1].shape[0]
         if state.shell is not None:
-            parts.append(_np.asarray(state.shell.nodes))
+            nodes = _np.asarray(state.shell.nodes)
+            if state.shell.node_mask is not None:
+                # padded quadrature rows replicate node 0; plan over the
+                # live rows (bucketize refuses padded shells under the fast
+                # evaluators, so this is belt-and-braces for plain plans)
+                nodes = nodes[_np.asarray(state.shell.node_mask)]
+            parts.append(nodes)
         for g in body_buckets(state.bodies):
             parts.append(_np.asarray(bd.place(g)[0]).reshape(-1, 3))
         if extra_targets is not None:

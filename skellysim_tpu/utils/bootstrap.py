@@ -82,34 +82,56 @@ def force_cpu_devices(n_devices: int | None = None) -> None:
                 "the environment.")
 
 
-def enable_compilation_cache(cache_dir: str | None) -> str | None:
+#: one min-compile-time threshold for every cache consumer (CLIs, bench.py,
+#: the obs cost gate): trivial programs stay out of the persistent cache
+CACHE_MIN_COMPILE_S = 1.0
+
+
+def default_cache_dir() -> str:
+    """The package root's ``.jax_cache`` — the ONE default location shared
+    by every CLI, `bench.py`, and the obs cost gate, so a cold server start
+    reuses the executables a CI run or bench already compiled. Override
+    with the ``SKELLYSIM_JAX_CACHE`` environment variable."""
+    env = os.environ.get("SKELLYSIM_JAX_CACHE")
+    if env:
+        return env
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), ".jax_cache")
+
+
+def enable_compilation_cache(cache_dir: str | None = "auto") -> str | None:
     """Point JAX's persistent XLA compilation cache at ``cache_dir``.
 
-    The one implementation behind every CLI's ``--jax-cache DIR`` flag
-    (run, ensemble, serve) — the same ``.jax_cache`` pattern `bench.py` and
-    the obs cost gate use internally: compiled executables persist across
-    processes, so a cold server start (or CI re-run) whose programs were
-    compiled before skips the multi-minute XLA compiles and goes straight
-    to warm admission. Returns the absolute cache path, or None when
-    ``cache_dir`` is falsy (cache off — the default).
+    The one implementation behind every CLI's cache wiring (run, ensemble,
+    serve, listener, the obs cost gate, bench.py): compiled executables
+    persist across processes, so a cold server start (or CI re-run) whose
+    programs were compiled before skips the multi-minute XLA compiles and
+    goes straight to warm admission. The persistent cache is DEFAULT-ON
+    (skelly-bucket): ``"auto"`` resolves to `default_cache_dir`; ``None``,
+    ``""`` or ``"off"`` disable it (the CLIs' ``--no-jax-cache`` /
+    ``[runtime] jax_cache = "off"`` opt-outs); anything else is an
+    explicit directory. Returns the absolute cache path or None when off.
 
-    Min-compile-time threshold of 1 s keeps trivial programs out of the
-    cache (matching bench.py); failures are non-fatal like bench's — an
-    unwritable cache dir must not kill a run that would merely recompile.
+    Min-compile-time threshold of `CACHE_MIN_COMPILE_S` keeps trivial
+    programs out of the cache; failures are non-fatal — an unwritable
+    cache dir must not kill a run that would merely recompile.
     """
-    if not cache_dir:
+    if not cache_dir or cache_dir == "off":
         return None
+    if cache_dir == "auto":
+        cache_dir = default_cache_dir()
     import jax
 
     path = os.path.abspath(cache_dir)
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          CACHE_MIN_COMPILE_S)
     except Exception as e:
         import logging
 
         logging.getLogger("skellysim_tpu").warning(
-            "--jax-cache %s not enabled: %s", path, e)
+            "compilation cache %s not enabled: %s", path, e)
         return None
     return path

@@ -1,0 +1,454 @@
+"""skelly-bucket: capacity-bucket shape polymorphism + warm-program pins.
+
+The acceptance pins of ISSUE 12 (ROADMAP item 4), `test_retrace.py`-style:
+
+* two DIFFERENTLY-SHAPED scenes landing in one capacity bucket share one
+  trace — zero compile events on the second (run, ensemble, serve paths);
+* a masked-node padded scene matches the unpadded scene through
+  `System.step`: padded solution entries are EXACT zeros (bitwise), padded
+  state rows pass through bitwise-unchanged, and the live physics matches
+  to solver roundoff (like the ensemble vmap plan, reduction shapes change
+  with padded vector lengths, so live values agree to ~1 ulp — the same
+  bound `fibers.container.grow_capacity` padding has always had);
+* the wire is padding-blind: a padded state's trajectory frame is
+  byte-identical to the unpadded state's;
+* serve admission buckets derive from the policy and admit
+  mixed-resolution tuple scenes (slow tier).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from skellysim_tpu.config import schema
+from skellysim_tpu.fibers import container as fc
+from skellysim_tpu.fibers.matrices import VALID_NODE_COUNTS
+from skellysim_tpu.params import Params
+from skellysim_tpu.system import BackgroundFlow, System
+from skellysim_tpu.system import buckets as bucket_mod
+from skellysim_tpu.testing import trace_counting_jit
+
+
+def _scene(n_fib, n_nodes, seed=5, box=2.0):
+    rng = np.random.default_rng(seed)
+    t = np.linspace(0, 1.0, n_nodes)
+    origins = rng.uniform(-box, box, (n_fib, 3))
+    dirs = rng.normal(size=(n_fib, 3))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    x = origins[:, None, :] + t[None, :, None] * dirs[:, None, :]
+    return fc.make_group(x, lengths=1.0, bending_rigidity=0.01,
+                         radius=0.0125)
+
+
+def _system(**over):
+    return System(Params(eta=1.0, dt_initial=1e-3, t_final=1e-2,
+                         gmres_tol=1e-10, adaptive_timestep_flag=False,
+                         **over))
+
+
+_BG = BackgroundFlow.make(uniform=(1.0, 0.0, 0.0))
+
+
+# ------------------------------------------------------------------ policy
+
+def test_policy_defaults_are_identity():
+    p = bucket_mod.BucketPolicy()
+    assert p.fiber_capacity(7) == 7
+    assert p.node_capacity(16) == 16
+    assert p.shell_capacity(500) is None
+    assert not p.node_polymorphism
+
+
+def test_policy_ladder_rungs_and_extension():
+    p = bucket_mod.BucketPolicy(fiber_ladder=(4, 16), node_ladder=(16, 64),
+                                shell_ladder=(100, 400))
+    assert p.fiber_capacity(3) == 4
+    assert p.fiber_capacity(5) == 16
+    assert p.fiber_capacity(17) == 32      # doubles past the last rung
+    assert p.node_capacity(8) == 16
+    assert p.node_capacity(24) == 64
+    assert p.shell_capacity(56) == 100
+    assert p.node_polymorphism
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="ascending"):
+        bucket_mod.BucketPolicy(fiber_ladder=(8, 4))
+    with pytest.raises(ValueError, match="valid fiber resolutions"):
+        bucket_mod.BucketPolicy(node_ladder=(10,))
+    with pytest.raises(ValueError, match="node_ladder must not be empty"):
+        bucket_mod.BucketPolicy(node_ladder=())
+
+
+def test_runtime_config_round_trip(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text("[runtime]\nbucket_ladder = [4, 8]\nnode_ladder = [32]\n"
+                 "jax_cache = 'off'\n")
+    rc = schema.load_runtime_config(str(p))
+    assert rc.bucket_ladder == [4, 8]
+    assert rc.jax_cache == "off"
+    pol = bucket_mod.BucketPolicy.from_runtime(rc)
+    assert pol.fiber_ladder == (4, 8)
+    assert pol.node_ladder == (32,)
+
+    p.write_text("[runtime]\nbucket_lader = [4]\n")
+    with pytest.raises(ValueError, match="unknown \\[runtime\\] keys"):
+        schema.load_runtime_config(str(p))
+    p.write_text("[runtime]\nbucket_ladder = [-1]\n")
+    pol = bucket_mod.BucketPolicy.from_runtime(
+        schema.load_runtime_config(str(p)))
+    assert pol.fiber_ladder == bucket_mod.GEOMETRIC_FIBER_LADDER
+    # defaults when the table is absent
+    p.write_text("[params]\neta = 1.0\n")
+    rc = schema.load_runtime_config(str(p))
+    assert rc.jax_cache == "auto" and rc.bucket_ladder == []
+
+
+def test_bucketize_default_policy_is_identity():
+    g = _scene(3, 16)
+    system = _system()
+    state = system.make_state(fibers=g, background=_BG)
+    out, key = bucket_mod.bucketize(state, bucket_mod.BucketPolicy())
+    assert out.fibers is state.fibers          # untouched, not re-padded
+    assert key == bucket_mod.BucketKey(fibers=((3, 16),), shell=None)
+    assert "3x16" in key.describe()
+
+
+# ------------------------------------------------- masked-node discipline
+
+def test_grow_node_capacity_invariants():
+    g = _scene(2, 16)
+    gp = fc.grow_node_capacity(g, 32)
+    assert gp.n_nodes == 32
+    assert fc.live_node_count(gp) == 16
+    nm = fc.node_mask_np(gp)
+    assert nm[:16].all() and not nm[16:].any()
+    # padded rows replicate node 0 (silent sources, finite kernels)
+    np.testing.assert_array_equal(np.asarray(gp.x)[:, 16:],
+                                  np.repeat(np.asarray(g.x)[:, :1], 16,
+                                            axis=1))
+    # live prefix bitwise-unchanged
+    np.testing.assert_array_equal(np.asarray(gp.x)[:, :16], np.asarray(g.x))
+    # exact-fit attach keeps shapes but swaps in runtime mats
+    ga = fc.grow_node_capacity(g, 16)
+    assert ga.n_nodes == 16 and ga.rt_mats is not None
+    with pytest.raises(ValueError, match="never shrinks"):
+        fc.grow_node_capacity(gp, 16)
+    # capacity growth composes with node padding (rt mats ride along)
+    gpp = fc.grow_capacity(gp, 4)
+    assert gpp.n_fibers == 4 and gpp.rt_mats is gp.rt_mats
+
+
+def test_masked_node_step_parity():
+    """Acceptance pin (b): padded-vs-unpadded `System.step`. Exactness
+    splits by construction: everything the masking CONTROLS is bitwise
+    (padded solution entries are exact zeros, padded state rows pass
+    through untouched); the live values agree to solver roundoff — padding
+    changes reduction shapes, the same ~ulp bound the ensemble vmap plan
+    and fiber-slot padding document."""
+    system = _system()
+    g = _scene(3, 16, seed=11)
+    st = system.make_state(fibers=g, background=_BG)
+    new0, sol0, info0 = system.step(st)
+    assert bool(info0.converged)
+
+    gp = fc.grow_capacity(fc.grow_node_capacity(g, 32), 6)
+    stp = system.make_state(fibers=gp, background=_BG)
+    new1, sol1, info1 = system.step(stp)
+    assert bool(info1.converged)
+    assert int(info1.iters) == int(info0.iters)
+
+    # bitwise: padded node rows and inactive slots pass through unchanged
+    x1 = np.asarray(new1.fibers.x)
+    np.testing.assert_array_equal(x1[:3, 16:], np.asarray(gp.x)[:3, 16:])
+    np.testing.assert_array_equal(x1[3:], np.asarray(gp.x)[3:])
+    # bitwise: padded solution entries solve the identity to exact zero
+    sol_mask = np.asarray(gp.rt_mats.sol_mask)
+    sol1_f = np.asarray(sol1)[:6 * 4 * 32].reshape(6, -1)
+    assert np.abs(sol1_f[:, ~sol_mask]).max() == 0.0
+    assert np.abs(sol1_f[3:]).max() == 0.0     # inactive slots: zero RHS
+    # live physics to solver roundoff
+    np.testing.assert_allclose(x1[:3, :16], np.asarray(new0.fibers.x),
+                               rtol=0, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(new1.fibers.tension)[:3, :16],
+                               np.asarray(new0.fibers.tension),
+                               rtol=0, atol=1e-10)
+    assert float(info1.fiber_error) < 1e-10
+
+
+def test_padded_frame_bytes_identical_to_unpadded():
+    """The wire is padding-blind: same state, padded vs not, identical
+    trajectory frame bytes (active fibers only, live node rows only)."""
+    from skellysim_tpu.io.trajectory import frame_bytes, frame_to_state
+
+    system = _system()
+    g = _scene(3, 16, seed=4)
+    st = system.make_state(fibers=g, background=_BG)
+    stp = st._replace(fibers=fc.grow_capacity(fc.grow_node_capacity(g, 32),
+                                              8))
+    assert frame_bytes(stp) == frame_bytes(st)
+
+    # and the frame re-lands on the bucket through frame_to_state +
+    # bucketize (the resume path every front door uses)
+    import msgpack
+
+    from skellysim_tpu.io import eigen
+
+    frame = eigen.decode_tree(msgpack.unpackb(frame_bytes(stp), raw=False))
+    back = frame_to_state(frame, st)
+    policy = bucket_mod.BucketPolicy(fiber_ladder=(8,), node_ladder=(32,))
+    back, key = bucket_mod.bucketize(back, policy)
+    assert key == bucket_mod.state_key(stp)
+    np.testing.assert_array_equal(np.asarray(back.fibers.x),
+                                  np.asarray(stp.fibers.x))
+
+
+# ------------------------------------------------------ zero-compile pins
+
+def test_one_bucket_one_trace_across_scene_shapes():
+    """Acceptance pin (a): differently-shaped scenes in one bucket share
+    ONE trace of the implicit step — the second scene compiles nothing."""
+    system = _system()
+    step = trace_counting_jit(system._solve_impl, static_argnames=("pair",))
+    policy = bucket_mod.BucketPolicy(fiber_ladder=(4,), node_ladder=(16,))
+    for n_fib, n_nodes, seed in ((2, 8, 1), (3, 16, 2), (4, 8, 3)):
+        st = system.make_state(fibers=_scene(n_fib, n_nodes, seed=seed),
+                               background=_BG)
+        st, key = bucket_mod.bucketize(st, policy)
+        assert key == bucket_mod.BucketKey(fibers=((4, 16),), shell=None,
+                                           rt_nodes=True)
+        _, _, info = step(st)
+        assert bool(info.converged)
+    assert step.trace_count == 1, "a bucketized scene retraced"
+
+
+def test_observed_jit_zero_compile_events_on_bucket_hit():
+    """The runtime twin of the trace pin: with a tracer active, the second
+    scene in a bucket emits NO compile event (and the first one's event
+    carries the persistent-cache stamp field)."""
+    import json
+
+    from skellysim_tpu.obs import tracer as obs_tracer
+
+    system = _system()
+    policy = bucket_mod.BucketPolicy(fiber_ladder=(4,), node_ladder=(16,))
+
+    events = []
+
+    class Collect(obs_tracer.Tracer):
+        def __init__(self):
+            pass
+
+        def emit(self, ev, **fields):
+            events.append(dict(ev=ev, **fields))
+
+        def close(self):
+            pass
+
+    with obs_tracer.use(Collect()):
+        for n_fib, n_nodes, seed in ((2, 8, 1), (3, 16, 2)):
+            st = system.make_state(fibers=_scene(n_fib, n_nodes, seed=seed),
+                                   background=_BG)
+            st, _ = bucket_mod.bucketize(st, policy)
+            system.step(st)
+    compiles = [e for e in events if e["ev"] == "compile"]
+    assert len(compiles) == 1, compiles
+    assert "persistent_cache" in compiles[0]
+    json.dumps(compiles)  # events stay JSONL-serializable
+
+
+def test_ensemble_admits_heterogeneous_members_one_program():
+    """Ensemble path: members of different shapes bucketize onto one key
+    and stack into ONE batched program (the sweep-CLI admission path)."""
+    from skellysim_tpu.ensemble.runner import EnsembleRunner
+
+    system = _system()
+    runner = EnsembleRunner(system)
+    policy = bucket_mod.BucketPolicy(fiber_ladder=(4,), node_ladder=(16,))
+    states, keys = [], []
+    for n_fib, n_nodes, seed in ((2, 8, 1), (3, 16, 2)):
+        st = system.make_state(fibers=_scene(n_fib, n_nodes, seed=seed),
+                               background=_BG)
+        st, key = bucket_mod.bucketize(st, policy)
+        states.append(st)
+        keys.append(key)
+    assert keys[0] == keys[1]
+    ens = runner.make_ensemble(states, [1e-2, 1e-2])
+    step = trace_counting_jit(runner.step_impl)
+    new_ens, info = step(ens)
+    assert bool(np.asarray(info.converged).all())
+    step(new_ens)
+    assert step.trace_count == 1
+
+
+# -------------------------------------------------------- shell + serve
+
+@pytest.mark.slow
+def test_shell_padding_parity_coupled():
+    """Shell-axis pin: a shell padded onto a capacity rung solves the same
+    coupled system — identical iteration count, live density to roundoff,
+    padded density rows exactly zero."""
+    from skellysim_tpu.audit import fixtures
+    from skellysim_tpu.periphery import periphery as peri
+
+    system = fixtures.make_system(shell=True)
+    state = fixtures.coupled_state(system)
+    new0, _, info0 = system.step(state)
+    assert bool(info0.converged)
+
+    state_p = state._replace(shell=peri.grow_capacity(state.shell, 72))
+    new1, _, info1 = system.step(state_p)
+    assert bool(info1.converged)
+    assert int(info1.iters) == int(info0.iters)
+    d0 = np.asarray(new0.shell.density)
+    d1 = np.asarray(new1.shell.density)
+    assert np.abs(d1[d0.size:]).max() == 0.0
+    np.testing.assert_allclose(d1[:d0.size], d0, rtol=0, atol=1e-9)
+
+
+@pytest.mark.slow
+def test_serve_bucketized_admission_mixed_resolution():
+    """Acceptance pin (c): a serve bucket derived from the policy admits a
+    MIXED-RESOLUTION tuple scene (smaller per-group counts and coarser
+    live resolutions padded onto the template), runs it to completion, and
+    keeps the zero-compiles-after-warm gate; an oversized scene is
+    rejected with the nearest admissible bucket named in the structured
+    error."""
+    from skellysim_tpu.config import BackgroundSource, Config, Fiber
+    from skellysim_tpu.config.toml_io import dumps
+    from skellysim_tpu.serve.server import SimulationServer
+
+    def scene_cfg(spec, shift=0.0):
+        cfg = Config()
+        cfg.params.dt_initial = cfg.params.dt_write = 0.005
+        cfg.params.t_final = 0.01
+        cfg.params.gmres_tol = 1e-10
+        cfg.params.adaptive_timestep_flag = False
+        for i, n in enumerate(spec):
+            fib = Fiber(n_nodes=n, length=1.0, bending_rigidity=0.01)
+            fib.fill_node_positions(np.array([shift + 2.0 * i, 0.0, 0.0]),
+                                    np.array([0.0, 0.0, 1.0]))
+            cfg.fibers.append(fib)
+        cfg.background = BackgroundSource(uniform=[1.0, 0.0, 0.0])
+        return cfg
+
+    def save(cfg, path, runtime=""):
+        cfg.save(str(path))
+        if runtime:
+            with open(path, "a") as fh:
+                fh.write(runtime)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        base = f"{td}/serve.toml"
+        # mixed-resolution base: one 16-node and one 24-node group; the
+        # node ladder coarsens both onto 32, the fiber ladder to 2 each
+        save(scene_cfg((16, 24)), base,
+             "\n[serve]\nmax_lanes = 2\nbatch_impl = 'unroll'\n"
+             "\n[runtime]\nbucket_ladder = [2]\nnode_ladder = [32]\n")
+        server = SimulationServer(base, warmup=True)
+        assert len(server.buckets) == 1
+        key = server.buckets[0].key
+        assert key.fibers == ((2, 32), (2, 32))
+
+        # tenant: SMALLER mixed scene — one 8-node fiber + one 16-node
+        # fiber; different live shapes, same bucket
+        t_cfg = scene_cfg((8, 16), shift=0.5)
+        resp = server.handle_request(
+            {"type": "submit", "config": dumps(schema.unpack(t_cfg))})
+        assert resp["ok"], resp.get("error")
+        while server.any_live():
+            server.tick()
+        st = server.handle_request({"type": "status",
+                                    "tenant": resp["tenant"]})
+        assert st["status"] == "finished"
+        assert server.metrics.stats()["compiles_after_warm"] == 0
+
+        # rejection names the nearest admissible bucket, structured
+        big = scene_cfg((16, 24, 16, 24, 16), shift=1.0)
+        rej = server.handle_request(
+            {"type": "submit", "config": dumps(schema.unpack(big))})
+        assert not rej["ok"]
+        assert "nearest_bucket" in rej
+        assert rej["nearest_bucket"]["fibers"] == [[2, 32], [2, 32]]
+        assert "fits no bucket" in rej["error"]
+
+
+def test_dynamic_instability_growth_lands_on_ladder():
+    from skellysim_tpu.system.buckets import next_fiber_capacity
+
+    assert next_fiber_capacity(3) == 4
+    assert next_fiber_capacity(5) == 8
+    assert next_fiber_capacity(4097) == 8192
+    pol = bucket_mod.BucketPolicy(fiber_ladder=(6, 12))
+    assert next_fiber_capacity(5, pol) == 6
+
+
+def test_dynamic_instability_nucleates_into_node_padded_bucket():
+    """Nucleation composes with the node axis: the [di.n_nodes] geometry
+    fills a node-capacity-padded slot's live prefix, padding rows take the
+    replicated-first-node placeholder, and the group's runtime mats (hence
+    its compiled program) survive the slot-fill."""
+    from skellysim_tpu.bodies import bodies as bd
+    from skellysim_tpu.params import DynamicInstability, Params
+    from skellysim_tpu.periphery.precompute import precompute_body
+    from skellysim_tpu.system.dynamic_instability import (
+        apply_dynamic_instability)
+    from skellysim_tpu.utils.rng import SimRNG
+
+    di = DynamicInstability(n_nodes=16, v_growth=0.5, f_catastrophe=0.0,
+                            nucleation_rate=1000.0, min_length=0.5,
+                            bending_rigidity=0.01, radius=0.0125)
+    p = Params(eta=1.0, dt_initial=1e-2, t_final=1.0,
+               adaptive_timestep_flag=False, dynamic_instability=di)
+    pre = precompute_body("sphere", 100, radius=0.5)
+    rng_s = np.random.default_rng(7)
+    sites = rng_s.standard_normal((12, 3))
+    sites = 0.5 * sites / np.linalg.norm(sites, axis=1, keepdims=True)
+    bodies = bd.make_group(pre["node_positions_ref"],
+                           pre["node_normals_ref"], pre["node_weights"],
+                           nucleation_sites_ref=sites[None], radius=0.5)
+    system = System(p)
+    g = fc.grow_node_capacity(_scene(2, 16, seed=3), 32)
+    state = system.make_state(fibers=g, bodies=bodies)
+    out = apply_dynamic_instability(state, p, SimRNG(seed=1))
+    fib = out.fibers
+    assert fib.rt_mats is not None and fib.n_nodes == 32
+    act = np.asarray(fib.active)
+    assert act.sum() > 2, "nucleation filled no slots"
+    x = np.asarray(fib.x)
+    new_slots = np.flatnonzero(act)[2:]
+    for s in new_slots:
+        # live prefix is the nucleated geometry, pads replicate node 0
+        np.testing.assert_array_equal(x[s, 16:], np.repeat(x[s, :1], 16,
+                                                           axis=0))
+        seg = np.linalg.norm(np.diff(x[s, :16], axis=0), axis=1)
+        np.testing.assert_allclose(seg.sum(), di.min_length, rtol=1e-12)
+
+
+def test_bucketize_to_and_admits():
+    g16 = _scene(2, 16)
+    st = _system().make_state(fibers=g16, background=_BG)
+    key = bucket_mod.BucketKey(fibers=((4, 32),), shell=None, rt_nodes=True)
+    assert bucket_mod.admits(key, st)
+    out = bucket_mod.bucketize_to(st, key)
+    assert bucket_mod.state_key(out) == key
+    small = bucket_mod.BucketKey(fibers=((1, 16),), shell=None,
+                                 rt_nodes=True)
+    assert not bucket_mod.admits(small, st)
+    with pytest.raises(ValueError, match="fiber slots"):
+        bucket_mod.bucketize_to(st, small)
+    wrong_groups = bucket_mod.BucketKey(fibers=((4, 32), (4, 32)),
+                                        shell=None, rt_nodes=True)
+    assert not bucket_mod.admits(wrong_groups, st)
+    with pytest.raises(ValueError, match="resolution group"):
+        bucket_mod.bucketize_to(st, wrong_groups)
+    # a static-resolution (non-rt) bucket only admits exact resolutions
+    static_key = bucket_mod.BucketKey(fibers=((4, 16),), shell=None)
+    assert bucket_mod.admits(static_key, st)
+    smaller_res = bucket_mod.BucketKey(fibers=((4, 32),), shell=None)
+    assert not bucket_mod.admits(smaller_res, st)
+    with pytest.raises(ValueError, match="static-"):
+        bucket_mod.bucketize_to(st, smaller_res)

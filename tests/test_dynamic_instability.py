@@ -168,7 +168,12 @@ def test_capacity_growth_padding_is_finite_in_flow():
         + np.array([[[1.0, 0, 0]], [[-1.0, 0, 0]]])
     fibers = fc.make_group(x, lengths=1.0, bending_rigidity=0.01, radius=0.0125)
     grown = _grow_capacity(fibers, 5)
-    grown = type(grown)(*[jnp.asarray(l) for l in grown])
+    # device round-trip of every ARRAY leaf (optional fields — rt_mats,
+    # absent metadata — stay as-is: jnp.asarray(None) is NaN-bound)
+    grown = grown._replace(**{
+        name: jnp.asarray(leaf)
+        for name, leaf in zip(grown._fields, grown)
+        if name != "rt_mats" and leaf is not None})
     caches = fc.update_cache(grown, dt=0.01, eta=1.0)
     for leaf in caches:
         assert np.all(np.isfinite(np.asarray(leaf))), "NaN in fiber cache"
