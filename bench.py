@@ -1046,6 +1046,126 @@ def _group_ensemble(extra, ck, on_acc):
     ck()
 
 
+def _scenario_scene(dtype, n_sites=4, shell_n=60):
+    """(system, member-state factory) for a small confined DI scene:
+    confining sphere + nucleating body + growing fibers — the oocyte-class
+    shape at bench scale (docs/scenarios.md)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skellysim_tpu.bodies import bodies as bd
+    from skellysim_tpu.fibers import container as fc
+    from skellysim_tpu.params import DynamicInstability, Params
+    from skellysim_tpu.periphery import periphery as peri
+    from skellysim_tpu.periphery.precompute import (precompute_body,
+                                                    precompute_periphery)
+    from skellysim_tpu.system import System
+
+    params = Params(
+        eta=1.0, dt_initial=0.02, dt_write=0.02, t_final=0.08,
+        gmres_tol=1e-6 if dtype == jnp.float32 else 1e-8,
+        adaptive_timestep_flag=False,
+        dynamic_instability=DynamicInstability(
+            n_nodes=8, v_growth=0.2, f_catastrophe=0.5,
+            nucleation_rate=60.0, min_length=0.3, radius=0.0125,
+            bending_rigidity=0.01))
+    pdata = precompute_periphery("sphere", n_nodes=shell_n, radius=2.5,
+                                 eta=1.0)
+    shell = peri.make_state(pdata["nodes"], pdata["normals"],
+                            pdata["quadrature_weights"],
+                            pdata["stresslet_plus_complementary"],
+                            pdata["M_inv"], dtype=dtype)
+    shape = peri.PeripheryShape(kind="sphere", radius=2.5)
+    bdata = precompute_body("sphere", 40, radius=0.4)
+    rng = np.random.default_rng(5)
+    sites = rng.standard_normal((n_sites, 3))
+    sites = 0.4 * sites / np.linalg.norm(sites, axis=1, keepdims=True)
+    bodies = bd.make_group(bdata["node_positions_ref"],
+                           bdata["node_normals_ref"], bdata["node_weights"],
+                           nucleation_sites_ref=sites[None], radius=0.4,
+                           dtype=dtype)
+
+    def member_state(system, i):
+        x = np.tile(np.linspace(0.0, 0.8, 8)[None, :, None], (2, 1, 3))
+        x += 0.6 + 0.02 * i
+        fibers = fc.make_group(x, lengths=0.8 * np.sqrt(3.0),
+                               bending_rigidity=0.01, radius=0.0125,
+                               dtype=dtype)
+        return system.make_state(fibers=fibers, bodies=bodies, shell=shell)
+
+    return System(params, shell_shape=shape), params, member_state
+
+
+def _group_scenarios(extra, ck, on_acc):
+    """ISSUE 13 acceptance: members/s vs B for a DI-enabled CONFINED scene
+    on the ensemble vmap path (in-trace nucleation/catastrophe +
+    scheduler-driven growth reseats) — the oocyte-class workload the
+    scenario subsystem unlocks. CPU-downscale-flagged like every group."""
+    import time as _t
+
+    import jax.numpy as jnp
+
+    from skellysim_tpu.ensemble import EnsembleRunner, MemberSpec
+    from skellysim_tpu.scenarios import ScenarioEnsemble
+    from skellysim_tpu.utils.rng import SimRNG
+
+    dtype = jnp.float64  # DI length/rate arithmetic is f64 on both paths
+    b_ladder = (1, 8, 32) if on_acc else (1, 2, 4)
+    system, params, member_state = _scenario_scene(dtype)
+    steps_per_member = max(int(round(params.t_final / params.dt_initial)), 1)
+
+    table = {}
+    base_rate = None
+    runner = EnsembleRunner(system, batch_impl="vmap")
+    for B in b_ladder:
+        if _remaining() < 60:
+            table[f"B{B}"] = {"skipped_budget": int(_remaining())}
+            continue
+        try:
+            def members(n0=0, n=2 * B):
+                return [MemberSpec(
+                    member_id=f"m{n0 + i}",
+                    state=member_state(system, n0 + i),
+                    t_final=params.t_final,
+                    rng=SimRNG(23).member(n0 + i)) for i in range(n)]
+
+            # warm the rung programs on a throwaway sweep (compile +
+            # growth-reseat rungs), then measure the warm drain
+            ScenarioEnsemble(system, members(1000, B), B,
+                             runner=runner).run(max_rounds=80)
+            t0 = _t.perf_counter()
+            records = []
+            se = ScenarioEnsemble(system, members(), B, runner=runner,
+                                  metrics=records.append)
+            finished = se.run(max_rounds=200)
+            wall = _t.perf_counter() - t0
+            steps = [r for r in records if r.get("event") == "step"]
+            row = {"B": B, "members": 2 * B,
+                   "members_retired": len(finished),
+                   "members_per_s": round(len(finished) / wall, 3),
+                   "steps_per_member": steps_per_member,
+                   "nucleations": sum(r["nucleations"] for r in steps),
+                   "catastrophes": sum(r["catastrophes"] for r in steps),
+                   "growth_reseats": se.reseats,
+                   "rungs": sorted(se._scheds),
+                   "wall_s": round(wall, 2)}
+            if B == 1:
+                base_rate = row["members_per_s"]
+            if base_rate:
+                row["speedup_vs_B1"] = round(
+                    row["members_per_s"] / base_rate, 2)
+            table[f"B{B}"] = row
+        except Exception as e:
+            table[f"B{B}"] = {"error": _short_err(e)}
+        ck()
+    out = {"scene": "confined (shell 60 + body 40 + DI fibers cap 2->rungs)",
+           "ladder": table}
+    if not on_acc:
+        _mark_downscaled(out, _CPU_FALLBACK)
+    extra["scenarios"] = out
+    ck()
+
+
 #: current multichip measurement round; bumping this IS the re-measurement
 #: protocol — the new round lands at the repo root, every round (old and
 #: new) is archived under benchmarks/, stale root rounds are pruned
@@ -1608,6 +1728,7 @@ GROUPS = [
     ("coupled", _group_coupled, 2.6),
     ("cells", _group_cells, 1.8),
     ("ensemble", _group_ensemble, 0.8),
+    ("scenarios", _group_scenarios, 0.8),
 ]
 
 

@@ -210,6 +210,15 @@ print(f"serve smoke ok: 2 tenants finished, "
 EOF
 rm -rf "$SERVE_TMP"
 
+echo "== scenarios: DI-ensemble smoke (docs/scenarios.md) =="
+# skelly-scenario acceptance, exit-code gated in EVERY tier: a small
+# CONFINED dynamic-instability sweep (periphery + nucleating body, B=2)
+# runs on the ensemble vmap path with in-trace nucleation/catastrophe,
+# at least one nucleation and one capacity-growth reseat, and ZERO
+# warm-path compiles (compile events == capacity rungs). ~90 s, dominated
+# by the two rung compiles (shared .jax_cache warms repeats).
+JAX_PLATFORMS=cpu python -m skellysim_tpu.scenarios.smoke
+
 echo "== guard: skelly-guard chaos smoke (docs/robustness.md) =="
 # fault injection against the REAL service, in EVERY tier: NaN one
 # tenant's lane -> status=failed with a verdict while its bucket sibling

@@ -75,7 +75,8 @@ def build_fibers(cfg_fibers: list, dtype):
     return groups[0] if len(groups) == 1 else tuple(groups)
 
 
-def build_bodies(cfg_bodies: list, config_dir: str, dtype):
+def build_bodies(cfg_bodies: list, config_dir: str, dtype,
+                 synthesize_precompute: bool = False):
     """BodyGroup (one shape/resolution) or tuple of per-(shape, n_nodes,
     n_sites) buckets.
 
@@ -84,6 +85,14 @@ def build_bodies(cfg_bodies: list, config_dir: str, dtype):
     (`body_container.cpp:523-550`). `config_rank` records each body's
     config position: it is the GLOBAL id fibers' `parent_body` refers to
     and the trajectory's wire order.
+
+    ``synthesize_precompute`` computes analytic (sphere/ellipsoid) body
+    surfaces in-process when the npz is MISSING — skelly-serve's path:
+    tenant configs arrive as TOML text over the wire and cannot carry npz
+    files, but a spherical MTOC's quadrature is a deterministic function
+    of (shape, n_nodes, radius) the server can rebuild itself
+    (docs/scenarios.md "DI tenants"). The default (off) keeps the CLI's
+    explicit missing-file error — batch runs precompute up front.
     """
     if not cfg_bodies:
         return None
@@ -91,8 +100,19 @@ def build_bodies(cfg_bodies: list, config_dir: str, dtype):
         from .bodies import deformable
 
         deformable.make_group()  # raises: declared-but-unimplemented parity stub
-    pre_all = [_load_npz(os.path.join(config_dir, b.precompute_file), "body")
-               for b in cfg_bodies]
+
+    def load(b):
+        path = os.path.join(config_dir, b.precompute_file)
+        if (synthesize_precompute and not os.path.exists(path)
+                and b.shape in ("sphere", "ellipsoid")):
+            from .periphery.precompute import precompute_body
+
+            a, bb, c = b.axis_length
+            return precompute_body(b.shape, b.n_nodes, radius=b.radius,
+                                   a=a, b=bb, c=c)
+        return _load_npz(path, "body")
+
+    pre_all = [load(b) for b in cfg_bodies]
 
     def runtime_quat(b):
         # TOML orientation follows the schema/Eigen-coeffs order [x, y, z, w]
@@ -201,11 +221,13 @@ def build_background(cfg_bg, dtype) -> BackgroundFlow | None:
 
 
 def build_simulation(config, config_dir: str = ".", dtype=jnp.float64,
-                     mesh=None):
+                     mesh=None, synthesize_body_precompute: bool = False):
     """Config (object or TOML path) → (System, SimState, SimRNG).
 
     ``mesh`` enables the ring pair evaluator when the config selects
     pair_evaluator = "ring"; without one the dense direct path runs.
+    ``synthesize_body_precompute`` rebuilds missing analytic body npz
+    in-process (`build_bodies`) — the serve submit path.
     """
     if isinstance(config, (str, os.PathLike)):
         config_dir = os.path.dirname(os.path.abspath(config)) or "."
@@ -247,6 +269,8 @@ def build_simulation(config, config_dir: str = ".", dtype=jnp.float64,
         points=build_point_sources(config.point_sources, dtype),
         background=build_background(config.background, dtype),
         shell=shell,
-        bodies=build_bodies(config.bodies, config_dir, dtype))
+        bodies=build_bodies(
+            config.bodies, config_dir, dtype,
+            synthesize_precompute=synthesize_body_precompute))
     rng = SimRNG(seed=config.params.seed)
     return system, state, rng

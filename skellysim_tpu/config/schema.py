@@ -674,7 +674,19 @@ class Config:
     background: BackgroundSource = field(default_factory=BackgroundSource)
 
     def validate(self) -> list[str]:
-        return _validate(self)
+        problems = _validate(self)
+        for j, b in enumerate(self.bodies):
+            if getattr(b, "shape", None) == "deformable":
+                # fail at schema-validation time with the stub named, not
+                # deep in the builder's make_group: the reference declares
+                # DeformableBody but never implements it
+                problems.append(
+                    f"bodies[{j}].shape: 'deformable' is declared but "
+                    "unimplemented (reference parity stub skellysim_tpu/"
+                    "bodies/deformable.py, mirroring body_deformable.cpp:"
+                    "13-41 whose methods are empty and whose flow throws); "
+                    "use shape = 'sphere' or 'ellipsoid'")
+        return problems
 
     def save(self, filename: str = "skelly_config.toml") -> None:
         problems = self.validate()

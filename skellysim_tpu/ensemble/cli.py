@@ -30,19 +30,23 @@ def _members_from_sweep(sweep_file: str):
     from .scheduler import MemberSpec
 
     spec, base_path, base, plans = load_members(sweep_file)
-    if spec.replicas > 1 or any(ax.key == "params.seed" for ax in spec.sweep):
-        # nothing in the batched runner consumes the member RNG yet (dynamic
-        # instability — the stochastic driver — is rejected up front), so
-        # replica members differ ONLY in their serialized RNG streams and
-        # write identical physics; never let that burn a sweep silently
+    if ((spec.replicas > 1 or any(ax.key == "params.seed"
+                                  for ax in spec.sweep))
+            and base.params.dynamic_instability.n_nodes == 0):
+        # without dynamic instability nothing in the batched runner
+        # consumes the member RNG, so replica members differ ONLY in their
+        # serialized RNG streams and write identical physics; never let
+        # that burn a sweep silently. (DI sweeps are the stochastic case
+        # replicas exist for — they route through scenarios.ScenarioEnsemble
+        # below, where each member's stream drives its own
+        # nucleation/catastrophe draws.)
         import logging
 
         logging.getLogger("skellysim_tpu").warning(
-            "replicas/seed sweep: the batched runner does not support "
-            "dynamic instability yet, so members of one sweep point run "
-            "identical deterministic physics (they differ only in their "
-            "recorded RNG streams); use replicas=1 until stochastic "
-            "dynamics land in the ensemble path")
+            "replicas/seed sweep without dynamic instability: members of "
+            "one sweep point run identical deterministic physics (they "
+            "differ only in their recorded RNG streams); use replicas=1, "
+            "or enable [dynamic_instability] for stochastic members")
     config_dir = os.path.dirname(os.path.abspath(base_path)) or "."
     if not plans:
         sys.exit(f"sweep spec '{sweep_file}' expands to zero members")
@@ -85,7 +89,7 @@ def _members_from_sweep(sweep_file: str):
         members.append(MemberSpec(
             member_id=plan.member_id, state=state_i, t_final=plan.t_final,
             rng=SimRNG(plan.seed).member(plan.index)))
-    return system, members, spec
+    return system, members, spec, policy
 
 
 def run(sweep_file: str, output_dir: str | None = None,
@@ -102,7 +106,7 @@ def run(sweep_file: str, output_dir: str | None = None,
 
     out_dir = output_dir or (os.path.dirname(os.path.abspath(sweep_file))
                              or ".")
-    system, members, spec = _members_from_sweep(sweep_file)
+    system, members, spec, policy = _members_from_sweep(sweep_file)
     metrics_path = metrics_path or os.path.join(out_dir,
                                                 "ensemble_metrics.jsonl")
     writers = MemberTrajectoryWriters(out_dir, overwrite=overwrite)
@@ -122,15 +126,33 @@ def run(sweep_file: str, output_dir: str | None = None,
              else contextlib.nullcontext())
     try:
         with writers, EnsembleMetricsWriter(metrics_path) as metrics, scope:
-            sched = EnsembleScheduler(
-                runner, members, batch or spec.batch, writer=writers,
-                metrics=metrics, write_initial_frames=True,
-                on_dt_underflow="retire",
-                # quarantine, not abort: one poisoned member must not take
-                # down a 10k-member sweep (docs/robustness.md) — its
-                # "failed" record + verdict land in the metrics JSONL
-                on_failure="retire")
-            retired = sched.run()
+            if runner.di_enabled:
+                # dynamic-instability sweeps: the scenario front-end runs
+                # the in-trace DI update on the ensemble lanes and handles
+                # capacity-growth reseats across rungs (docs/scenarios.md)
+                from ..scenarios import ScenarioEnsemble
+
+                # the base config's [runtime] policy rides along: growth
+                # reseats must land on the SAME ladder rungs admission
+                # bucketized onto, or --resume re-bucketizes onto a rung
+                # the live run never occupied
+                sched = ScenarioEnsemble(
+                    system, members, batch or spec.batch, runner=runner,
+                    policy=policy, writer=writers, metrics=metrics,
+                    write_initial_frames=True,
+                    on_dt_underflow="retire", on_failure="retire")
+                retired = sched.run()
+            else:
+                sched = EnsembleScheduler(
+                    runner, members, batch or spec.batch, writer=writers,
+                    metrics=metrics, write_initial_frames=True,
+                    on_dt_underflow="retire",
+                    # quarantine, not abort: one poisoned member must not
+                    # take down a 10k-member sweep (docs/robustness.md) —
+                    # its "failed" record + verdict land in the metrics
+                    # JSONL
+                    on_failure="retire")
+                retired = sched.run()
     finally:
         # close even when the drain raises (System.run's tracer lifecycle)
         if tracer is not None:

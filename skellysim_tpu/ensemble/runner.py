@@ -41,6 +41,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..guard import verdict as _verdict
 from ..system.system import SimState, System
@@ -53,6 +54,13 @@ class EnsembleState(NamedTuple):
     #: [B] float64 per-member end time; a lane whose ``time >= t_final`` is
     #: inert (finished or idle — the scheduler parks empty lanes at -inf)
     t_final: jnp.ndarray
+    #: [B, 3] int32 per-member RNG stream carry (seed, stream_id, counter)
+    #: for device-side dynamic instability (`scenarios.di_device`): each
+    #: member's `SimRNG.member(i)` ``distributed`` stream as trace DATA,
+    #: advanced by `di_device.DRAWS_PER_STEP` per applied update. None when
+    #: the system has no dynamic instability (bit-identical pre-scenario
+    #: pytree).
+    di_rng: jnp.ndarray | None = None
 
 
 class EnsembleStepInfo(NamedTuple):
@@ -93,6 +101,17 @@ class EnsembleStepInfo(NamedTuple):
     failed: jnp.ndarray = False
     #: [B] guard-ladder retries this round (`StepInfo.guard_retries`)
     guard_retries: jnp.ndarray = 0
+    #: [B] int32 dynamic-instability events APPLIED this round (rejected /
+    #: frozen lanes report 0 — like the host loop, a rejected trial
+    #: discards its nucleations/catastrophes); all-zero without DI
+    nucleations: jnp.ndarray = 0
+    catastrophes: jnp.ndarray = 0
+    #: [B] int32 live fiber count after the round's merge (0 without DI)
+    active_fibers: jnp.ndarray = 0
+    #: [B] a nucleation burst outgrew the lane's capacity bucket: the lane
+    #: froze un-advanced (RNG counter untouched) — the scheduler reseats it
+    #: onto the next `buckets.next_fiber_capacity` rung (scenarios.sweep)
+    needs_growth: jnp.ndarray = False
 
 
 def _check_member(i, template_leaves, state):
@@ -146,6 +165,16 @@ def set_lane(bstates: SimState, lane: int, state: SimState) -> SimState:
         bstates, state)
 
 
+def rng_carry(rng) -> jnp.ndarray:
+    """A member `SimRNG` -> its [3] int32 ``distributed``-stream carry
+    (seed, stream_id, counter) — the device DI draw state
+    (`scenarios.di_device`). None -> an inert zero stream (idle lanes)."""
+    if rng is None:
+        return jnp.zeros(3, dtype=jnp.int32)
+    s = rng.distributed
+    return jnp.asarray([s.seed, s.stream_id, s.counter], dtype=jnp.int32)
+
+
 def _where_lanes(mask, new_tree, old_tree):
     """Per-lane select over every leaf (mask [B] broadcast to leaf rank)."""
     def sel(n, o):
@@ -159,13 +188,23 @@ class EnsembleRunner:
     """The jit'd batched trial step with masked per-member adaptive dt.
 
     One compiled program for a fixed lane count B: the scheduler swaps
-    member leaves in and out of lanes without retracing. Dynamic
-    instability (host-side RNG re-bucketing between steps) and the
-    host-planned evaluators are incompatible with a closed batched trace,
-    so they are rejected at construction rather than silently degraded.
+    member leaves in and out of lanes without retracing. The host-planned
+    fast evaluators are incompatible with a closed batched trace, so they
+    are rejected at construction rather than silently degraded.
+
+    Dynamic instability runs IN-TRACE when the params enable it
+    (`scenarios.di_device`, docs/scenarios.md): nucleation/catastrophe are
+    masked flips over each member's fixed-capacity fiber batch, drawn from
+    per-member RNG stream carries riding `EnsembleState.di_rng`, applied
+    at the top of every member trial exactly where the sequential loop
+    applies the host update. A member whose capacity bucket fills reports
+    ``needs_growth`` and freezes; the scheduler reseats it host-side.
+    ``di_sample_fn`` overrides the natural draws (`di_device.sample_draws`)
+    — the deterministic-injection seam the host/device parity tests use.
     """
 
-    def __init__(self, system: System, batch_impl: str = "vmap"):
+    def __init__(self, system: System, batch_impl: str = "vmap",
+                 di_sample_fn=None):
         if batch_impl not in ("vmap", "unroll"):
             raise ValueError(
                 f"unknown batch_impl {batch_impl!r}; use 'vmap' (throughput; "
@@ -184,14 +223,10 @@ class EnsembleRunner:
                 "(shard_map inside the member batch axis); shard the MEMBER "
                 "axis instead (parallel.shard_ensemble) — batch parallelism "
                 "is the outer axis for small-N members")
-        if p.dynamic_instability.n_nodes > 0:
-            raise ValueError(
-                "ensemble batching does not support dynamic instability yet: "
-                "nucleation/catastrophe re-bucket fibers host-side between "
-                "steps (system.dynamic_instability); run those members "
-                "through System.run")
         self.system = system
         self.batch_impl = batch_impl
+        self.di_enabled = p.dynamic_instability.n_nodes > 0
+        self._di_sample_fn = di_sample_fn
         # through the compile observer (obs.compile_log): with a tracer
         # active, the scheduler's timeline shows exactly when (and with
         # what member signature) the batched step compiled — the runtime
@@ -202,26 +237,65 @@ class EnsembleRunner:
 
     # ------------------------------------------------------------- assembly
 
-    def make_ensemble(self, states, t_finals) -> EnsembleState:
-        """Stack member states + per-member end times into an EnsembleState."""
+    def make_ensemble(self, states, t_finals, rngs=None) -> EnsembleState:
+        """Stack member states + per-member end times into an EnsembleState.
+
+        With dynamic instability enabled, ``rngs`` (one `SimRNG` or None
+        per member) seeds the [B, 3] ``di_rng`` stream carry — rng-less
+        lanes (idle templates) carry a zero stream that never advances
+        (frozen/idle lanes do not draw)."""
+        states = list(states)
         stacked = stack_states(states)
         t_final = jnp.asarray(list(t_finals), dtype=jnp.float64)
         if t_final.shape != (stacked.time.shape[0],):
             raise ValueError(
                 f"t_finals has shape {t_final.shape}, expected "
                 f"({stacked.time.shape[0]},)")
-        return EnsembleState(states=stacked, t_final=t_final)
+        di_rng = None
+        if self.di_enabled:
+            from ..scenarios.di_device import check_di_state
+
+            check_di_state(states[0], self.system.params)
+            rngs = list(rngs) if rngs is not None else [None] * len(states)
+            if len(rngs) != len(states):
+                raise ValueError(
+                    f"rngs has {len(rngs)} entries for {len(states)} members")
+            t_np = np.asarray(t_final)
+            missing = [i for i, r in enumerate(rngs)
+                       if r is None and t_np[i] > float("-inf")]
+            if missing:
+                # only IDLE (t_final = -inf) template lanes may go rng-less:
+                # a RUNNING zero-stream lane would draw the same seed-0
+                # stream as every other rng-less lane — silently correlated
+                # "stochastic" members
+                raise ValueError(
+                    f"members {missing}: dynamic-instability members need a "
+                    "per-member SimRNG (SimRNG(seed).member(i)) — rng-less "
+                    "lanes are only legal as idle templates")
+            di_rng = jnp.stack([rng_carry(r) for r in rngs])
+        return EnsembleState(states=stacked, t_final=t_final, di_rng=di_rng)
 
     # ------------------------------------------------------------- the step
 
-    def _member_body(self, state: SimState):
-        """One member's trial: solve + (under the adaptive gate) collision."""
+    def _member_body(self, state: SimState, di_rng=None):
+        """One member's trial: DI update (when enabled) + solve + (under the
+        adaptive gate) collision. The DI flips ride ``new_state`` only — a
+        rejected trial rolls back to the pre-DI state, exactly like the
+        sequential loop's backup/restore (which also discards the host DI
+        update on reject without rewinding the RNG)."""
+        if self.di_enabled:
+            from ..scenarios.di_device import di_update
+
+            state, di_info = di_update(state, self.system.params, di_rng,
+                                       sample_fn=self._di_sample_fn)
+        else:
+            di_info = None
         new_state, solution, info = self.system.trial_step(state)
         if self.system.params.adaptive_timestep_flag:
             collided = self.system.collision(new_state)
         else:
             collided = jnp.asarray(False)
-        return new_state, solution, info, collided
+        return new_state, solution, info, collided, di_info
 
     def step_impl(self, ens: EnsembleState):
         """(EnsembleState, EnsembleStepInfo) after one masked batched trial.
@@ -235,17 +309,26 @@ class EnsembleRunner:
         running = states.time.astype(jnp.float64) < ens.t_final
 
         if self.batch_impl == "vmap":
-            new_states, solutions, infos, collided = jax.vmap(
-                self._member_body)(states)
+            args = (states, ens.di_rng) if self.di_enabled else (states,)
+            new_states, solutions, infos, collided, di_infos = jax.vmap(
+                self._member_body)(*args)
         else:
             # one inlined copy of the member step per lane: bit-identical to
             # the unbatched program (see the module docstring)
-            outs = [self._member_body(lane_state(states, i))
-                    for i in range(states.time.shape[0])]
-            new_states, solutions, infos, collided = jax.tree_util.tree_map(
+            outs = [self._member_body(
+                lane_state(states, i),
+                ens.di_rng[i] if self.di_enabled else None)
+                for i in range(states.time.shape[0])]
+            (new_states, solutions, infos, collided,
+             di_infos) = jax.tree_util.tree_map(
                 lambda *ls: jnp.stack(ls), *outs)
 
         conv = infos.converged
+        # a needs_growth lane is frozen WHOLESALE: no advance/reject, dt
+        # kept, RNG counter kept — its round re-runs after the host-side
+        # capacity reseat (scenarios.sweep)
+        growth = (running & di_infos.needs_growth if self.di_enabled
+                  else jnp.zeros_like(conv))
         # the host loop's ladder runs in Python floats (f64); matching it
         # bitwise for any state dtype means doing the dt/t arithmetic in f64
         # and casting back only at the state boundary. The dt that actually
@@ -265,7 +348,7 @@ class EnsembleRunner:
             coll = conv & collided
             dt_new64 = jnp.where(coll, dt64 * 0.5, dt_new64)
             accept = good & ~coll
-            dt_underflow = running & (dt_new64 < p.dt_min)
+            dt_underflow = running & (dt_new64 < p.dt_min) & ~growth
         else:
             accept = jnp.ones_like(conv)
             dt_new64 = dt64
@@ -282,14 +365,16 @@ class EnsembleRunner:
                   | jnp.where(dt_underflow,
                               jnp.int32(_verdict.DT_UNDERFLOW),
                               jnp.int32(0)))
-        failed = running & _verdict.is_terminal(health) & ~dt_underflow
+        failed = running & _verdict.is_terminal(health) & ~dt_underflow \
+            & ~growth
 
         # the sequential loop raises BEFORE applying an underflowed update,
-        # leaving the state untouched: frozen (underflowed or quarantined)
-        # lanes here do the same — masked selects, so sibling lanes'
-        # leaves are bitwise-unaffected (pinned by tests/test_ensemble.py)
-        advance = running & accept & ~dt_underflow & ~failed
-        reject = running & ~accept & ~dt_underflow & ~failed
+        # leaving the state untouched: frozen (underflowed, quarantined, or
+        # growth-pending) lanes here do the same — masked selects, so
+        # sibling lanes' leaves are bitwise-unaffected (pinned by
+        # tests/test_ensemble.py)
+        advance = running & accept & ~dt_underflow & ~failed & ~growth
+        reject = running & ~accept & ~dt_underflow & ~failed & ~growth
 
         merged = _where_lanes(advance, new_states, states)
         t_new64 = states.time.astype(jnp.float64) + dt64
@@ -298,6 +383,25 @@ class EnsembleRunner:
         dt_out = jnp.where(advance | reject,
                            dt_new64.astype(states.dt.dtype), states.dt)
         merged = merged._replace(time=time_out, dt=dt_out)
+
+        zeros_i = jnp.zeros(conv.shape, dtype=jnp.int32)
+        di_rng_out = ens.di_rng
+        if self.di_enabled:
+            # the stream counter advances for every lane that actually drew
+            # this round — including rejected/failed ones (the sequential
+            # loop does not rewind the RNG on reject either); growth-frozen
+            # lanes never drew: their round re-runs at the next rung
+            from ..scenarios.di_device import DRAWS_PER_STEP
+
+            adv = jnp.where(running & ~growth,
+                            jnp.int32(DRAWS_PER_STEP), jnp.int32(0))
+            di_rng_out = ens.di_rng.at[:, 2].add(adv)
+            nucleations = jnp.where(advance, di_infos.nucleations, 0)
+            catastrophes = jnp.where(advance, di_infos.catastrophes, 0)
+            active_fibers = jnp.sum(merged.fibers.active,
+                                    axis=1).astype(jnp.int32)
+        else:
+            nucleations = catastrophes = active_fibers = zeros_i
 
         info = EnsembleStepInfo(
             running=running, accepted=advance, converged=conv,
@@ -316,8 +420,11 @@ class EnsembleRunner:
             failed=jnp.broadcast_to(failed, conv.shape),
             guard_retries=jnp.broadcast_to(
                 jnp.asarray(infos.guard_retries, dtype=jnp.int32),
-                conv.shape))
-        return EnsembleState(states=merged, t_final=ens.t_final), info
+                conv.shape),
+            nucleations=nucleations, catastrophes=catastrophes,
+            active_fibers=active_fibers, needs_growth=growth)
+        return EnsembleState(states=merged, t_final=ens.t_final,
+                             di_rng=di_rng_out), info
 
     def step(self, ens: EnsembleState):
         """One compiled batched trial step (same signature as `step_impl`)."""

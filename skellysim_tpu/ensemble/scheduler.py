@@ -30,13 +30,20 @@ from ..obs import tracer as obs_tracer
 from ..solver.gmres import history_rows
 from ..system.system import SimState, crossed_write_boundary
 from ..utils.rng import SimRNG
-from .runner import EnsembleRunner, lane_state, set_lane
+from .runner import EnsembleRunner, lane_state, rng_carry, set_lane
 
 logger = logging.getLogger("skellysim_tpu")
 
 #: ensemble t_final for an empty lane: `time < -inf` is never true, so idle
 #: lanes are inert masked no-ops until the queue refills them
 IDLE_T_FINAL = float("-inf")
+
+
+def _fiber_capacity(state) -> int:
+    """Total fiber-slot count of a member state (the growth-event id)."""
+    from ..fibers import container as fc
+
+    return sum(g.n_fibers for g in fc.as_buckets(state.fibers))
 
 
 @dataclasses.dataclass
@@ -102,7 +109,16 @@ class EnsembleScheduler:
     snapshot point (possibly newer than its last dt_write frame);
     skelly-serve stores it for tenant snapshot/resume. ``extra`` carries
     structured failure context (``health``/``verdict``) on ``failed`` and
-    ``dt_underflow`` retirements.
+    ``dt_underflow`` retirements, plus ``rng_state`` (the member's current
+    serialized RNG streams) whenever the member carries a `SimRNG`.
+
+    ``on_growth``: what to do with a lane whose device dynamic-instability
+    update reported ``needs_growth`` (the member's nucleation burst
+    outgrew its capacity bucket; the runner froze the lane un-advanced).
+    "raise" (default) aborts; "retire" retires the member with reason
+    ``"growth"`` — its CURRENT state and synced RNG ride the `on_retire`
+    callback, and the caller (scenarios.sweep, skelly-serve) re-admits it
+    onto the next capacity rung (docs/scenarios.md "Growth reseats").
     """
 
     def __init__(self, runner: EnsembleRunner, members, batch: int, *,
@@ -112,6 +128,7 @@ class EnsembleScheduler:
                  write_initial_frames: bool = False,
                  on_dt_underflow: str = "raise",
                  on_failure: str = "raise",
+                 on_growth: str = "raise",
                  max_rounds: Optional[int] = None,
                  template: Optional[SimState] = None,
                  on_retire: Optional[Callable] = None):
@@ -124,6 +141,9 @@ class EnsembleScheduler:
         if on_failure not in ("raise", "retire"):
             raise ValueError(
                 f"unknown on_failure {on_failure!r}; use 'raise' or 'retire'")
+        if on_growth not in ("raise", "retire"):
+            raise ValueError(
+                f"unknown on_growth {on_growth!r}; use 'raise' or 'retire'")
         members = list(members)
         if not members and template is None:
             raise ValueError("ensemble needs at least one member (or a "
@@ -137,6 +157,7 @@ class EnsembleScheduler:
         self.write_initial_frames = write_initial_frames
         self.on_dt_underflow = on_dt_underflow
         self.on_failure = on_failure
+        self.on_growth = on_growth
         self.on_retire = on_retire
         self.max_rounds = max_rounds
         self.rounds = 0
@@ -161,9 +182,18 @@ class EnsembleScheduler:
         return spec.rng.dump_state() if spec.rng is not None else None
 
     def _start_member(self, lane: int, spec: MemberSpec):
+        if self.runner.di_enabled and spec.rng is None:
+            raise ValueError(
+                f"member {spec.member_id}: dynamic-instability members need "
+                "a per-member SimRNG (SimRNG(seed).member(i)) — the device "
+                "DI update draws from its distributed stream")
         self.ens = self.ens._replace(
             states=set_lane(self.ens.states, lane, spec.state),
             t_final=self.ens.t_final.at[lane].set(spec.t_final))
+        if self.runner.di_enabled:
+            # seat the member's RNG stream carry next to its state leaves
+            self.ens = self.ens._replace(
+                di_rng=self.ens.di_rng.at[lane].set(rng_carry(spec.rng)))
         self.lanes[lane] = _Lane(spec=spec, t=float(spec.state.time),
                                  dt=float(spec.state.dt))
         # admission latency (queue entry -> lane seat): the serving SLO
@@ -198,7 +228,8 @@ class EnsembleScheduler:
             # gathering the lane twice)
             if final_state is None:
                 final_state = lane_state(self.ens.states, lane)
-            self.on_retire(ln.spec.member_id, final_state, reason, **extra)
+            self.on_retire(ln.spec.member_id, final_state, reason,
+                           rng_state=self._rng_state(ln.spec), **extra)
         obs_tracer.emit("lane", action="retire", lane=lane,
                         member=ln.spec.member_id, reason=reason,
                         steps=ln.steps, **extra)
@@ -208,8 +239,11 @@ class EnsembleScheduler:
         logger.info("ensemble retire member=%s lane=%d t=%.6g steps=%d (%s)",
                     ln.spec.member_id, lane, ln.t, ln.steps, reason)
         self.retired.append(ln.spec.member_id)
-        if self.writer is not None and hasattr(self.writer, "close_member"):
-            # file-based writers free the handle as the lane frees
+        if (self.writer is not None and hasattr(self.writer, "close_member")
+                and reason != "growth"):
+            # file-based writers free the handle as the lane frees — except
+            # on a growth reseat, where the member is about to re-admit at
+            # the next capacity rung and keeps streaming to the same file
             self.writer.close_member(ln.spec.member_id)
         self.lanes[lane] = None
         self.ens = self.ens._replace(
@@ -311,11 +345,21 @@ class EnsembleScheduler:
                                  "fiber_error", "refines",
                                  "loss_of_accuracy", "dt_underflow",
                                  "dt_used", "t", "dt_next", "cycles",
-                                 "health", "failed", "guard_retries")}
+                                 "health", "failed", "guard_retries",
+                                 "nucleations", "catastrophes",
+                                 "active_fibers", "needs_growth")}
             hist = (np.asarray(info.history)
                     if info.history is not None else None)
             wall_s = _time.perf_counter() - wall0
         self.rounds += 1
+        if self.runner.di_enabled:
+            # keep each seated member's SimRNG current with its in-trace
+            # stream carry: frames/snapshots written below then resume with
+            # the exact counters the device draws left off at
+            counters = np.asarray(self.ens.di_rng)
+            for lane, ln in enumerate(self.lanes):
+                if ln is not None and ln.spec.rng is not None:
+                    ln.spec.rng.distributed.counter = int(counters[lane, 2])
 
         for lane, ln in enumerate(self.lanes):
             if ln is None:
@@ -333,6 +377,28 @@ class EnsembleScheduler:
             health = int(fetched["health"][lane])
             dt_used = float(fetched["dt_used"][lane])
             t_new = float(fetched["t"][lane])
+            if bool(fetched["needs_growth"][lane]):
+                # the member's nucleation burst outgrew this capacity
+                # bucket: the runner froze the lane un-advanced (state and
+                # RNG counter exactly as before the round). Hand the member
+                # back for a reseat onto the next capacity rung — its
+                # current state rides on_retire like any retirement
+                # (scenarios.sweep and skelly-serve re-admit it there).
+                cap = _fiber_capacity(lane_state(self.ens.states, lane))
+                obs_tracer.emit("lane", action="growth", lane=lane,
+                                member=ln.spec.member_id, capacity=cap,
+                                t=ln.t)
+                if self.on_growth == "raise":
+                    raise RuntimeError(
+                        f"ensemble member {ln.spec.member_id}: nucleation "
+                        f"outgrew its fiber capacity bucket ({cap} slots) "
+                        "at t="
+                        f"{ln.t:.6g}; drive the sweep through scenarios."
+                        "ScenarioEnsemble (or serve) for automatic growth "
+                        "reseats, or start at a larger capacity")
+                self._retire_member(lane, reason="growth",
+                                    extra={"capacity": cap})
+                continue
             if failed:
                 # terminal health verdict: the runner froze the lane
                 # un-advanced (quarantine — siblings bitwise-unaffected);
@@ -383,6 +449,9 @@ class EnsembleScheduler:
                     fetched["loss_of_accuracy"][lane]),
                 "health": health,
                 "guard_retries": int(fetched["guard_retries"][lane]),
+                "nucleations": int(fetched["nucleations"][lane]),
+                "catastrophes": int(fetched["catastrophes"][lane]),
+                "active_fibers": int(fetched["active_fibers"][lane]),
                 "wall_s": round(wall_s, 4),
                 "wall_ms": round(wall_s * 1e3, 3),
                 "gmres_history": history_rows(

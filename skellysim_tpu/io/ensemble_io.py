@@ -28,7 +28,8 @@ ENSEMBLE_STEP_FIELDS = ("event", "member", "lane", "round", "step", "t",
                         "dt", "iters", "gmres_cycles", "residual",
                         "residual_true", "fiber_error", "accepted",
                         "refines", "loss_of_accuracy", "health",
-                        "guard_retries", "wall_s", "wall_ms",
+                        "guard_retries", "nucleations", "catastrophes",
+                        "active_fibers", "wall_s", "wall_ms",
                         "gmres_history")
 
 #: keys of an ``event == "start"`` record (member entered a lane);
@@ -44,6 +45,12 @@ ENSEMBLE_RETIRE_FIELDS = ("event", "member", "lane", "t", "steps", "frames")
 #: quarantined/frozen): the retire keys plus the packed health word and
 #: its decoded bit names (`guard.verdict` — docs/robustness.md)
 ENSEMBLE_FAILURE_FIELDS = ENSEMBLE_RETIRE_FIELDS + ("health", "verdict")
+
+#: keys of an ``event == "growth"`` record: a dynamic-instability member's
+#: nucleation outgrew its fiber ``capacity`` bucket — the lane froze
+#: un-advanced and the member reseats onto the next capacity rung
+#: (scenarios.sweep / skelly-serve; docs/scenarios.md "Growth reseats")
+ENSEMBLE_GROWTH_FIELDS = ENSEMBLE_RETIRE_FIELDS + ("capacity",)
 
 
 class EnsembleMetricsWriter:

@@ -10,8 +10,9 @@ One parser for every line shape the repo emits (docs/observability.md):
   `io.ensemble_io`);
 * resume markers (``{"resume": true, ...}``).
 
-The report has four sections — per-span timings, compile events, lane
-occupancy, solver convergence — each omitted when its inputs are absent,
+The report's sections — per-span timings, compile events, faults, lane
+occupancy, dynamic instability, solver convergence — are each omitted
+when their inputs are absent,
 so the same command serves a single-run metrics file, a trace file, an
 ensemble metrics file, or all of them at once.
 """
@@ -177,6 +178,45 @@ class Summary:
                        f"max {max(w):.4f}s  (n={len(w)})")
         out.append("")
 
+    def _scenario_section(self, out: list[str]):
+        """Dynamic-instability table (docs/scenarios.md): per-member fiber
+        population trajectory + growth-reseat events. Rendered only when
+        the stream carries DI activity — the fields are all-zero on
+        deterministic runs."""
+        di_steps = [s for s in self.steps
+                    if s.get("nucleations") or s.get("catastrophes")
+                    or s.get("active_fibers")]
+        # a ScenarioEnsemble trace carries BOTH the scheduler's "growth"
+        # (lane froze) and the sweep's "growth_reseat" (member re-admitted)
+        # for the same reseat; a serve trace carries "growth" only — take
+        # the max, not the sum
+        growths = max(self.lane_events.get("growth", 0),
+                      self.lane_events.get("growth_reseat", 0))
+        if not di_steps and not growths:
+            return
+        out.append("== dynamic instability ==")
+        by_member: dict[str, list[dict]] = {}
+        for s in self.steps:
+            by_member.setdefault(s.get("member", "run"), []).append(s)
+        rows = [("member", "steps", "nucleated", "catastrophes",
+                 "active (first->last, max)")]
+        for member in sorted(by_member):
+            recs = by_member[member]
+            act = [int(r.get("active_fibers", 0)) for r in recs]
+            rows.append((
+                member, str(len(recs)),
+                str(sum(int(r.get("nucleations", 0)) for r in recs)),
+                str(sum(int(r.get("catastrophes", 0)) for r in recs)),
+                f"{act[0]} -> {act[-1]}, max {max(act)}" if act else "-"))
+        widths = [max(len(r[i]) for r in rows) for i in range(5)]
+        out.extend("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                   for r in rows)
+        total_n = sum(int(s.get("nucleations", 0)) for s in self.steps)
+        total_c = sum(int(s.get("catastrophes", 0)) for s in self.steps)
+        out.append(f"events: nucleations={total_n}  catastrophes={total_c}"
+                   + (f"  growth-reseats={growths}" if growths else ""))
+        out.append("")
+
     def _convergence_section(self, out: list[str]):
         if not self.steps:
             return
@@ -266,6 +306,7 @@ class Summary:
         self._compile_section(out)
         self._fault_section(out)
         self._lane_section(out)
+        self._scenario_section(out)
         self._convergence_section(out)
         if self.unparsed:
             out.append(f"({self.unparsed} unparseable line(s) skipped)")

@@ -1,0 +1,17 @@
+"""skelly-scenario: device-side dynamic instability + the scenario sweep
+front-end (docs/scenarios.md).
+
+Two halves:
+
+* `di_device` — the stochastic nucleation/catastrophe update of
+  `system.dynamic_instability` re-expressed as pure masked jnp ops over a
+  fixed-capacity fiber batch, so it runs INSIDE the batched ensemble trace
+  (ROADMAP item 5's ensemble leg, unlocked by skelly-bucket's capacity
+  rungs);
+* `sweep` — the front-end that composes it with the ensemble scheduler:
+  one shared compiled step across a geometric ladder of capacity rungs,
+  growth reseats between rungs when a member's bucket fills.
+"""
+
+from .di_device import DIDraws, DIInfo, di_update, sample_draws  # noqa: F401
+from .sweep import ScenarioEnsemble, ensure_di_capacity  # noqa: F401

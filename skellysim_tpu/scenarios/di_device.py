@@ -1,0 +1,320 @@
+"""Device-side dynamic instability: nucleation/catastrophe as in-trace mask
+flips over a fixed-capacity fiber batch.
+
+The host path (`system.dynamic_instability.apply_dynamic_instability`)
+re-buckets fibers with numpy between jit'd solves — which is exactly why
+`ensemble.runner` used to reject dynamic instability: a host round-trip
+cannot live inside one closed batched trace. This module is the same
+update as pure masked jnp ops, so it vmaps over the ensemble's member axis
+(the JAX Fast Stokesian Dynamics shape, PAPERS.md arXiv 2503.07847:
+stochastic per-step dynamics kept inside one jit'd program):
+
+* **catastrophe** — P(die) = 1 - exp(-dt * f_cat) per active fiber (with
+  the plus-pinned rate rescaling), one uniform draw per capacity slot;
+  dying fibers flip ``active`` off and free their binding site — no shape
+  changes, no recompilation;
+* **growth** — survivors grow by dt * v_growth (`system.di_rates` is the
+  ONE rate-math definition shared with the host oracle);
+* **nucleation** — Poisson(dt * rate * n_inactive) capped by the free-site
+  count; chosen sites fill inactive capacity slots via a static-shape
+  masked prefix-sum over the slot bitmap + an argsort over the site
+  bitmap (uniform selection without replacement: free sites ranked by an
+  independent uniform priority). New fibers point radially out of their
+  body, minus-clamped at ``min_length`` — all field writes are
+  ``jnp.where`` selects at fixed shapes.
+
+**RNG discipline**: all draws come from the member's `SimRNG.member(i)`
+``distributed`` stream, threaded through the trace as DATA — a ``[3]``
+int32 carry ``(seed, stream_id, counter)`` riding `EnsembleState.di_rng`.
+Each step folds ``counter + j`` (j = 0..2) into the stream's base key
+exactly like `utils.rng.Stream` does host-side, then the runner advances
+the counter by `DRAWS_PER_STEP`; the carry round-trips through
+`SimRNG` dump/restore, so serve snapshots and ``--resume`` keep RNG
+continuity. (The host loop's draw COUNT per step is data-dependent, so
+host and device streams are not draw-for-draw aligned; cross-path parity
+tests inject deterministic draws instead — docs/scenarios.md.)
+
+**Capacity overflow**: when a nucleation burst wants more slots than the
+batch holds, the whole update aborts for that member (its lane freezes
+un-advanced, its counter does NOT advance) and ``DIInfo.needs_growth``
+flags it. The scheduler reseats the lane onto the next
+`system.buckets.next_fiber_capacity` rung host-side (`scenarios.sweep`) —
+mask flips in-trace, geometric re-bucketing outside, O(log n) traces
+total, warm via the persistent compile cache.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..bodies import bodies as bd
+from ..fibers import container as fc, fd_fiber
+from ..system import di_rates
+
+#: keys consumed per step (u_cat / poisson / u_site) — the runner advances
+#: the member's stream counter by this after every applied update
+DRAWS_PER_STEP = 3
+
+
+class DIDraws(NamedTuple):
+    """One step's stochastic inputs (the injection seam for parity tests).
+
+    ``u_cat`` [capacity] uniforms in [0, 1) (catastrophe; 0 = never dies),
+    ``n_raw`` scalar int32 (the un-capped Poisson nucleation count),
+    ``u_site`` [n_sites] uniform priorities (site selection: the n lowest
+    free-site priorities nucleate, in ascending order).
+    """
+
+    u_cat: jnp.ndarray
+    n_raw: jnp.ndarray
+    u_site: jnp.ndarray
+
+
+class DIInfo(NamedTuple):
+    """Per-member outcome of one device DI update (scalars inside vmap)."""
+
+    nucleations: jnp.ndarray     # int32 slots filled (0 on abort)
+    catastrophes: jnp.ndarray    # int32 fibers deactivated (0 on abort)
+    active_fibers: jnp.ndarray   # int32 live count AFTER the update
+    #: the nucleation burst outgrew the capacity bucket: the update was
+    #: aborted (state and RNG counter untouched) — reseat the member onto
+    #: the next capacity rung and re-run
+    needs_growth: jnp.ndarray
+
+
+class SiteTable(NamedTuple):
+    """Flat lab-frame nucleation-site table over every body bucket — the
+    traced twin of the host path's ``site_tab`` (same body-major,
+    site-minor flat order, so injected-draw selection parity holds)."""
+
+    sites: jnp.ndarray    # [S, 3] lab-frame site positions
+    coms: jnp.ndarray     # [S, 3] owning body centers
+    gids: jnp.ndarray     # [S] int32 global body ids (config_rank)
+    sids: jnp.ndarray     # [S] int32 per-body site indices
+
+
+def site_table(bodies) -> Optional[SiteTable]:
+    """Traced site table, or None when no body carries nucleation sites
+    (site COUNT is static — body positions/orientations are traced)."""
+    sites, coms, gids, sids = [], [], [], []
+    for g in bd.as_buckets(bodies):
+        ns = g.nucleation_sites_ref.shape[1]
+        if ns == 0:
+            continue
+        _, _, s_lab = bd.place(g)                     # [nb, ns, 3]
+        sites.append(s_lab.reshape(-1, 3))
+        coms.append(jnp.repeat(g.position, ns, axis=0))
+        ranks = (g.config_rank if g.config_rank is not None
+                 else jnp.arange(g.n_bodies, dtype=jnp.int32))
+        gids.append(jnp.repeat(ranks, ns))
+        sids.append(jnp.tile(jnp.arange(ns, dtype=jnp.int32), g.n_bodies))
+    if not sites:
+        return None
+    return SiteTable(jnp.concatenate(sites), jnp.concatenate(coms),
+                     jnp.concatenate(gids), jnp.concatenate(sids))
+
+
+def _stream_key(di_rng, offset: int):
+    """The `utils.rng.Stream` key chain, in-trace: fold stream id then
+    (counter + offset) into the seeded base key."""
+    base = jax.random.fold_in(jax.random.PRNGKey(di_rng[0]), di_rng[1])
+    return jax.random.fold_in(base, di_rng[2] + offset)
+
+
+def sample_draws(di_rng, lam, capacity: int, n_sites: int,
+                 dtype=jnp.float64) -> DIDraws:
+    """Natural draws for one step from the member's stream carry (three
+    keys: counter+0 / +1 / +2). ``lam`` is traced — the Poisson mean
+    depends on the live occupancy."""
+    u_cat = jax.random.uniform(_stream_key(di_rng, 0), (capacity,),
+                               dtype=dtype)
+    n_raw = jax.random.poisson(_stream_key(di_rng, 1),
+                               jnp.maximum(lam, 0.0)).astype(jnp.int32)
+    u_site = jax.random.uniform(_stream_key(di_rng, 2), (max(n_sites, 1),),
+                                dtype=dtype)
+    return DIDraws(u_cat=u_cat, n_raw=n_raw, u_site=u_site[:n_sites])
+
+
+#: per-fiber fields the slot-fill writes — the device twin of the host
+#: path's ``handled`` set; a new FiberGroup field with a leading fiber
+#: axis must be added HERE too or nucleation would recycle dead values
+_HANDLED = {"x", "tension", "length", "length_prev", "bending_rigidity",
+            "radius", "penalty", "beta_tstep", "v_growth", "force_scale",
+            "minus_clamped", "plus_pinned", "binding_body", "binding_site",
+            "active", "config_rank"}
+
+
+def check_di_state(state, params) -> None:
+    """Static (trace-time) validation that ``state`` can run the device DI
+    update; raises with an actionable message otherwise. Shared by the
+    ensemble runner's admission and the scenario front-end."""
+    di = params.dynamic_instability
+    fibers = state.fibers
+    if fibers is None or not isinstance(fibers, fc.FiberGroup):
+        raise ValueError(
+            "device dynamic instability needs a single fixed-capacity "
+            "FiberGroup (mixed-resolution tuples and fiber-less states "
+            "run the host loop; pre-allocate capacity with "
+            "scenarios.ensure_di_capacity)")
+    if fc.live_node_count(fibers) != di.n_nodes:
+        raise ValueError(
+            "dynamic_instability.n_nodes must match the fiber group's live "
+            f"resolution ({di.n_nodes} != {fc.live_node_count(fibers)})")
+    per_fiber = {name for name, leaf in zip(fibers._fields, fibers)
+                 if name != "rt_mats" and leaf is not None
+                 and getattr(leaf, "ndim", 0) >= 1
+                 and leaf.shape[0] == fibers.n_fibers}
+    if per_fiber - _HANDLED:
+        raise RuntimeError(
+            f"device nucleation slot-fill does not reset fiber fields "
+            f"{sorted(per_fiber - _HANDLED)}; recycled slots would inherit "
+            "dead fibers' values (update di_device._HANDLED and the host "
+            "path's handled set together)")
+
+
+def di_update(state, params, di_rng, *, sample_fn=None):
+    """One in-trace nucleation/catastrophe update -> (new_state, DIInfo).
+
+    Pure at fixed shapes: vmaps over a stacked member axis (``di_rng``
+    becomes [B, 3]) and inlines per-lane under the unroll plan. The
+    arithmetic runs in float64 and casts back at the state boundary,
+    mirroring the host path's numpy-f64 discipline, so f32 states see the
+    same update the host loop would apply. On ``needs_growth`` every
+    output equals its input (the member's round never happened).
+    """
+    di = params.dynamic_instability
+    fibers = state.fibers
+    # no validation HERE: this body runs at trace time, where the host-side
+    # checks (live_node_count pulls the node mask) would sync or abort —
+    # every admission seam (`check_di_state` via runner.make_ensemble,
+    # `ensure_di_capacity`, serve admission) validates concrete states
+    dtype = fibers.x.dtype
+    cap = fibers.n_fibers
+    n_live = di.n_nodes
+    dt64 = state.dt.astype(jnp.float64)
+
+    active = fibers.active
+    v_growth, f_cat = di_rates.effective_rates(di, fibers.plus_pinned, jnp)
+    attached = active & (fibers.binding_body >= 0)
+    n_active_old = jnp.sum(attached).astype(jnp.int32)
+
+    tab = site_table(state.bodies)
+    n_sites = tab.sites.shape[0] if tab is not None else 0
+    lam = di_rates.nucleation_mean(
+        dt64, di.nucleation_rate,
+        jnp.maximum(n_sites - n_active_old, 0).astype(jnp.float64))
+    draws = (sample_fn or sample_draws)(di_rng, lam, cap, n_sites,
+                                        jnp.float64)
+
+    # ---------------------------------------------- catastrophe + growth
+    die = di_rates.catastrophe_mask(active, draws.u_cat, dt64, f_cat, jnp)
+    survive = active & ~die
+    length64 = fibers.length.astype(jnp.float64)
+    length_prev64 = jnp.where(survive, length64,
+                              fibers.length_prev.astype(jnp.float64))
+    length64 = di_rates.grown_length(length64, survive, dt64, v_growth, jnp)
+    v_growth64 = jnp.where(survive, v_growth, 0.0)
+    binding_body = jnp.where(survive, fibers.binding_body,
+                             jnp.int32(-1))
+
+    if tab is None:
+        # catastrophe-only scene (no nucleation sites): never overflows
+        out = fibers._replace(
+            active=survive, length=length64.astype(dtype),
+            length_prev=length_prev64.astype(dtype),
+            v_growth=v_growth64.astype(dtype), binding_body=binding_body)
+        info = DIInfo(
+            nucleations=jnp.int32(0),
+            catastrophes=jnp.sum(die).astype(jnp.int32),
+            active_fibers=jnp.sum(survive).astype(jnp.int32),
+            needs_growth=jnp.asarray(False))
+        return state._replace(fibers=out), info
+
+    # ---------------------------------------------------------- nucleation
+    # occupancy bitmap over the flat site table (the reference's one flat
+    # bitmap, `dynamic_instability.cpp:63,87`), from the POST-catastrophe
+    # bindings — a dying fiber frees its site this very step
+    bound = survive & (binding_body >= 0)
+    occ = jnp.any(bound[None, :]
+                  & (binding_body[None, :] == tab.gids[:, None])
+                  & (fibers.binding_site[None, :] == tab.sids[:, None]),
+                  axis=1)                                        # [S]
+    n_free = jnp.sum(~occ).astype(jnp.int32)
+    n_want = di_rates.nucleation_count(draws.n_raw, n_free, jnp)
+    free_slots = jnp.sum(~survive).astype(jnp.int32)
+    needs_growth = n_want > free_slots
+    n_fill = jnp.minimum(n_want, free_slots)
+
+    # uniform selection without replacement at static shape: free sites
+    # ranked by their priority draw (occupied sites sort to the back),
+    # the first n_fill of the order nucleate
+    prio = jnp.where(occ, jnp.inf, draws.u_site)
+    order = jnp.argsort(prio).astype(jnp.int32)                  # [S]
+
+    # k-th chosen site fills the k-th inactive capacity slot (the host
+    # path's flatnonzero(~active)[:n] in masked prefix-sum form)
+    slot_rank = (jnp.cumsum(~survive) - 1).astype(jnp.int32)     # [cap]
+    fill = (~survive) & (slot_rank < n_fill)
+    site_of = order[jnp.clip(slot_rank, 0, n_sites - 1)]         # [cap]
+    origin = tab.sites[site_of].astype(jnp.float64)              # [cap, 3]
+    com = tab.coms[site_of].astype(jnp.float64)
+    nodes = di_rates.nucleated_nodes(origin, com, di.min_length, n_live,
+                                     jnp)                        # [cap, nl, 3]
+    pad = fibers.n_nodes - n_live
+    if pad:
+        # node-capacity-padded groups (skelly-bucket): live prefix gets the
+        # geometry, masked pad rows replicate node 0 — the grow_node_capacity
+        # placeholder discipline
+        nodes = jnp.concatenate(
+            [nodes, jnp.repeat(nodes[:, :1], pad, axis=1)], axis=1)
+
+    next_rank = jnp.max(fibers.config_rank) + 1
+
+    def sel(mask, new, old):
+        m = mask.reshape(mask.shape + (1,) * (jnp.ndim(old) - 1))
+        return jnp.where(m, new, old)
+
+    upd = fibers._replace(
+        x=sel(fill, nodes.astype(dtype), fibers.x),
+        tension=sel(fill, jnp.zeros((), dtype), fibers.tension),
+        length=sel(fill, jnp.asarray(di.min_length, dtype),
+                   length64.astype(dtype)),
+        length_prev=sel(fill, jnp.asarray(di.min_length, dtype),
+                        length_prev64.astype(dtype)),
+        bending_rigidity=sel(fill, jnp.asarray(di.bending_rigidity, dtype),
+                             fibers.bending_rigidity),
+        radius=sel(fill, jnp.asarray(di.radius, dtype), fibers.radius),
+        penalty=sel(fill, jnp.asarray(fd_fiber.DEFAULT_PENALTY, dtype),
+                    fibers.penalty),
+        beta_tstep=sel(fill, jnp.asarray(fd_fiber.DEFAULT_BETA_TSTEP, dtype),
+                       fibers.beta_tstep),
+        v_growth=sel(fill, jnp.zeros((), dtype), v_growth64.astype(dtype)),
+        force_scale=sel(fill, jnp.zeros((), dtype), fibers.force_scale),
+        minus_clamped=jnp.where(fill, True, fibers.minus_clamped),
+        plus_pinned=jnp.where(fill, False, fibers.plus_pinned),
+        binding_body=jnp.where(fill, tab.gids[site_of], binding_body),
+        binding_site=jnp.where(fill, tab.sids[site_of], fibers.binding_site),
+        active=survive | fill,
+        config_rank=jnp.where(fill, next_rank + slot_rank,
+                              fibers.config_rank),
+    )
+
+    # abort wholesale on overflow: the lane freezes, the scheduler reseats
+    # it onto the next capacity rung and this round re-runs there (inside
+    # vmap needs_growth is a scalar, so plain where broadcasts every
+    # changed leaf; untouched leaves — rt_mats — stay shared)
+    out = fibers._replace(**{
+        name: jnp.where(needs_growth, getattr(fibers, name),
+                        getattr(upd, name))
+        for name in _HANDLED})
+    info = DIInfo(
+        nucleations=jnp.where(needs_growth, 0, n_fill).astype(jnp.int32),
+        catastrophes=jnp.where(needs_growth, 0,
+                               jnp.sum(die)).astype(jnp.int32),
+        active_fibers=jnp.where(needs_growth, jnp.sum(active),
+                                jnp.sum(survive | fill)).astype(jnp.int32),
+        needs_growth=needs_growth)
+    return state._replace(fibers=out), info

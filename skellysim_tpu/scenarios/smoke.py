@@ -1,0 +1,126 @@
+"""Exit-code-gated DI-ensemble smoke for CI (docs/scenarios.md).
+
+    python -m skellysim_tpu.scenarios.smoke
+
+Boots a SMALL confined dynamic-instability sweep — confining periphery +
+nucleating body + growing fibers, B=2 members on the ensemble vmap path —
+deliberately undersized (2 fiber slots) so nucleation outgrows the first
+capacity rung, and gates the skelly-scenario acceptance surface:
+
+* both members finish their horizon with >= 1 nucleation applied;
+* >= 1 growth reseat happened (lane froze, member re-admitted at the next
+  geometric rung);
+* ZERO warm-path compiles: every `observed_jit` compile event belongs to
+  a rung's FIRST round — after a reseat warms its rung, within-bucket
+  nucleation/catastrophe never retrace (compile events == rung count).
+
+Exits 0 on success, 1 with a message on any violation (ci/run_ci.sh gates
+on the exit code).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    import os
+
+    # pin CPU BEFORE anything initializes a backend (jax.devices() here
+    # would initialize the default platform and make the pin a no-op);
+    # an explicit JAX_PLATFORMS (e.g. tpu) is respected
+    if not os.environ.get("JAX_PLATFORMS"):
+        from ..utils.bootstrap import force_cpu_devices
+
+        force_cpu_devices(1)
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ..bodies import bodies as bd
+    from ..obs import tracer as obs_tracer
+    from ..params import DynamicInstability, Params
+    from ..periphery import periphery as peri
+    from ..periphery.precompute import precompute_body, precompute_periphery
+    from ..scenarios import ScenarioEnsemble
+    from ..ensemble.scheduler import MemberSpec
+    from ..fibers import container as fc
+    from ..system import System
+    from ..utils.rng import SimRNG
+
+    params = Params(
+        eta=1.0, dt_initial=0.02, dt_write=0.02, t_final=0.08,
+        gmres_tol=1e-8, adaptive_timestep_flag=False,
+        dynamic_instability=DynamicInstability(
+            n_nodes=8, v_growth=0.2, f_catastrophe=0.1,
+            nucleation_rate=100.0, min_length=0.3, radius=0.0125,
+            bending_rigidity=0.01))
+
+    # confining sphere (60-node quadrature) + nucleating body with 2 sites
+    pdata = precompute_periphery("sphere", n_nodes=60, radius=2.5, eta=1.0)
+    shell = peri.make_state(pdata["nodes"], pdata["normals"],
+                            pdata["quadrature_weights"],
+                            pdata["stresslet_plus_complementary"],
+                            pdata["M_inv"], dtype=jnp.float64)
+    shape = peri.PeripheryShape(kind="sphere", radius=2.5)
+    bdata = precompute_body("sphere", 40, radius=0.4)
+    rng = np.random.default_rng(3)
+    sites = rng.standard_normal((2, 3))
+    sites = 0.4 * sites / np.linalg.norm(sites, axis=1, keepdims=True)
+    bodies = bd.make_group(bdata["node_positions_ref"],
+                           bdata["node_normals_ref"], bdata["node_weights"],
+                           nucleation_sites_ref=sites[None], radius=0.4)
+    system = System(params, shell_shape=shape)
+
+    members = []
+    for i in range(2):
+        x = np.tile(np.linspace(0.0, 0.8, 8)[None, :, None], (2, 1, 3))
+        x += 0.6 + 0.1 * i
+        fibers = fc.make_group(x, lengths=0.8 * np.sqrt(3.0),
+                               bending_rigidity=0.01, radius=0.0125)
+        # 2 slots, both live: the first nucleation forces a growth reseat
+        state = system.make_state(fibers=fibers, bodies=bodies, shell=shell)
+        members.append(MemberSpec(member_id=f"m{i}", state=state,
+                                  t_final=params.t_final,
+                                  rng=SimRNG(17).member(i)))
+
+    tracer = obs_tracer.Tracer(None)
+    records: list = []
+    with obs_tracer.use(tracer):
+        se = ScenarioEnsemble(system, members, batch=2,
+                              metrics=records.append)
+        finished = se.run(max_rounds=60)
+
+    steps = [r for r in records if r.get("event") == "step"]
+    nucleations = sum(r["nucleations"] for r in steps)
+    compiles = [e for e in tracer.events if e.get("ev") == "compile"
+                and e.get("name") == "ensemble_step"]
+    rungs = sorted(se._scheds)
+
+    problems = []
+    if sorted(finished) != ["m0", "m1"]:
+        problems.append(f"members did not finish: {finished}")
+    if nucleations < 1:
+        problems.append("no nucleation was applied")
+    if se.reseats < 1:
+        problems.append("no growth reseat happened (capacity never filled)")
+    if len(compiles) != len(rungs):
+        problems.append(
+            f"{len(compiles)} compile events for {len(rungs)} capacity "
+            f"rungs {rungs} — a warm rung retraced (the zero-compiles-"
+            "after-reseat gate)")
+    if problems:
+        for p in problems:
+            print(f"scenario smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print(f"scenario smoke ok: 2 confined DI members finished, "
+          f"{nucleations} nucleation(s), {se.reseats} growth reseat(s) "
+          f"across rungs {rungs}, {len(compiles)} compiles "
+          f"(one per rung, zero warm-path)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

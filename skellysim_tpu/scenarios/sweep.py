@@ -1,0 +1,236 @@
+"""Scenario front-end: DI ensembles across a ladder of capacity rungs.
+
+The device DI engine (`scenarios.di_device`) runs nucleation/catastrophe
+as mask flips inside ONE compiled batched step — but a fixed-capacity
+trace cannot grow. This module owns the host half of that contract: every
+member runs at a geometric capacity rung (`system.buckets.
+next_fiber_capacity`, the same rungs skelly-serve admission uses), one
+`EnsembleScheduler` per rung shares ONE `EnsembleRunner` (so a rung's
+program is one `observed_jit` trace, warm via the persistent compile
+cache), and when a member's bucket fills (``EnsembleStepInfo.
+needs_growth``) the scheduler hands it back and `ScenarioEnsemble`
+reseats it onto the next rung: `fibers.container.grow_capacity` host-side
+(mask flips in-trace, geometric re-bucketing outside — O(log n) traces
+total over a sweep's whole life).
+
+The member's frozen round re-runs at the new rung with its RNG counter
+untouched, so a reseat costs one batched round plus (at most once per
+rung, ever) one trace.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ensemble.runner import EnsembleRunner
+from ..ensemble.scheduler import EnsembleScheduler, MemberSpec
+from ..fibers import container as fc
+from ..obs import tracer as obs_tracer
+from ..system import buckets as bucket_mod, di_rates, dynamic_instability
+from .di_device import check_di_state
+
+logger = logging.getLogger("skellysim_tpu")
+
+
+def ensure_di_capacity(state, params, capacity: Optional[int] = None,
+                       node_multiple: int = 1,
+                       policy: Optional[bucket_mod.BucketPolicy] = None):
+    """State padded onto a DI-runnable capacity rung.
+
+    Dynamic instability under the batched paths needs a single
+    fixed-capacity `FiberGroup` whose live resolution matches
+    ``dynamic_instability.n_nodes``. Fiber-less scenes (nucleation from
+    scratch — the host loop creates the group lazily on first nucleation)
+    get an all-inactive placeholder group seeded from the first body
+    nucleation site's geometry, so the batch has valid (finite-cache)
+    coordinates before anything nucleates. ``capacity`` overrides the
+    rung; the default is the smallest geometric rung holding the scene.
+    """
+    di = params.dynamic_instability
+    if di.n_nodes == 0:
+        return state
+    fibers = state.fibers
+    if fibers is not None and not isinstance(fibers, fc.FiberGroup):
+        raise ValueError(
+            "device dynamic instability supports a single fiber resolution "
+            "bucket; mixed-resolution tuples run the host loop")
+    if fibers is None:
+        tab = _host_sites(state.bodies)
+        if tab is None:
+            raise ValueError(
+                "cannot pre-allocate DI capacity: the scene has no fibers "
+                "and no body nucleation sites to seed a placeholder from")
+        origin, com = tab[0]
+        x = di_rates.nucleated_nodes(origin, com, di.min_length,
+                                     di.n_nodes, np)
+        dtype = state.time.dtype
+        group = fc.make_group(x[None], lengths=di.min_length,
+                              bending_rigidity=di.bending_rigidity,
+                              radius=di.radius, minus_clamped=True,
+                              dtype=dtype)
+        # the placeholder slot is INERT capacity, not a fiber: inactive and
+        # unbound, it weighs zero in every flow and solves the identity
+        group = group._replace(
+            active=jnp.zeros(1, dtype=jnp.bool_),
+            config_rank=jnp.full((1,), -1, dtype=jnp.int32))
+        fibers = group
+    cap = (capacity if capacity is not None
+           else bucket_mod.next_fiber_capacity(fibers.n_fibers, policy))
+    state = state._replace(
+        fibers=fc.grow_capacity(fibers, cap, node_multiple=node_multiple))
+    check_di_state(state, params)
+    return state
+
+
+def _host_sites(bodies):
+    """[(origin, com)] nucleation sites host-side (the ONE flat table order
+    of `dynamic_instability.host_site_table`), or None when no body carries
+    sites."""
+    tab = dynamic_instability.host_site_table(bodies)
+    return [(origin, com) for _, _, origin, com in tab] or None
+
+
+class ScenarioEnsemble:
+    """Drain DI members through per-rung schedulers sharing one runner.
+
+    The composition layer ROADMAP item 5 asks for: `members` (MemberSpec
+    iterable — each member MUST carry a per-member `SimRNG`) are padded
+    onto their geometric capacity rung and drained through one
+    `EnsembleScheduler` per rung, all rungs sharing one `EnsembleRunner`
+    (one `observed_jit` program; a rung's first member pays its one
+    trace, every later member and every reseat into it is warm).
+
+    Growth reseats are transparent: a member whose bucket fills freezes,
+    retires with reason ``"growth"``, is re-padded onto the next rung and
+    re-admitted under the same id with its synced RNG — its trajectory
+    stream continues seamlessly (``writer`` sees one monotone frame
+    sequence). ``on_retire`` fires for terminal retirements only.
+    """
+
+    def __init__(self, system, members, batch: int, *,
+                 batch_impl: str = "vmap",
+                 policy: Optional[bucket_mod.BucketPolicy] = None,
+                 writer: Optional[Callable] = None,
+                 metrics: Optional[Callable] = None,
+                 step_fn: Optional[Callable] = None,
+                 write_initial_frames: bool = False,
+                 on_dt_underflow: str = "retire",
+                 on_failure: str = "retire",
+                 on_retire: Optional[Callable] = None,
+                 node_multiple: int = 1,
+                 runner: Optional[EnsembleRunner] = None):
+        if not system.params.dynamic_instability.n_nodes:
+            raise ValueError(
+                "ScenarioEnsemble drives dynamic-instability sweeps; for "
+                "deterministic members use ensemble.EnsembleScheduler")
+        self.system = system
+        self.runner = runner or EnsembleRunner(system, batch_impl=batch_impl)
+        self.batch = batch
+        self.policy = policy
+        self.node_multiple = node_multiple
+        self.writer = writer
+        self.metrics = metrics
+        self.step_fn = step_fn
+        self.write_initial_frames = write_initial_frames
+        self.on_dt_underflow = on_dt_underflow
+        self.on_failure = on_failure
+        self.user_on_retire = on_retire
+        self._scheds: dict[int, EnsembleScheduler] = {}
+        self._specs: dict[str, MemberSpec] = {}
+        self.finished: list[str] = []
+        self.reseats = 0
+        self.rounds = 0
+        for spec in members:
+            self.admit(spec)
+
+    # ------------------------------------------------------------ admission
+
+    def _sched_for(self, capacity: int, template) -> EnsembleScheduler:
+        sched = self._scheds.get(capacity)
+        if sched is None:
+            sched = EnsembleScheduler(
+                self.runner, [], self.batch, template=template,
+                writer=self.writer, metrics=self.metrics,
+                step_fn=self.step_fn,
+                write_initial_frames=False,
+                on_dt_underflow=self.on_dt_underflow,
+                on_failure=self.on_failure,
+                on_growth="retire", on_retire=self._on_retire)
+            self._scheds[capacity] = sched
+            logger.info("scenario: capacity rung %d opened (%d rung(s))",
+                        capacity, len(self._scheds))
+        return sched
+
+    def admit(self, spec: MemberSpec):
+        """Pad ``spec`` onto its capacity rung and seat/queue it."""
+        if spec.rng is None:
+            raise ValueError(
+                f"member {spec.member_id}: scenario members need a "
+                "per-member SimRNG (SimRNG(seed).member(i))")
+        state = ensure_di_capacity(spec.state, self.system.params,
+                                   node_multiple=self.node_multiple,
+                                   policy=self.policy)
+        cap = state.fibers.n_fibers
+        spec = MemberSpec(member_id=spec.member_id, state=state,
+                          t_final=spec.t_final, rng=spec.rng,
+                          enqueued_at=spec.enqueued_at)
+        self._specs[spec.member_id] = spec
+        if self.write_initial_frames and self.writer is not None:
+            self.writer(spec.member_id, state,
+                        rng_state=spec.rng.dump_state())
+        return self._sched_for(cap, state).admit(spec)
+
+    # --------------------------------------------------------------- drain
+
+    def _on_retire(self, member_id: str, state, reason: str,
+                   rng_state=None, **extra):
+        spec = self._specs.get(member_id)
+        if reason == "growth":
+            # reseat onto the next geometric rung: the member's CURRENT
+            # state (frozen un-advanced) grows masked inert slots and
+            # re-admits under the same id — the scheduler already synced
+            # its SimRNG counter, so the re-run draws the same step the
+            # frozen round would have
+            old_cap = extra.get("capacity", state.fibers.n_fibers)
+            new_cap = bucket_mod.next_fiber_capacity(old_cap + 1, self.policy)
+            grown = state._replace(fibers=fc.grow_capacity(
+                state.fibers, new_cap, node_multiple=self.node_multiple))
+            self.reseats += 1
+            obs_tracer.emit("lane", action="growth_reseat",
+                            member=member_id, capacity=new_cap)
+            logger.info("scenario: member %s reseated %d -> %d fiber slots",
+                        member_id, old_cap, new_cap)
+            self._sched_for(new_cap, grown).admit(MemberSpec(
+                member_id=member_id, state=grown,
+                t_final=spec.t_final, rng=spec.rng))
+            return
+        if reason == "finished":
+            self.finished.append(member_id)
+        if self.user_on_retire is not None:
+            self.user_on_retire(member_id, state, reason,
+                                rng_state=rng_state, **extra)
+
+    def poll(self) -> bool:
+        """One batched round over every rung with live lanes; True when any
+        rung stepped."""
+        stepped = False
+        for cap in sorted(self._scheds):
+            sched = self._scheds[cap]
+            if sched.live:
+                sched.poll()
+                stepped = True
+        if stepped:
+            self.rounds += 1
+        return stepped
+
+    def run(self, max_rounds: Optional[int] = None) -> list:
+        """Drain every rung (growth reseats included) to completion;
+        returns finished member ids in retirement order."""
+        while self.poll():
+            if max_rounds is not None and self.rounds >= max_rounds:
+                break
+        return self.finished

@@ -50,6 +50,8 @@ class ServeMetrics:
         #: steps flagged loss_of_accuracy across every tenant (server
         #: increments via `note_loss_of_accuracy`)
         self.loss_of_accuracy_steps = 0
+        #: DI capacity-growth reseats (lane ``growth`` events)
+        self.growth_reseats = 0
 
     # ------------------------------------------------------------ ingest
 
@@ -66,6 +68,11 @@ class ServeMetrics:
                 reason = fields.get("reason", "finished")
                 self.retire_reasons[reason] = (
                     self.retire_reasons.get(reason, 0) + 1)
+            elif action == "growth":
+                # a DI tenant's nucleation outgrew its capacity bucket and
+                # the lane is being reseated onto a larger one
+                # (docs/scenarios.md "Growth reseats")
+                self.growth_reseats += 1
         elif ev == "span" and fields.get("name") == "ensemble_step":
             self.rounds += 1
             self.round_wall_s += float(fields.get("dur_s", 0.0))
@@ -125,6 +132,7 @@ class ServeMetrics:
             "warm": self.warm,
             "faults": dict(self.faults),
             "loss_of_accuracy_steps": self.loss_of_accuracy_steps,
+            "growth_reseats": self.growth_reseats,
             "frames_streamed": dict(self.frames_streamed),
             "frames_streamed_total": sum(self.frames_streamed.values()),
         }

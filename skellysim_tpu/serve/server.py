@@ -97,8 +97,17 @@ class SimulationServer:
         from ..fibers import container as fc
         from ..system import buckets as bucket_mod
 
-        system, base_state, _ = build_simulation(config,
-                                                 config_dir=config_dir)
+        system, base_state, _ = build_simulation(
+            config, config_dir=config_dir, synthesize_body_precompute=True)
+        self.di_enabled = system.params.dynamic_instability.n_nodes > 0
+        if self.di_enabled:
+            # dynamic-instability serving (docs/scenarios.md): the base
+            # scene pre-allocates its fiber capacity rung (fiber-less DI
+            # bases get the inert placeholder group), so bucket templates
+            # carry the capacity the in-trace DI update flips masks over
+            from ..scenarios import ensure_di_capacity
+
+            base_state = ensure_di_capacity(base_state, system.params)
         if base_state.fibers is None:
             raise ValueError("serve needs a base config with fibers: they "
                              "define the compiled-program contract tenants "
@@ -150,7 +159,7 @@ class SimulationServer:
                 runner, [], serve_cfg.max_lanes, template=template,
                 writer=self._on_frame, metrics=self._on_sched_event,
                 on_retire=self._on_retire, on_dt_underflow="retire",
-                on_failure="retire")
+                on_failure="retire", on_growth="retire")
             self.buckets.append(Bucket(
                 sum(c for c, _ in key.fibers), template, sched, key=key))
         self.buckets.sort(key=lambda b: b.capacity)
@@ -317,6 +326,9 @@ class SimulationServer:
                             bucket.template, state)
                         if mismatch:
                             raise ValueError(mismatch)
+                        if self.di_enabled and not rng_state:
+                            raise ValueError(
+                                "DI tenant snapshot lacks rng_state")
                     except Exception as e:
                         logger.warning(
                             "serve: journal tenant %s snapshot does not "
@@ -376,11 +388,23 @@ class SimulationServer:
             t.frames.append(tenants_mod.state_snapshot(state,
                                                        rng_state=rng_state))
             t.frames_total += 1
+            if rng_state is not None:
+                # DI tenants advance their stream in-trace; keep the record
+                # current so checkpoints/snapshots resume the exact counters
+                t.rng_state = rng_state
 
     def _on_retire(self, member_id: str, state, reason: str, **extra):
         import time
 
         t = self._tenant(member_id)
+        if extra.get("rng_state") is not None and t is not None:
+            t.rng_state = extra["rng_state"]
+        if reason == "growth":
+            # not a terminal retirement: the tenant's nucleation outgrew
+            # its capacity bucket — reseat onto the next bucket rung
+            # (docs/scenarios.md "Growth reseats")
+            self._grow_tenant(member_id, state, extra)
+            return
         if t is not None:
             t.final_frame = tenants_mod.state_snapshot(
                 state, rng_state=t.rng_state)
@@ -393,6 +417,48 @@ class SimulationServer:
             # restarted server still answers status/snapshot for this
             # tenant (and knows NOT to re-admit it)
             self._journal_record("retire", t, frame=t.final_frame)
+
+    def _grow_tenant(self, member_id: str, state, extra: dict):
+        """Reseat a DI tenant whose nucleation outgrew its bucket onto the
+        next capacity bucket; with no larger bucket the tenant terminates
+        as ``evicted`` (its current snapshot stays fetchable — resubmit it
+        to a server with bigger buckets)."""
+        import time
+
+        from ..ensemble.scheduler import MemberSpec
+        from ..system import buckets as bucket_mod
+        from ..utils.rng import SimRNG
+
+        t = self._tenant(member_id)
+        if t is None:
+            return
+        nxt = next((b for b in self.buckets
+                    if b.capacity > t.bucket
+                    and bucket_mod.admits(b.key, state)), None)
+        if nxt is None:
+            self.tracer.emit("fault", kind="growth_overflow",
+                             member=member_id, bucket=t.bucket)
+            logger.warning(
+                "serve: tenant %s outgrew the largest bucket (%d slots) — "
+                "evicting with its current snapshot", member_id, t.bucket)
+            t.status = "evicted"
+            t.t = float(state.time)
+            t.final_frame = tenants_mod.state_snapshot(
+                state, rng_state=t.rng_state)
+            t.retired_at = time.monotonic()
+            self._journal_record("retire", t, frame=t.final_frame)
+            return
+        grown = bucket_mod.bucketize_to(state, nxt.key)
+        old = t.bucket
+        t.bucket = nxt.capacity
+        rng = (SimRNG.from_state(t.rng_state) if t.rng_state else None)
+        nxt.scheduler.admit(MemberSpec(member_id=member_id, state=grown,
+                                       t_final=t.t_final, rng=rng))
+        self._journal_record(
+            "checkpoint", t,
+            frame=tenants_mod.state_snapshot(grown, rng_state=t.rng_state))
+        logger.info("serve: tenant %s reseated bucket %d -> %d",
+                    member_id, old, nxt.capacity)
 
     def _on_sched_event(self, rec: dict):
         t = self._tenant(rec.get("member", ""))
@@ -445,7 +511,8 @@ class SimulationServer:
                 "admission queue full on every bucket — retry later",
                 retry=True)
         try:
-            cfg = tenants_mod.parse_tenant_config(req["config"])
+            cfg = tenants_mod.parse_tenant_config(req["config"],
+                                                  di_enabled=self.di_enabled)
         except ValueError as e:
             self.metrics.note_rejected()
             return protocol.error(str(e))
@@ -454,7 +521,19 @@ class SimulationServer:
         if err:
             self.metrics.note_rejected()
             return protocol.error(err)
-        _, state, rng = build_simulation(cfg)
+        _, state, rng = build_simulation(cfg,
+                                         synthesize_body_precompute=True)
+        if self.di_enabled:
+            # fiber-less DI scenes get the inert placeholder group (capacity
+            # 1 here — bucketize_to below pads to the admitted bucket's)
+            from ..scenarios import ensure_di_capacity
+
+            try:
+                state = ensure_di_capacity(state, self.system.params,
+                                           capacity=1)
+            except ValueError as e:
+                self.metrics.note_rejected()
+                return protocol.error(str(e))
 
         # capacity-bucket selection: smallest bucket whose key admits the
         # scene (per-group fiber AND node capacities — `buckets.admits`)

@@ -134,13 +134,19 @@ class TenantRegistry:
 normalized_params = schema.normalized_member_params
 
 
-def parse_tenant_config(config_text: str):
+def parse_tenant_config(config_text: str, di_enabled: bool = False):
     """Submitted TOML text -> validated `schema.Config`.
 
     Serve tenants are free-space scenes (fibers + background + point
-    sources): periphery/bodies need server-side precompute npz files a wire
-    submission cannot carry, so they are rejected up front with a message
-    instead of failing deep in the builder."""
+    sources) plus — on a dynamic-instability server — ANALYTIC bodies:
+    a periphery needs a server-side precompute npz a wire submission
+    cannot carry, but a spherical/ellipsoidal MTOC's quadrature is a
+    deterministic function of (shape, n_nodes, radius) the server
+    rebuilds itself (`builder.build_bodies(synthesize_precompute=True)`),
+    so DI tenants can bring their nucleation bodies over the wire
+    (nucleation sites must be embedded in the TOML — site generation is
+    random and belongs client-side). Everything else is rejected up front
+    with a message instead of failing deep in the builder."""
     try:
         data = toml_loads(config_text)
     except Exception as e:
@@ -150,11 +156,24 @@ def parse_tenant_config(config_text: str):
         raise ValueError(
             "serve tenants cannot use a periphery: its precompute npz lives "
             "server-side; run periphery scenes through the batch CLIs")
-    if cfg.bodies:
+    if cfg.bodies and not di_enabled:
         raise ValueError(
-            "serve tenants cannot use bodies: their precompute npz lives "
-            "server-side; run body scenes through the batch CLIs")
-    if not cfg.fibers:
+            "serve tenants cannot use bodies on a server without dynamic "
+            "instability: run body scenes through the batch CLIs (a "
+            "[dynamic_instability] server admits analytic nucleation "
+            "bodies — docs/scenarios.md)")
+    for j, b in enumerate(cfg.bodies):
+        if b.shape not in ("sphere", "ellipsoid"):
+            raise ValueError(
+                f"bodies[{j}]: serve tenants can only bring analytic "
+                f"(sphere/ellipsoid) bodies, not {b.shape!r} — other "
+                "surfaces need a server-side precompute npz")
+        if b.n_nucleation_sites > 0 and not b.nucleation_sites:
+            raise ValueError(
+                f"bodies[{j}]: embed generated nucleation_sites in the "
+                "config (site generation is random and must happen "
+                "client-side so the submitted scene is deterministic)")
+    if not cfg.fibers and not (di_enabled and cfg.bodies):
         raise ValueError("tenant config has no fibers")
     problems = cfg.validate()
     if problems:

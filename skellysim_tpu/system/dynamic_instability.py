@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..fibers import container as fc
 from ..utils.rng import SimRNG
+from . import di_rates
 
 
 #: shared with the builder's ring-evaluator padding; see
@@ -50,7 +51,8 @@ def _bucket_bindings(groups):
 
 
 def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
-                              node_multiple: int = 1, _extra_occupied=None,
+                              node_multiple: int = 1, stats: dict | None = None,
+                              _extra_occupied=None,
                               _extra_bound: int = 0, _rank_floor: int = -1):
     """One nucleation/catastrophe update. Returns a new SimState.
 
@@ -62,8 +64,18 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
     through untouched but their site occupancy, bound-fiber count, and
     config ranks still feed the global bookkeeping (the reference's flat
     site bitmap spans all fibers).
+
+    The rate math is the shared `system.di_rates` module — ONE definition
+    with the device-side engine (`scenarios.di_device`), so the host oracle
+    and the in-trace ensemble update cannot drift. ``stats`` (optional
+    dict) is filled with this update's ``catastrophes`` / ``nucleations``
+    counts — the run-loop metrics fields (the loop counts the surviving
+    ``active_fibers`` off the final state itself).
     """
     di = params.dynamic_instability
+    if stats is not None:
+        stats.setdefault("catastrophes", 0)
+        stats.setdefault("nucleations", 0)
     if di.n_nodes == 0:
         return state
     if (state.fibers is not None
@@ -83,7 +95,8 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
              for g in others if g.config_rank is not None), default=-1)
         sub = apply_dynamic_instability(
             state._replace(fibers=buckets[idx]), params, rng,
-            capacity_factor, node_multiple, _extra_occupied=occ,
+            capacity_factor, node_multiple, stats=stats,
+            _extra_occupied=occ,
             _extra_bound=n_bound, _rank_floor=rank_floor)
         buckets[idx] = sub.fibers
         return state._replace(fibers=tuple(buckets))
@@ -104,21 +117,19 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
         nf = fibers.n_fibers
         active = np.asarray(fibers.active).copy()
         plus_pinned = np.asarray(fibers.plus_pinned)
-        v_growth = np.where(plus_pinned, di.v_growth * di.v_grow_collision_scale,
-                            di.v_growth)
-        f_cat = np.where(plus_pinned,
-                         di.f_catastrophe * di.f_catastrophe_collision_scale,
-                         di.f_catastrophe)
+        v_growth, f_cat = di_rates.effective_rates(di, plus_pinned, np)
         attached = active & (np.asarray(fibers.binding_body) >= 0)
         n_active_old = int(attached.sum())
 
         u = rng.distributed.uniform(size=nf)
-        die = active & (u > np.exp(-dt * f_cat))
+        die = di_rates.catastrophe_mask(active, u, dt, f_cat, np)
         survive = active & ~die
+        if stats is not None:
+            stats["catastrophes"] += int(die.sum())
 
         length = np.asarray(fibers.length)
         length_prev = np.where(survive, length, np.asarray(fibers.length_prev))
-        length = np.where(survive, length + dt * v_growth, length)
+        length = di_rates.grown_length(length, survive, dt, v_growth, np)
         fibers = fibers._replace(
             active=survive,
             length=length, length_prev=length_prev,
@@ -129,25 +140,7 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
         n_active_old = 0
 
     # ---------------------------------------------------------- nucleation
-    from ..bodies import bodies as bd
-
-    # global site table across every body bucket (the reference's flat
-    # bitmap over all sites, `dynamic_instability.cpp:63,87`); fibers bind
-    # by GLOBAL body id (`BodyGroup.config_rank`)
-    site_tab = []                               # (global_id, site, origin, com)
-    for g in bd.as_buckets(bodies):
-        ns_b = g.nucleation_sites_ref.shape[1]
-        if ns_b == 0:
-            continue
-        _, _, sites_lab = bd.place(g)
-        sites_lab = np.asarray(sites_lab)       # [nb, ns_b, 3]
-        pos = np.asarray(g.position)
-        ranks = (np.asarray(g.config_rank) if g.config_rank is not None
-                 else np.arange(g.n_bodies))
-        for lb in range(g.n_bodies):
-            for s_i in range(ns_b):
-                site_tab.append((int(ranks[lb]), s_i,
-                                 sites_lab[lb, s_i], pos[lb]))
+    site_tab = host_site_table(bodies)
     if not site_tab:
         return state._replace(fibers=_as_device(fibers, state))
     n_sites = len(site_tab)
@@ -162,9 +155,10 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
     free_sites = [k for k, (gid, s_i, _, _) in enumerate(site_tab)
                   if (gid, s_i) not in occupied]
     n_inactive_old = n_sites - n_active_old - _extra_bound
-    n_nucleate = min(
-        rng.distributed.poisson_int(dt * di.nucleation_rate * n_inactive_old),
-        len(free_sites))
+    n_nucleate = int(di_rates.nucleation_count(
+        rng.distributed.poisson_int(
+            di_rates.nucleation_mean(dt, di.nucleation_rate, n_inactive_old)),
+        len(free_sites)))
 
     # sequential uniform draws without replacement (`dynamic_instability.cpp:118-126`)
     chosen = []
@@ -176,16 +170,18 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
         return state._replace(fibers=_as_device(fibers, state))
 
     new_x, new_body, new_site = [], [], []
-    s = np.linspace(0.0, di.min_length, di.n_nodes)
     for flat in chosen:
         gid, i_site, origin, com = site_tab[int(flat)]
-        u_dir = origin - com
-        u_dir = u_dir / np.linalg.norm(u_dir)
-        new_x.append(origin[None, :] + s[:, None] * u_dir[None, :])
+        new_x.append(di_rates.nucleated_nodes(origin, com, di.min_length,
+                                              di.n_nodes, np))
         new_body.append(gid)
         new_site.append(i_site)
+    if stats is not None:
+        stats["nucleations"] += len(chosen)
 
     if fibers is None or fibers.n_fibers == 0:
+        from . import buckets as _buckets
+
         dtype = state.time.dtype
         fibers = fc.make_group(
             np.stack(new_x), lengths=di.min_length,
@@ -194,7 +190,14 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
             binding_site=np.array(new_site),
             config_rank=_rank_floor + 1 + np.arange(len(new_x)),
             dtype=dtype)
-        fibers = fc.grow_capacity(fibers, fibers.n_fibers, node_multiple)
+        # from-scratch groups land on the SAME geometric rungs as overflow
+        # growth and bucket admission (`buckets.next_fiber_capacity`): a
+        # `[runtime]`-laddered resume re-bucketizes live fibers onto their
+        # rung, and only a rung-aligned capacity keeps the continued
+        # trajectory bitwise (padding changes reduction shapes)
+        fibers = fc.grow_capacity(
+            fibers, _buckets.next_fiber_capacity(fibers.n_fibers),
+            node_multiple)
         return state._replace(fibers=fibers)
 
     # fill inactive slots; grow capacity geometrically when out of room —
@@ -262,6 +265,39 @@ def apply_dynamic_instability(state, params, rng: SimRNG, capacity_factor=1.5,
         arr["active"][slot] = True
     fibers = fibers._replace(**arr)
     return state._replace(fibers=_as_device(fibers, state))
+
+
+def host_site_table(bodies) -> list:
+    """Flat ``[(global_id, site_index, origin, com)]`` nucleation-site table
+    across every body bucket, host-side — the reference's flat bitmap over
+    all sites (`dynamic_instability.cpp:63,87`): bucket-concatenated,
+    body-major, site-minor; fibers bind by GLOBAL body id
+    (`BodyGroup.config_rank`). ONE definition shared by this host update
+    and the scenario front-end (`scenarios.sweep`); the traced twin
+    (`scenarios.di_device.site_table`) must keep exactly this order or
+    injected-draw site-selection parity between the paths breaks."""
+    from ..bodies import bodies as bd
+
+    tab = []
+    for g in bd.as_buckets(bodies):
+        ns_b = g.nucleation_sites_ref.shape[1]
+        if ns_b == 0:
+            continue
+        _, _, sites_lab = bd.place(g)
+        sites_lab = np.asarray(sites_lab)       # [nb, ns_b, 3]
+        pos = np.asarray(g.position)
+        ranks = (np.asarray(g.config_rank) if g.config_rank is not None
+                 else np.arange(g.n_bodies))
+        for lb in range(g.n_bodies):
+            for s_i in range(ns_b):
+                tab.append((int(ranks[lb]), s_i, sites_lab[lb, s_i], pos[lb]))
+    return tab
+
+
+def _count_active(fibers) -> int:
+    """Host-side live fiber count over every bucket (the `active_fibers`
+    metrics field; cheap — one bool mask fetch per bucket)."""
+    return sum(int(np.asarray(g.active).sum()) for g in fc.as_buckets(fibers))
 
 
 def _as_device(fibers, state):

@@ -92,8 +92,8 @@ def _rewrap_fibers(fibers, new_buckets: tuple):
 METRICS_FIELDS = ("step", "t", "dt", "iters", "gmres_cycles",
                   "collective_rounds", "residual", "residual_true",
                   "fiber_error", "accepted", "refines", "loss_of_accuracy",
-                  "health", "guard_retries", "wall_s", "wall_ms",
-                  "gmres_history")
+                  "health", "guard_retries", "nucleations", "catastrophes",
+                  "active_fibers", "wall_s", "wall_ms", "gmres_history")
 
 
 def crossed_write_boundary(t_new: float, dt: float, dt_write: float) -> bool:
@@ -1310,7 +1310,8 @@ class System:
         return state
 
     def _run_loop(self, state: SimState, *, writer, max_steps, rng, metrics_fh):
-        from .dynamic_instability import apply_dynamic_instability
+        from .dynamic_instability import (_count_active as _di_count_active,
+                                          apply_dynamic_instability)
 
         p = self.params
         n_steps = 0
@@ -1327,13 +1328,16 @@ class System:
             if max_steps is not None and n_steps >= max_steps:
                 break
             backup = state
+            di_stats = None
             if rng is not None and p.dynamic_instability.n_nodes > 0:
                 # a ring mesh constrains nucleation's capacity growth to
                 # mesh-divisible node counts (grow_capacity invariant)
                 nm = self.mesh.size if self._ring_active() else 1
+                di_stats = {}
                 with obs_tracer.span("dynamic_instability"):
                     state = apply_dynamic_instability(state, p, rng,
-                                                      node_multiple=nm)
+                                                      node_multiple=nm,
+                                                      stats=di_stats)
             # snapshot the time scalars BEFORE the step: with donation on,
             # the step consumes the input state's buffers
             t_cur = float(state.time)
@@ -1435,6 +1439,17 @@ class System:
                     "loss_of_accuracy": bool(info.loss_of_accuracy),
                     "health": health,
                     "guard_retries": int(info.guard_retries),
+                    # dynamic-instability trajectory (docs/scenarios.md):
+                    # events applied this trial (a rejected trial discards
+                    # its DI update, so it reports 0/0, matching the
+                    # ensemble records) and the live count that persists
+                    "nucleations": (di_stats["nucleations"]
+                                    if accept and di_stats else 0),
+                    "catastrophes": (di_stats["catastrophes"]
+                                     if accept and di_stats else 0),
+                    "active_fibers": (_di_count_active(
+                        (new_state if accept else backup).fibers)
+                        if di_stats is not None else 0),
                     "wall_s": round(wall_s, 4),
                     "wall_ms": round(wall_s * 1e3, 3),
                     "gmres_history": history_rows(info.history,

@@ -308,3 +308,24 @@ def test_fmm_evaluator_name_maps_to_ewald(tmp_path):
     assert rt3.pair_evaluator == "direct"
     with pytest.raises(ValueError, match="unknown pair_evaluator"):
         schema.to_runtime_params(schema.Params(pair_evaluator="spectral"))
+
+
+def test_deformable_body_rejected_at_schema_validation(tmp_path):
+    """skelly-scenario satellite: a deformable-body config fails at
+    schema-validation time with a structured error naming the reference
+    parity stub, instead of failing deep in `builder.build_bodies` ->
+    `make_group` at build time."""
+    cfg = Config()
+    fib = Fiber(n_nodes=8, length=1.0)
+    fib.fill_node_positions(np.zeros(3), np.array([0.0, 0.0, 1.0]))
+    cfg.fibers = [fib]
+    cfg.bodies = [Body(shape="deformable")]
+    problems = cfg.validate()
+    assert any("deformable" in p and "bodies/deformable.py" in p
+               for p in problems), problems
+    # save() refuses like every other validation failure
+    with pytest.raises(ValueError, match="deformable"):
+        cfg.save(str(tmp_path / "bad.toml"))
+    # sphere/ellipsoid stay valid
+    cfg.bodies = [Body(shape="sphere", radius=0.5)]
+    assert not cfg.validate()

@@ -317,14 +317,20 @@ def test_runner_rejects_untraceable_configs(scene):
     sys_ew.params = ew
     with pytest.raises(ValueError, match="ewald"):
         EnsembleRunner(sys_ew)
+    # dynamic instability is no longer rejected (skelly-scenario runs it
+    # in-trace) — but a member whose live fiber resolution does not match
+    # dynamic_instability.n_nodes still fails loudly at assembly
     di = dataclasses.replace(
         system.params,
         dynamic_instability=dataclasses.replace(
             system.params.dynamic_instability, n_nodes=16))
     sys_di, _ = _ensemble_system()
     sys_di.params = di
-    with pytest.raises(ValueError, match="dynamic instability"):
-        EnsembleRunner(sys_di)
+    runner_di = EnsembleRunner(sys_di)
+    assert runner_di.di_enabled
+    with pytest.raises(ValueError, match="live *\n? *resolution|resolution"):
+        runner_di.make_ensemble([members[0].state], [0.1],
+                                rngs=[SimRNG(1).member(0)])
 
 
 def test_stack_states_rejects_mismatched_members(scene):

@@ -89,3 +89,64 @@ def test_resume_with_dynamic_instability(tmp_path):
     r3 = TrajectoryReader(traj)
     assert r3.times[-1] >= 0.55
     r3.close()
+
+
+@pytest.mark.slow  # two e2e cli runs + a run->resume pair (~60 s)
+def test_resume_into_runtime_ladder_rung_continues_bitwise(tmp_path):
+    """skelly-scenario satellite: DI `--resume` under a non-identity
+    `[runtime]` bucket ladder. A growth-only run (f_catastrophe = 0, so
+    the live count tracks its geometric rung exactly) whose fiber capacity
+    grew mid-flight is interrupted and resumed: the resume re-bucketizes
+    the live fibers onto the SAME geometric rung the uninterrupted run
+    occupies (`buckets.next_fiber_capacity` == the ladder's rung), the RNG
+    stream restores its counters, and every appended frame is BYTE-equal
+    to the uninterrupted run's — capacity padding is invisible to the
+    physics and the wire."""
+    def ladder_cfg(dirname, t_final):
+        d = tmp_path / dirname
+        d.mkdir(exist_ok=True)
+        path = _di_config(d, t_final)
+        cfg = open(path).read()
+        with open(path, "w") as fh:
+            # growth-only: catastrophes would let the live count fall below
+            # its rung, and the uninterrupted capacity (which never
+            # shrinks) would then diverge from the resume's re-bucketized
+            # rung — draw shapes, and so the RNG stream, would split
+            fh.write(cfg.replace("f_catastrophe = 0.5",
+                                 "f_catastrophe = 0.0"))
+            fh.write("\n[runtime]\nbucket_ladder = [-1]\n")
+        return str(d), path
+
+    # uninterrupted oracle to t=0.6
+    full_dir, full_cfg = ladder_cfg("full", 0.6)
+    precompute.precompute_from_config(full_cfg, verbose=False)
+    cli.run(full_cfg)
+    rf = TrajectoryReader(str(tmp_path / "full" / "skelly_sim.out"))
+    full_frames = [rf.load_frame(i) for i in range(len(rf))]
+    rf.close()
+    counts = [len(f["fibers"][1]) for f in full_frames]
+    assert max(counts) > 4, (
+        "scene never outgrew the first ladder rungs — the test must cross "
+        f"a capacity growth to mean anything (counts {counts})")
+
+    # interrupted twin: run to t=0.3, extend, resume to 0.6
+    part_dir, part_cfg = ladder_cfg("part", 0.3)
+    precompute.precompute_from_config(part_cfg, verbose=False)
+    cli.run(part_cfg)
+    ladder_cfg("part", 0.6)
+    cli.run(part_cfg, resume=True)
+
+    rp = TrajectoryReader(str(tmp_path / "part" / "skelly_sim.out"))
+    part_frames = [rp.load_frame(i) for i in range(len(rp))]
+    rp.close()
+    assert len(part_frames) == len(full_frames)
+    for k, (a, b) in enumerate(zip(full_frames, part_frames)):
+        assert a["time"] == b["time"], k
+        fa, fb = a["fibers"][1], b["fibers"][1]
+        assert len(fa) == len(fb), f"frame {k}: fiber count diverged"
+        for f1, f2 in zip(fa, fb):
+            for key in ("x_", "length_", "binding_site_", "tension_"):
+                np.testing.assert_array_equal(
+                    np.asarray(f1[key]), np.asarray(f2[key]),
+                    err_msg=f"frame {k} field {key} not bitwise across "
+                            "the ladder-rung resume")

@@ -26,7 +26,7 @@ import numpy as np
 import pytest
 
 from skellysim_tpu.builder import build_simulation
-from skellysim_tpu.config import BackgroundSource, Config, Fiber, schema
+from skellysim_tpu.config import Body, BackgroundSource, Config, Fiber, schema
 from skellysim_tpu.config.toml_io import dumps as toml_dumps
 from skellysim_tpu.io.trajectory import frame_bytes
 from skellysim_tpu.serve import protocol
@@ -803,3 +803,91 @@ def test_socket_end_to_end(tmp_path):
             assert stats["compiles_after_warm"] == 0
         rc = srv.stop()
     assert rc == 0
+
+
+# --------------------------------------------- dynamic-instability serving
+
+
+def _di_cfg(n_sites=4, nucleation_rate=200.0, t_final=0.04, seed=130319):
+    """Fiber-less confined-class DI tenant scene: one analytic nucleating
+    body with EMBEDDED sites (the wire contract — docs/scenarios.md)."""
+    cfg = Config()
+    cfg.params.eta = 1.0
+    cfg.params.dt_initial = 0.02
+    cfg.params.dt_write = 0.02
+    cfg.params.t_final = t_final
+    cfg.params.gmres_tol = 1e-10
+    cfg.params.adaptive_timestep_flag = False
+    cfg.params.seed = seed
+    di = cfg.params.dynamic_instability
+    di.n_nodes = 8
+    di.v_growth = 0.2
+    di.f_catastrophe = 0.0
+    di.nucleation_rate = nucleation_rate
+    di.min_length = 0.3
+    di.radius = 0.0125
+    di.bending_rigidity = 0.01
+    rng = np.random.default_rng(7)
+    sites = rng.standard_normal((n_sites, 3))
+    sites = 0.4 * sites / np.linalg.norm(sites, axis=1, keepdims=True)
+    cfg.bodies = [Body(shape="sphere", radius=0.4, n_nodes=40,
+                       n_nucleation_sites=n_sites,
+                       nucleation_sites=sites.ravel().tolist())]
+    return cfg
+
+
+def test_di_tenant_admission_rules():
+    """DI serve admission (docs/scenarios.md): bodies stay rejected on a
+    non-DI server; a DI server admits ANALYTIC bodies with embedded sites
+    and rejects non-analytic surfaces and unembedded generated sites."""
+    from skellysim_tpu.serve import tenants as tenants_mod
+
+    text = _toml(_di_cfg())
+    with pytest.raises(ValueError, match="dynamic"):
+        tenants_mod.parse_tenant_config(text, di_enabled=False)
+    out = tenants_mod.parse_tenant_config(text, di_enabled=True)
+    assert out.bodies and out.bodies[0].nucleation_sites
+    bad = _di_cfg()
+    bad.bodies[0].shape = "deformable"
+    with pytest.raises(ValueError, match="analytic"):
+        tenants_mod.parse_tenant_config(_toml(bad), di_enabled=True)
+    bad2 = _di_cfg()
+    bad2.bodies[0].nucleation_sites = []
+    with pytest.raises(ValueError, match="embed"):
+        tenants_mod.parse_tenant_config(_toml(bad2), di_enabled=True)
+    # fiber-less is legal ONLY with a nucleating body on a DI server
+    nofib = _di_cfg()
+    nofib.bodies = []
+    with pytest.raises(ValueError, match="no fibers"):
+        tenants_mod.parse_tenant_config(_toml(nofib), di_enabled=True)
+
+
+@pytest.mark.slow  # warms two vmap coupled body-program buckets (~80 s)
+def test_di_tenant_growth_reseat_and_finish():
+    """Tentpole serve pin: a DI tenant (fiber-less, nucleating analytic
+    body) admits onto a DI server, its nucleation burst outgrows the first
+    capacity bucket, `_grow_tenant` reseats it onto the next bucket, and
+    it finishes with a streamable trajectory + `growth_reseats` on
+    /stats."""
+    srv = SimulationServer(
+        _di_cfg(), serve_cfg=schema.ServeConfig(max_lanes=1,
+                                                batch_impl="vmap",
+                                                bucket_capacities=[2, 4]))
+    assert srv.di_enabled
+    assert [b.capacity for b in srv.buckets] == [2, 4]
+    r = _submit(srv, _di_cfg(seed=7), tenant="di0")
+    assert r["tenant"] == "di0"
+    _drain(srv)
+    st = srv.handle_request({"type": "status", "tenant": "di0"})
+    assert st["ok"] and st["status"] == "finished", st
+    # 4 free sites at rate 200 make the first nucleation burst ~surely
+    # outgrow the 2-slot bucket: the growth reseat moved the tenant 2 -> 4
+    stats = srv.handle_request({"type": "stats"})["stats"]
+    assert stats["growth_reseats"] >= 1, stats
+    t = srv.registry.get("di0")
+    assert t.bucket == 4
+    frames = _stream(srv, "di0")
+    assert len(frames) >= 2
+    # the snapshot survives as a resume point with its RNG streams
+    snap = srv.handle_request({"type": "snapshot", "tenant": "di0"})
+    assert snap["ok"] and snap["frame"]
