@@ -1274,6 +1274,11 @@ def _group_multichip(extra, ck, on_acc):
         doc["generated_by"] = "bench.py --group multichip"
         doc["round"] = MULTICHIP_ROUND
         doc["backend"] = extra.get("backend")
+        # provenance (skelly-pulse): the round artifact self-describes the
+        # runtime + hardware it measured (obs.tracer.provenance, stamped
+        # into `extra` by _child_main); `downscaled` is already on `out`
+        doc["jax_version"] = extra.get("jax_version")
+        doc["device_kind"] = extra.get("device_kind")
         doc["telemetry_version"] = TELEMETRY_VERSION
         try:
             with open(MULTICHIP_JSON_PATH, "w") as fh:
@@ -1544,6 +1549,8 @@ def _group_treecode(extra, ck, on_acc):
         doc = dict(out)
         doc["generated_by"] = "bench.py --group treecode"
         doc["backend"] = extra.get("backend")
+        doc["jax_version"] = extra.get("jax_version")
+        doc["device_kind"] = extra.get("device_kind")
         doc["telemetry_version"] = TELEMETRY_VERSION
         try:
             with open(TREECODE_JSON_PATH, "w") as fh:
@@ -1768,10 +1775,13 @@ def _child_main(group: str, out_path: str):
     except Exception:
         pass
     extra["backend"] = jax.default_backend()
-    try:
-        extra["device_kind"] = jax.devices()[0].device_kind
-    except Exception:
-        extra["device_kind"] = None
+    # provenance stamp (skelly-pulse): jax_version/device_kind from the ONE
+    # helper the telemetry header uses — bench artifacts and timelines
+    # self-describe identically. Children import jax anyway; the jax-free
+    # PARENT never calls this (it merges the children's values).
+    from skellysim_tpu.obs.tracer import provenance
+
+    extra.update(provenance())
     on_acc = extra["backend"] != "cpu"
     ck()
 
@@ -1869,6 +1879,8 @@ def _parent_main():
             backend = child.pop("backend", backend) or backend
             extra["device_kind"] = child.pop("device_kind",
                                              extra.get("device_kind"))
+            extra["jax_version"] = child.pop("jax_version",
+                                             extra.get("jax_version"))
             child.pop("group_total_s", None)
             extra.update(child)
         except Exception:

@@ -49,10 +49,20 @@ echo "== obs: skelly-scope cost baselines (docs/observability.md) =="
 # the compile cache is shared with bench.py; cold runs pay ~40 s more.)
 python -m skellysim_tpu.obs cost --check
 
-echo "== obs: skelly-scope telemetry smoke (2-step run -> summarize) =="
+echo "== obs: skelly-pulse bench-history regression gate =="
+# skelly-pulse: diff the archived bench rounds (benchmarks/MULTICHIP_r*)
+# on their gated ladder metrics — a coupled-solve speedup regression
+# beyond 25% on non-downscaled rounds fails CI here instead of waiting
+# for someone to eyeball two JSONs (downscaled CPU rounds warn only).
+# Pure JSON parsing, <1 s.
+python -m skellysim_tpu.obs perf --compare benchmarks/
+
+echo "== obs: skelly-scope telemetry smoke (2-step run -> summarize + timeline) =="
 # a real System.run with metrics+trace streams, rendered through the CLI:
 # pins the acceptance path end to end (span events, compile events,
-# convergence stats from one JSONL pair) in ~15 s
+# convergence stats from one JSONL pair) in ~15 s, then merges the trace
+# into a perfetto timeline and structurally validates it (>=1 host track
+# with span slices — the `obs timeline` smoke)
 OBS_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu python -c "
 from skellysim_tpu.utils.bootstrap import force_cpu_devices
@@ -68,6 +78,21 @@ system.run(fixtures.free_state(system), max_steps=2,
 python -m skellysim_tpu.obs summarize "$OBS_TMP"/metrics.jsonl "$OBS_TMP"/trace.jsonl \
   | grep -q "solver convergence" \
   || { echo "obs summarize smoke failed" >&2; rm -rf "$OBS_TMP"; exit 1; }
+python -m skellysim_tpu.obs timeline "$OBS_TMP"/trace.jsonl -o "$OBS_TMP"/timeline.json \
+  || { echo "obs timeline smoke failed" >&2; rm -rf "$OBS_TMP"; exit 1; }
+python -c "
+import json
+doc = json.load(open('$OBS_TMP/timeline.json'))
+evs = doc['traceEvents']
+hosts = [e for e in evs if e.get('ph') == 'M' and e.get('name') == 'process_name']
+assert hosts, 'timeline has no process tracks'
+slices = [e for e in evs if e.get('ph') == 'X']
+instants = [e for e in evs if e.get('ph') == 'i']
+assert slices, 'timeline has no host span slices'
+assert instants, 'timeline has no compile instants'
+print(f'timeline smoke ok: {len(hosts)} track(s), {len(slices)} slice(s), '
+      f'{len(instants)} instant(s)')
+" || { echo "obs timeline validation failed" >&2; rm -rf "$OBS_TMP"; exit 1; }
 rm -rf "$OBS_TMP"
 
 echo "== bucket: warm-cache + zero-compile smoke (docs/performance.md) =="

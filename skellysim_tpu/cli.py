@@ -136,8 +136,13 @@ def main(argv=None) -> None:
                          "events; render with `python -m skellysim_tpu.obs "
                          "summarize`, docs/observability.md)")
     ap.add_argument("--profile", default=None, metavar="DIR",
-                    help="wrap the run in jax.profiler.trace(DIR) — "
-                         "perfetto/TensorBoard dumps of the whole loop")
+                    help="device profiler capture of the whole loop "
+                         "(obs.profile.profile_session — python tracer "
+                         "off so device ops survive the buffer); the dump "
+                         "is parsed afterwards and device_phase events "
+                         "are appended to --trace-file. Render with "
+                         "`python -m skellysim_tpu.obs profile DIR` / "
+                         "`obs timeline` (docs/observability.md)")
     ap.add_argument("--jax-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache directory shared "
                          "across runs/CLIs (default: [runtime] jax_cache, "

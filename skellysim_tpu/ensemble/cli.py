@@ -95,8 +95,15 @@ def _members_from_sweep(sweep_file: str):
 def run(sweep_file: str, output_dir: str | None = None,
         batch: int | None = None, batch_impl: str | None = None,
         overwrite: bool = False, metrics_path: str | None = None,
-        trace_path: str | None = None) -> list:
-    """Expand + drain a sweep; returns retired member ids."""
+        trace_path: str | None = None,
+        profile_dir: str | None = None) -> list:
+    """Expand + drain a sweep; returns retired member ids.
+
+    ``profile_dir`` wraps the drain in the device profiler
+    (`obs.profile.profile_session`) and, after it closes, folds the dump
+    back into the telemetry stream as ``device_phase`` events — so `obs
+    summarize` on the ``--trace-file`` shows device time by phase next to
+    the lane occupancy (docs/observability.md)."""
     import contextlib
 
     from ..io.ensemble_io import EnsembleMetricsWriter, MemberTrajectoryWriters
@@ -124,8 +131,15 @@ def run(sweep_file: str, output_dir: str | None = None,
     tracer = obs_tracer.Tracer(trace_path) if trace_path else None
     scope = (obs_tracer.use(tracer) if tracer is not None
              else contextlib.nullcontext())
+    if profile_dir is not None:
+        from ..obs.profile import profile_session
+
+        prof = profile_session(profile_dir)
+    else:
+        prof = contextlib.nullcontext()
     try:
-        with writers, EnsembleMetricsWriter(metrics_path) as metrics, scope:
+        with writers, EnsembleMetricsWriter(metrics_path) as metrics, \
+                scope, prof:
             if runner.di_enabled:
                 # dynamic-instability sweeps: the scenario front-end runs
                 # the in-trace DI update on the ensemble lanes and handles
@@ -153,6 +167,13 @@ def run(sweep_file: str, output_dir: str | None = None,
                     # JSONL
                     on_failure="retire")
                 retired = sched.run()
+        if profile_dir is not None:
+            # the dump is written at prof's exit above — fold it into the
+            # active telemetry stream (the CLI's --trace-file, or an
+            # externally installed tracer) as device_phase events
+            from ..obs.profile import emit_device_phases
+
+            emit_device_phases(profile_dir, tracer)
     finally:
         # close even when the drain raises (System.run's tracer lifecycle)
         if tracer is not None:
@@ -186,6 +207,13 @@ def main(argv=None) -> None:
                     help="skelly-scope telemetry JSONL (lane events + "
                          "batched-step spans; `python -m skellysim_tpu.obs "
                          "summarize` reports lane occupancy from it)")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="device profiler capture of the drain "
+                         "(obs.profile.profile_session); the dump is "
+                         "parsed afterwards and device_phase events are "
+                         "appended to --trace-file — render with `obs "
+                         "profile DIR` / `obs timeline` "
+                         "(docs/observability.md)")
     ap.add_argument("--jax-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache directory shared "
                          "across runs/CLIs (default-on: [runtime] jax_cache "
@@ -228,4 +256,5 @@ def main(argv=None) -> None:
 
     run(args.sweep_file, output_dir=args.output_dir, batch=args.batch,
         batch_impl=args.batch_impl, overwrite=args.overwrite,
-        metrics_path=args.metrics_file, trace_path=args.trace_file)
+        metrics_path=args.metrics_file, trace_path=args.trace_file,
+        profile_dir=args.profile)

@@ -1,4 +1,5 @@
-"""skelly-scope CLI: `python -m skellysim_tpu.obs <summarize|cost>`.
+"""skelly-scope CLI:
+`python -m skellysim_tpu.obs <summarize|cost|profile|timeline|perf>`.
 
 ``summarize FILE [FILE...]`` renders any mix of telemetry/metrics JSONL
 streams (run-loop metrics, `System.run(trace_path=...)` traces, ensemble
@@ -6,6 +7,19 @@ metrics, bench traces) into per-span timings, compile events, lane
 occupancy, and solver convergence stats. Pure host-side text processing —
 it never initializes a jax backend (the package import pulls the jax
 *module* in, nothing more).
+
+``profile DIR [--by phase|collective|op] [--json]`` attributes the device
+op time of a ``--profile`` dump to the named_scope phase vocabulary
+(`obs.profile`, docs/observability.md "Device-time attribution").
+
+``timeline TRACE.jsonl [TRACE...] [--profile DIR] -o out.perfetto.json``
+merges telemetry spans, compile instants, and (optionally) the profiler's
+device phases into ONE Chrome-trace/Perfetto artifact (`obs.timeline`).
+
+``perf --compare DIR [--gate PCT] [--json]`` diffs the archived bench
+rounds (``benchmarks/MULTICHIP_r*.json`` …) and exits 1 on a gated-metric
+regression on non-downscaled rounds (`obs.perf`) — the CI bench-history
+gate.
 
 ``cost`` measures every registered auditable program's XLA cost/memory
 analysis and (``--check``) gates it against `obs/baselines/*.toml` — exit
@@ -95,18 +109,116 @@ def _cmd_cost(args) -> int:
     return 0
 
 
+def _cmd_profile(args) -> int:
+    import json as json_mod
+
+    from . import profile as profile_mod
+
+    try:
+        trace = profile_mod.load_device_trace(args.dir)
+    except FileNotFoundError as e:
+        print(f"skelly-pulse: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json_mod.dumps(profile_mod.profile_json(trace)))
+    else:
+        print(profile_mod.render_table(trace, by=args.by), end="")
+    return 0
+
+
+def _cmd_timeline(args) -> int:
+    import os
+
+    from . import timeline as timeline_mod
+
+    missing = [p for p in args.traces if not os.path.exists(p)]
+    if missing:
+        print(f"skelly-pulse: no such trace file(s): "
+              f"{', '.join(missing)}", file=sys.stderr)
+        return 2
+    try:
+        counts = timeline_mod.write_timeline(args.traces, args.out,
+                                             profile_dir=args.profile)
+    except FileNotFoundError as e:
+        print(f"skelly-pulse: {e}", file=sys.stderr)
+        return 2
+    print(f"skelly-pulse: {args.out}: {counts['events']} events "
+          f"({counts['host_slices']} host slices, {counts['instants']} "
+          f"instants, {counts['device_slices']} device slices) — open in "
+          "ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+def _cmd_perf(args) -> int:
+    import json as json_mod
+
+    from . import perf as perf_mod
+
+    if not args.compare:
+        print("skelly-pulse: perf needs --compare DIR", file=sys.stderr)
+        return 2
+    try:
+        if args.json:
+            # exit-code contract shared with the text path via report_json
+            # (2 = no rounds, 1 = gated regression) — a --json CI wiring
+            # must fail exactly when the text gate would
+            doc, rc = perf_mod.report_json(args.compare,
+                                           gate_pct=args.gate)
+            print(json_mod.dumps(doc, indent=1))
+            return rc
+        report, rc = perf_mod.render_report(args.compare,
+                                            gate_pct=args.gate)
+    except FileNotFoundError as e:
+        print(f"skelly-pulse: {e}", file=sys.stderr)
+        return 2
+    print(report, end="")
+    return rc
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m skellysim_tpu.obs",
         description="skelly-scope: runtime telemetry — span/compile event "
-                    "summaries and the program cost gate "
-                    "(docs/observability.md).")
+                    "summaries, the program cost gate, device-time "
+                    "attribution, merged timelines, and the bench-history "
+                    "gate (docs/observability.md).")
     sub = parser.add_subparsers(dest="cmd")
 
     p_sum = sub.add_parser(
         "summarize", help="render telemetry/metrics JSONL file(s) into "
                           "span/compile/lane/convergence tables")
     p_sum.add_argument("files", nargs="+", metavar="JSONL")
+
+    p_prof = sub.add_parser(
+        "profile", help="attribute a --profile dump's device op time to "
+                        "named phases (docs/observability.md)")
+    p_prof.add_argument("dir", metavar="DIR",
+                        help="jax.profiler.trace dump directory")
+    p_prof.add_argument("--by", default="phase",
+                        choices=("phase", "collective", "op"),
+                        help="grouping for the attribution table")
+    p_prof.add_argument("--json", action="store_true",
+                        help="machine-readable report (all groupings)")
+
+    p_tl = sub.add_parser(
+        "timeline", help="merge telemetry JSONL (+ profiler dump) into one "
+                         "perfetto/chrome-trace JSON")
+    p_tl.add_argument("traces", nargs="+", metavar="TRACE.jsonl")
+    p_tl.add_argument("--profile", default=None, metavar="DIR",
+                      help="profiler dump dir for the device-phase track")
+    p_tl.add_argument("-o", "--out", required=True,
+                      help="output path (e.g. out.perfetto.json)")
+
+    p_perf = sub.add_parser(
+        "perf", help="bench-history regression gate over archived "
+                     "<GROUP>_rNN.json rounds")
+    p_perf.add_argument("--compare", metavar="DIR",
+                        help="bench artifact directory (benchmarks/)")
+    p_perf.add_argument("--gate", type=float, default=25.0, metavar="PCT",
+                        help="regression tolerance percent on gated "
+                             "metrics (default 25; downscaled rounds "
+                             "warn instead of failing)")
+    p_perf.add_argument("--json", action="store_true")
 
     p_cost = sub.add_parser(
         "cost", help="measure every auditable program's XLA cost/memory "
@@ -127,6 +239,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.cmd == "summarize":
         return _cmd_summarize(args)
+    if args.cmd == "profile":
+        return _cmd_profile(args)
+    if args.cmd == "timeline":
+        return _cmd_timeline(args)
+    if args.cmd == "perf":
+        return _cmd_perf(args)
     if args.cmd == "cost":
         if args.check and args.update:
             print("skelly-scope: --check and --update are mutually "

@@ -1,0 +1,248 @@
+"""Bench-history regression gate: read the archived rounds, diff the
+ladder (skelly-pulse).
+
+`bench.py` archives round artifacts (``benchmarks/MULTICHIP_r01..r07``,
+root ``TREECODE_r06.json`` …) but until now nothing READ them — a ladder
+regression only surfaced if someone eyeballed two JSONs. ``python -m
+skellysim_tpu.obs perf --compare DIR [--gate PCT]`` closes the loop:
+
+* every ``<GROUP>_r<NN>.json`` in the dir is one round of one group
+  (multichip / collectives / treecode / compile / scenarios — any future
+  group joins by naming convention);
+* the trajectory table prints each group's gated metrics across ALL
+  rounds (failed/timeout rounds — the r01–r05 `{"rc": 124}` shells —
+  render as ``-``, never crash the report);
+* the LATEST TWO parseable rounds are diffed on the gated metrics; a
+  drop worse than ``--gate`` percent exits 1.
+
+Gated metrics are the throughput/speedup RATIOS (key suffixes in
+`GATED_SUFFIXES` — ``speedup_vs_1dev``, ``tree_vs_direct``,
+``*_per_s`` …), not raw walls: ratios survive scene-size changes between
+rounds, walls do not. Rounds stamped ``"downscaled": true`` (the CPU
+fallback — every round so far; see `_mark_downscaled` in bench.py) report
+regressions as WARNINGS and exit 0: toy-scale CPU walls swing ±35%
+run-to-run, and a gate that cries wolf gets deleted. The gate ARMS
+ITSELF on the first real-backend round pair.
+
+jax-free (json only), cheap enough for every CI tier (<100 ms).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+#: artifact naming convention: <GROUP>_r<NN>.json (bench.py archives)
+ROUND_RE = re.compile(r"^([A-Za-z0-9]+(?:_[A-Za-z0-9]+)*)_r(\d+)\.json$")
+
+#: numeric-leaf key suffixes that gate (all higher-is-better ratios/rates)
+GATED_SUFFIXES = ("speedup_vs_1dev", "tree_vs_direct", "gpairs_per_s",
+                  "equiv_gpairs_per_s", "members_per_s", "steps_per_s",
+                  "warm_speedup")
+
+#: per-group headline metrics for the trajectory table (dotted paths);
+#: groups not listed fall back to their first few gated metrics
+HEADLINES = {
+    "multichip": ["coupled_spmd.d2.speedup_vs_1dev",
+                  "coupled_spmd.d4.speedup_vs_1dev",
+                  "coupled_spmd.d8.speedup_vs_1dev",
+                  "matvec.d8.speedup_vs_1dev"],
+    "treecode": ["n65536.tree_vs_direct", "n16384.tree_vs_direct"],
+}
+
+
+def flatten(doc, prefix="") -> dict:
+    """Nested dict -> {dotted.path: number} over int/float leaves (bools
+    excluded — `downscaled` must not become a gated metric)."""
+    out: dict = {}
+    if not isinstance(doc, dict):
+        return out
+    for k, v in doc.items():
+        path = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[path] = float(v)
+        elif isinstance(v, dict):
+            out.update(flatten(v, path))
+    return out
+
+
+def gated_metrics(flat: dict) -> dict:
+    return {k: v for k, v in flat.items()
+            if k.rsplit(".", 1)[-1] in GATED_SUFFIXES
+            or any(k.endswith("." + s) for s in GATED_SUFFIXES)}
+
+
+class Round:
+    def __init__(self, group: str, number: int, path: str):
+        self.group = group
+        self.number = number
+        self.path = path
+        self.doc: dict = {}
+        self.error = None
+        try:
+            with open(path) as fh:
+                self.doc = json.load(fh)
+            if not isinstance(self.doc, dict):
+                raise ValueError("artifact is not a JSON object")
+        except Exception as e:
+            self.doc = {}
+            self.error = f"{type(e).__name__}: {e}"
+        self.flat = flatten(self.doc)
+        self.gated = gated_metrics(self.flat)
+
+    @property
+    def parseable(self) -> bool:
+        """A round carrying at least one gated metric — the r01–r05
+        timeout shells ({"rc": 124, "ok": false}) are not."""
+        return bool(self.gated)
+
+    @property
+    def downscaled(self) -> bool:
+        return bool(self.doc.get("downscaled"))
+
+    @property
+    def label(self) -> str:
+        return f"r{self.number:02d}"
+
+
+def scan_rounds(bench_dir: str) -> dict:
+    """{group: [Round sorted by number]} over ``<GROUP>_r<NN>.json``."""
+    groups: dict = {}
+    if not os.path.isdir(bench_dir):
+        raise FileNotFoundError(f"no such bench dir: {bench_dir!r}")
+    for fname in sorted(os.listdir(bench_dir)):
+        m = ROUND_RE.match(fname)
+        if not m:
+            continue
+        group = m.group(1).lower()
+        groups.setdefault(group, []).append(
+            Round(group, int(m.group(2)), os.path.join(bench_dir, fname)))
+    for rounds in groups.values():
+        rounds.sort(key=lambda r: r.number)
+    return groups
+
+
+def compare_rounds(prev: Round, cur: Round, gate_pct: float) -> list:
+    """[(metric, prev, cur, pct_change, regressed)] over the gated
+    metrics both rounds carry (higher is better for all of them)."""
+    out = []
+    for key in sorted(set(prev.gated) & set(cur.gated)):
+        a, b = prev.gated[key], cur.gated[key]
+        if a == 0:
+            continue
+        pct = (b - a) / abs(a) * 100.0
+        out.append((key, a, b, pct, pct < -gate_pct))
+    return out
+
+
+def render_report(bench_dir: str, gate_pct: float = 25.0):
+    """(report text, exit code): the `obs perf --compare` body.
+
+    Exit 1 iff a non-downscaled round pair regressed a gated metric by
+    more than ``gate_pct`` percent; 2 when the dir holds no rounds."""
+    groups = scan_rounds(bench_dir)
+    out: list = []
+    failures = 0
+    warnings = 0
+    if not groups:
+        return (f"no <GROUP>_rNN.json round artifacts under {bench_dir!r}\n",
+                2)
+    for group in sorted(groups):
+        rounds = groups[group]
+        out.append(f"== {group} trajectory ({len(rounds)} round(s)) ==")
+        headline = HEADLINES.get(group)
+        if headline is None:
+            parseable = [r for r in rounds if r.parseable]
+            headline = (sorted(parseable[-1].gated)[:4] if parseable
+                        else [])
+        def _hdr(h: str) -> str:
+            # "coupled_spmd.d8.speedup_vs_1dev" -> "coupled_spmd.d8": the
+            # gated suffix is implied, the component path disambiguates
+            for s in GATED_SUFFIXES:
+                if h.endswith("." + s):
+                    return h[:-(len(s) + 1)]
+            return h
+
+        rows = [("round",) + tuple(_hdr(h) for h in headline) + ("flags",)]
+        for r in rounds:
+            if not r.parseable:
+                rows.append((r.label,) + ("-",) * len(headline)
+                            + ("unparseable" if r.error else "incomplete",))
+                continue
+            vals = tuple("-" if r.flat.get(h) is None
+                         else f"{r.flat[h]:g}" for h in headline)
+            rows.append((r.label,) + vals
+                        + ("downscaled" if r.downscaled else "",))
+        widths = [max(len(row[i]) for row in rows)
+                  for i in range(len(rows[0]))]
+        out.extend("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+                   for row in rows)
+
+        parseable = [r for r in rounds if r.parseable]
+        if len(parseable) < 2:
+            out.append(f"({group}: <2 parseable rounds — nothing to diff)")
+            out.append("")
+            continue
+        prev, cur = parseable[-2], parseable[-1]
+        soft = prev.downscaled or cur.downscaled
+        out.append(f"diff {prev.label} -> {cur.label} "
+                   f"(gate {gate_pct:g}%"
+                   + (", downscaled rounds: warn-only)" if soft else ")"))
+        for key, a, b, pct, regressed in compare_rounds(prev, cur,
+                                                        gate_pct):
+            mark = ""
+            if regressed:
+                if soft:
+                    mark = "  WARN (downscaled — not gated)"
+                    warnings += 1
+                else:
+                    mark = "  REGRESSION"
+                    failures += 1
+            out.append(f"  {key}: {a:g} -> {b:g} ({pct:+.1f}%){mark}")
+        out.append("")
+    if failures:
+        out.append(f"skelly-pulse: {failures} gated regression(s) beyond "
+                   f"{gate_pct:g}% — fix the ladder or re-measure "
+                   "deliberately (docs/performance.md)")
+    elif warnings:
+        out.append(f"skelly-pulse: {warnings} downscaled-round warning(s); "
+                   "gate passes (CPU toy rounds never gate — re-measure "
+                   "on hardware)")
+    else:
+        out.append("skelly-pulse: bench history within gate")
+    return "\n".join(out) + "\n", (1 if failures else 0)
+
+
+def report_json(bench_dir: str, gate_pct: float = 25.0):
+    """(doc, exit code) — the machine-readable twin of `render_report`,
+    with the SAME exit-code contract (2 when the dir holds no rounds, 1 on
+    a gated non-downscaled regression): a CI job wired with ``--json``
+    must fail exactly when the text gate would."""
+    groups = scan_rounds(bench_dir)
+    doc: dict = {"gate_pct": gate_pct, "groups": {}}
+    failures = 0
+    for group, rounds in groups.items():
+        parseable = [r for r in rounds if r.parseable]
+        entry = {
+            "rounds": [r.label for r in rounds],
+            "parseable": [r.label for r in parseable],
+            "trajectory": {r.label: r.gated for r in parseable},
+        }
+        if len(parseable) >= 2:
+            prev, cur = parseable[-2], parseable[-1]
+            soft = prev.downscaled or cur.downscaled
+            metrics = [
+                {"metric": k, "prev": a, "cur": b,
+                 "pct": round(pct, 2), "regressed": reg}
+                for k, a, b, pct, reg in compare_rounds(prev, cur,
+                                                        gate_pct)]
+            entry["diff"] = {"from": prev.label, "to": cur.label,
+                             "downscaled": soft, "metrics": metrics}
+            if not soft:
+                failures += sum(1 for m in metrics if m["regressed"])
+        doc["groups"][group] = entry
+    rc = 2 if not doc["groups"] else (1 if failures else 0)
+    return doc, rc

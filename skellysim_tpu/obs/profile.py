@@ -1,0 +1,568 @@
+"""Device-time attribution from `jax.profiler` dumps (skelly-pulse).
+
+`--profile DIR` wraps a run in `jax.profiler.trace(DIR)`, which drops a
+TensorBoard profile bundle nobody in the tree could read until now: a
+Chrome trace-event JSON (`*.trace.json.gz` — per-op device execution
+events) and an XSpace protobuf (`*.xplane.pb`) that EMBEDS the optimized
+HLO of every profiled module. This module joins the two into per-phase
+device-time totals:
+
+* the trace events carry each executed op's wall time but only its HLO
+  instruction name (``dot.3``, ``fusion.17``);
+* the HLO proto's per-instruction ``metadata.op_name`` carries the
+  `jax.named_scope` path the tracing code declared
+  (``jit(step)/.../prep/dot_general``) — the hot pipeline threads the
+  phase vocabulary below through every layer (`system/system.py`,
+  `solver/gmres.py`, `parallel/spmd.py`, `parallel/ring.py`,
+  `ops/treecode.py`).
+
+Folding device op time onto the scope path gives the table ROADMAP item 2
+needs: where a d8 coupled solve actually spends its device time, with
+collectives split by kind (the same ``all_reduce``/``all_gather``/
+``collective_permute`` names the audit contracts pin).
+
+No protobuf dependency: the XSpace/HLO containers are walked with a
+~50-line protobuf wire-format reader over the handful of field numbers
+involved (`XSpace.planes` -> the ``/host:metadata`` plane ->
+``Hlo Proto`` stats -> `HloModuleProto.computations[].instructions[]`).
+Unknown fields are skipped by wire type, so schema growth degrades to
+missing metadata (reported as unattributed time), never a crash.
+
+jax-free on purpose (json/gzip/struct only): `obs profile` and
+`obs timeline` parse dumps without paying JAX backend init, like
+`obs summarize`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gzip
+import json
+import os
+from typing import Optional
+
+#: the named_scope phase vocabulary threaded through the hot pipeline.
+#: A scope-path component is a PHASE component iff it appears here —
+#: everything else in the op_name (jit(...) wrappers, transform scopes,
+#: op leaf names) is attribution noise. Grow this set together with the
+#: named_scope sites (docs/observability.md "Device-time attribution").
+PHASE_SCOPES = frozenset({
+    # System step phases (system/system.py, parallel/spmd.py)
+    "prep", "gmres", "precond", "refine", "advance",
+    # solver phases inside the Krylov loop (solver/gmres.py)
+    "arnoldi", "gram", "givens",
+    # SPMD collective phases (parallel/spmd.py, parallel/ring.py)
+    "ring-step", "allgather-density", "psum-dots",
+    # treecode traversal phases (ops/treecode.py)
+    "upward", "near", "far",
+    # in-trace auxiliaries: the device DI update (scenarios/di_device.py)
+    # and the jitted collision gate (system/system.py)
+    "dynamic-instability", "collision",
+})
+
+#: HLO collective opcode -> the audit contract's collective kind names
+#: (audit/checks.py collective-contract inventory)
+COLLECTIVE_KINDS = {
+    "all-reduce": "all_reduce",
+    "all-gather": "all_gather",
+    "collective-permute": "collective_permute",
+    "all-to-all": "all_to_all",
+    "reduce-scatter": "reduce_scatter",
+    "collective-broadcast": "collective_broadcast",
+}
+
+
+# --------------------------------------------------- protobuf wire reading
+
+def _read_varint(buf, i):
+    v = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, i
+        shift += 7
+
+
+def _fields(buf):
+    """One message level -> {field_number: [values]} (ints for varints,
+    bytes for length-delimited; fixed32/64 skipped). Returns None when the
+    buffer does not parse as a protobuf message."""
+    i, n = 0, len(buf)
+    out: dict = {}
+    try:
+        while i < n:
+            tag, i = _read_varint(buf, i)
+            fnum, wtype = tag >> 3, tag & 7
+            if fnum == 0 or fnum > 1 << 20:
+                return None
+            if wtype == 0:
+                v, i = _read_varint(buf, i)
+                out.setdefault(fnum, []).append(v)
+            elif wtype == 2:
+                ln, i = _read_varint(buf, i)
+                if ln < 0 or i + ln > n:
+                    return None
+                out.setdefault(fnum, []).append(bytes(buf[i:i + ln]))
+                i += ln
+            elif wtype == 5:
+                i += 4
+            elif wtype == 1:
+                i += 8
+            else:
+                return None
+    except IndexError:
+        return None
+    return out
+
+
+def _utf8(b) -> str:
+    try:
+        return b.decode("utf-8")
+    except (UnicodeDecodeError, AttributeError):
+        return ""
+
+
+def _module_op_names(hlo_module: bytes) -> dict:
+    """HloModuleProto bytes -> {instruction name: metadata.op_name}.
+
+    HloModuleProto.computations = field 3 (HloComputationProto),
+    HloComputationProto.instructions = field 2 (HloInstructionProto),
+    HloInstructionProto.name = field 1, .metadata = field 7 (OpMetadata),
+    OpMetadata.op_name = field 2 — the named_scope path."""
+    out: dict = {}
+    mod = _fields(hlo_module)
+    if not mod:
+        return out
+    for comp_b in mod.get(3, []):
+        comp = _fields(comp_b)
+        if not comp:
+            continue
+        for instr_b in comp.get(2, []):
+            instr = _fields(instr_b)
+            if not instr or 1 not in instr or 7 not in instr:
+                continue
+            name = _utf8(instr[1][0])
+            meta = _fields(instr[7][0])
+            if not name or not meta or 2 not in meta:
+                continue
+            op_name = _utf8(meta[2][0])
+            if op_name:
+                out[name] = op_name
+    return out
+
+
+def load_op_name_map(xplane_path: str) -> dict:
+    """{(module_name, instruction_name): scope path} from an xplane dump.
+
+    The profiler stores each profiled module's optimized `HloProto` as a
+    bytes stat (stat-metadata name ``Hlo Proto``) on the ``/host:metadata``
+    plane's event metadata; the event-metadata name is
+    ``module_name(program_id)``. Degrades to {} on any structural surprise
+    — callers then report the time as unattributed, never crash."""
+    with open(xplane_path, "rb") as fh:
+        space = _fields(fh.read())
+    out: dict = {}
+    if not space:
+        return out
+    for plane_b in space.get(1, []):
+        plane = _fields(plane_b)
+        if not plane:
+            continue
+        # find the "Hlo Proto" stat-metadata id for THIS plane
+        hlo_stat_ids = set()
+        for sm_entry in plane.get(5, []):
+            entry = _fields(sm_entry)
+            if not entry or 2 not in entry:
+                continue
+            meta = _fields(entry[2][0])
+            if meta and _utf8(meta.get(2, [b""])[0]) == "Hlo Proto":
+                hlo_stat_ids.add(meta.get(1, entry.get(1, [0]))[0])
+        if not hlo_stat_ids:
+            continue
+        for em_entry in plane.get(4, []):
+            entry = _fields(em_entry)
+            if not entry or 2 not in entry:
+                continue
+            emeta = _fields(entry[2][0])
+            if not emeta:
+                continue
+            # "jit_f(5)" -> "jit_f" (trace events carry the bare name)
+            mod_name = _utf8(emeta.get(2, [b""])[0]).rsplit("(", 1)[0]
+            for stat_b in emeta.get(5, []):
+                stat = _fields(stat_b)
+                if (not stat or stat.get(1, [None])[0] not in hlo_stat_ids
+                        or 6 not in stat):
+                    continue
+                hlo = _fields(stat[6][0])
+                if not hlo or 1 not in hlo:
+                    continue
+                for instr, op_name in _module_op_names(hlo[1][0]).items():
+                    out[(mod_name, instr)] = op_name
+    return out
+
+
+# ------------------------------------------------------- trace-event reading
+
+def find_profile_files(profile_dir: str):
+    """(trace_json_paths, xplane_paths) for the LATEST run under a
+    `jax.profiler.trace` dump dir (``DIR/plugins/profile/<ts>/``); a dir
+    already containing the files (or a run dir itself) works too."""
+    candidates = [profile_dir]
+    runs_root = os.path.join(profile_dir, "plugins", "profile")
+    if os.path.isdir(runs_root):
+        runs = sorted(d for d in os.listdir(runs_root)
+                      if os.path.isdir(os.path.join(runs_root, d)))
+        candidates = [os.path.join(runs_root, runs[-1])] if runs else []
+    for cand in candidates:
+        if not os.path.isdir(cand):
+            continue
+        names = sorted(os.listdir(cand))
+        traces = [os.path.join(cand, f) for f in names
+                  if f.endswith(".trace.json.gz")
+                  or f.endswith(".trace.json")]
+        xplanes = [os.path.join(cand, f) for f in names
+                   if f.endswith(".xplane.pb")]
+        if traces:
+            return traces, xplanes
+    return [], []
+
+
+def _load_trace_events(path: str) -> list:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        doc = json.load(fh)
+    return doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+
+
+def _self_times(events: list) -> list:
+    """Per-event SELF durations: each complete ("X") event's duration minus
+    its same-thread children's — so nested op events (fusions wrapping
+    sub-ops, while bodies re-reporting region ops) never double-count.
+    Returns [(event, self_dur_us)]."""
+    by_tid: dict = {}
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev or "ts" not in ev:
+            continue
+        by_tid.setdefault((ev.get("pid"), ev.get("tid")), []).append(ev)
+    out = []
+    for evs in by_tid.values():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: list = []   # (end_ts, child_sum_slot) — slot is a 1-elem list
+        for ev in evs:
+            ts, dur = ev["ts"], ev["dur"]
+            while stack and ts >= stack[-1][0] - 1e-9:
+                stack.pop()
+            if stack:
+                stack[-1][1][0] += dur
+            slot = [0.0]
+            stack.append((ts + dur, slot))
+            out.append((ev, slot))
+    return [(ev, max(ev["dur"] - slot[0], 0.0)) for ev, slot in out]
+
+
+def phase_of(op_name: str) -> Optional[str]:
+    """Slash-joined RECOGNIZED scope components of a metadata op_name, or
+    None — ``jit(step)/.../gmres/precond/dot_general`` -> ``gmres/precond``.
+    Dedupes immediate repeats (a scope re-entered per ring hop)."""
+    comps = []
+    for c in op_name.split("/"):
+        if c in PHASE_SCOPES and (not comps or comps[-1] != c):
+            comps.append(c)
+    return "/".join(comps) if comps else None
+
+
+def collective_kind(op_event_name: str) -> Optional[str]:
+    """``all-reduce.17`` -> ``all_reduce`` (audit-contract spelling).
+
+    Prefix-matches past the opcode so the TPU lowering's async pairs
+    (``all-reduce-start.N`` / ``all-reduce-done.N``) and fused collective
+    thunks (``all-reduce-fusion``) classify as their kind too — on real
+    chips EVERY collective is async, and missing them would file all comm
+    time under "(computation)"."""
+    base = op_event_name.split(".")[0].split(" ")[0]
+    for opcode, kind in COLLECTIVE_KINDS.items():
+        if base == opcode or base.startswith(opcode + "-"):
+            return kind
+    return None
+
+
+class DeviceTrace:
+    """Aggregated per-op device time from one profile dump.
+
+    ``rows`` is a list of dicts: op (instruction name), module, phase
+    (recognized scope path or None), collective (kind or None), scope (the
+    full metadata op_name when known), dur_us (summed SELF time), count.
+    ``events`` keeps the raw per-execution op events (ts/dur/self_us/
+    phase/...) for the timeline renderer.
+    """
+
+    def __init__(self, rows: list, events: list):
+        self.rows = rows
+        self.events = events
+
+    # ------------------------------------------------------------- totals
+
+    @property
+    def total_us(self) -> float:
+        return sum(r["dur_us"] for r in self.rows)
+
+    @property
+    def attributed_us(self) -> float:
+        return sum(r["dur_us"] for r in self.rows if r["phase"])
+
+    @property
+    def inferred_us(self) -> float:
+        return sum(r["dur_us"] for r in self.rows
+                   if r["phase"] and r.get("inferred"))
+
+    @property
+    def attributed_frac(self) -> float:
+        tot = self.total_us
+        return (self.attributed_us / tot) if tot > 0 else 0.0
+
+    def _group(self, key_fn) -> list:
+        groups: dict = {}
+        for r in self.rows:
+            key = key_fn(r)
+            g = groups.setdefault(key, {"dur_us": 0.0, "count": 0,
+                                        "collectives": {}})
+            g["dur_us"] += r["dur_us"]
+            g["count"] += r["count"]
+            if r["collective"]:
+                g["collectives"][r["collective"]] = (
+                    g["collectives"].get(r["collective"], 0.0) + r["dur_us"])
+        tot = self.total_us
+        out = []
+        for key, g in groups.items():
+            out.append({"key": key, "dur_us": round(g["dur_us"], 3),
+                        "count": g["count"],
+                        "share": (g["dur_us"] / tot) if tot > 0 else 0.0,
+                        "collectives": {k: round(v, 3) for k, v
+                                        in sorted(g["collectives"].items())}})
+        out.sort(key=lambda r: -r["dur_us"])
+        return out
+
+    def by_phase(self) -> list:
+        """Per-phase totals; unattributed time reported under the explicit
+        ``(unattributed)`` key, never hidden."""
+        return self._group(lambda r: r["phase"] or "(unattributed)")
+
+    def by_collective(self) -> list:
+        """Collectives by kind + one ``(computation)`` row for the rest —
+        the comm/compute split the CA-GMRES ladder work tunes against."""
+        return self._group(lambda r: r["collective"] or "(computation)")
+
+    def by_op(self) -> list:
+        return self._group(lambda r: f"{r['module']}/{r['op']}")
+
+
+def load_device_trace(profile_dir: str) -> DeviceTrace:
+    """Parse a `jax.profiler.trace` dump dir into a `DeviceTrace`.
+
+    Device op events are the trace events carrying an ``hlo_op``/
+    ``hlo_module`` arg (XLA executor events — host Python/runtime frames
+    never carry them); their scope paths come from the xplane-embedded
+    HLO metadata. Raises FileNotFoundError when the dir holds no trace."""
+    traces, xplanes = find_profile_files(profile_dir)
+    if not traces:
+        raise FileNotFoundError(
+            f"no *.trace.json(.gz) under {profile_dir!r} — is this a "
+            "`--profile DIR` dump (DIR/plugins/profile/<run>/)?")
+    op_names: dict = {}
+    for xp in xplanes:
+        try:
+            op_names.update(load_op_name_map(xp))
+        except Exception:
+            pass   # missing metadata -> unattributed time, reported as such
+
+    kept_events = []
+    for tpath in traces:
+        events = _load_trace_events(tpath)
+        for ev, self_us in _self_times(events):
+            args = ev.get("args") or {}
+            op = args.get("hlo_op")
+            module = args.get("hlo_module")
+            if not op and not module:
+                continue
+            op = op or ev.get("name", "?")
+            scope = (op_names.get((module, op))
+                     or op_names.get((module, ev.get("name", ""))) or "")
+            phase = phase_of(scope) if scope else None
+            coll = collective_kind(ev.get("name", "")) or collective_kind(op)
+            kept_events.append({
+                "name": ev.get("name", op), "op": op,
+                "module": module or "?", "ts": ev.get("ts", 0.0),
+                "dur": ev.get("dur", 0.0), "self_us": self_us,
+                "phase": phase, "inferred": False, "collective": coll,
+                "pid": ev.get("pid"), "tid": ev.get("tid")})
+    _infer_gap_phases(kept_events)
+
+    agg: dict = {}
+    for e in kept_events:
+        key = (e["module"], e["op"], e["phase"])
+        row = agg.setdefault(key, {
+            "op": e["op"], "module": e["module"], "phase": e["phase"],
+            "inferred": e["inferred"], "collective": e["collective"],
+            "scope": op_names.get((e["module"], e["op"]), ""),
+            "dur_us": 0.0, "count": 0})
+        row["dur_us"] += e["self_us"]
+        row["count"] += 1
+    rows = sorted(agg.values(), key=lambda r: -r["dur_us"])
+    for r in rows:
+        r["dur_us"] = round(r["dur_us"], 3)
+    return DeviceTrace(rows, kept_events)
+
+
+def _infer_gap_phases(events: list) -> None:
+    """Temporal-locality gap fill for metadata-less ops.
+
+    XLA optimization renames/expands instructions (fusions, the
+    triangular-solve while+dot expansion) whose names then miss the
+    xplane HLO's pre-optimization metadata. The device thread executes
+    serially in phase-contiguous segments, so an unmatched op whose
+    nearest metadata-attributed neighbors ON BOTH SIDES (same thread)
+    agree on a phase almost surely belongs to it: inherit, and mark the
+    event ``inferred`` so the table reports directly-attributed and
+    inferred shares separately (never silently)."""
+    by_tid: dict = {}
+    for e in events:
+        by_tid.setdefault((e["pid"], e["tid"]), []).append(e)
+    for evs in by_tid.values():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        # prev_phase[i]: phase of the nearest attributed event at or before i
+        n = len(evs)
+        prev_ph = [None] * n
+        last = None
+        for i, e in enumerate(evs):
+            if e["phase"]:
+                last = e["phase"]
+            prev_ph[i] = last
+        nxt = None
+        for i in range(n - 1, -1, -1):
+            e = evs[i]
+            if e["phase"]:
+                nxt = e["phase"]
+            elif prev_ph[i] is not None and prev_ph[i] == nxt:
+                e["phase"] = nxt
+                e["inferred"] = True
+
+
+# -------------------------------------------------------------- rendering
+
+def render_table(trace: DeviceTrace, by: str = "phase") -> str:
+    """The `obs profile` text report (docs/observability.md)."""
+    groups = {"phase": trace.by_phase, "collective": trace.by_collective,
+              "op": trace.by_op}[by]()
+    rows = [(by, "time_ms", "share", "ops", "collectives")]
+    for g in groups[:40]:
+        colls = "  ".join(f"{k}={v / 1e3:.3f}ms"
+                          for k, v in g["collectives"].items())
+        rows.append((str(g["key"]), f"{g['dur_us'] / 1e3:.3f}",
+                     f"{g['share']:.1%}", str(g["count"]), colls))
+    widths = [max(len(r[i]) for r in rows) for i in range(5)]
+    out = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+           for r in rows]
+    if len(groups) > 40:
+        out.append(f"... ({len(groups) - 40} more rows; --json for all)")
+    out.append("")
+    tot = trace.total_us
+    inf_frac = (trace.inferred_us / tot) if tot > 0 else 0.0
+    out.append(f"device op time: {tot / 1e3:.3f}ms over "
+               f"{sum(r['count'] for r in trace.rows)} op executions; "
+               f"{trace.attributed_frac:.1%} attributed to named phases "
+               f"({trace.attributed_frac - inf_frac:.1%} via HLO metadata, "
+               f"{inf_frac:.1%} inferred from phase-contiguous neighbors)")
+    return "\n".join(out) + "\n"
+
+
+def profile_json(trace: DeviceTrace) -> dict:
+    return {
+        "total_us": round(trace.total_us, 3),
+        "attributed_us": round(trace.attributed_us, 3),
+        "inferred_us": round(trace.inferred_us, 3),
+        "attributed_frac": round(trace.attributed_frac, 4),
+        "by_phase": trace.by_phase(),
+        "by_collective": trace.by_collective(),
+        "by_op": trace.by_op(),
+    }
+
+
+# ---------------------------------------------------------- capture context
+
+@contextlib.contextmanager
+def profile_session(profile_dir: str):
+    """Profiler capture tuned for device-time attribution.
+
+    `jax.profiler.trace` captures Python host frames too
+    (``python_tracer_level=1``); around a loop that COMPILES inside the
+    window, those frames flood the ~1M-event trace buffer and evict the
+    device op events this parser needs (observed: a 2-step `System.run`
+    produced 1,000,027 events with ZERO surviving ``hlo_op`` args). This
+    context creates the profiler session with the Python tracer OFF and
+    ``enable_hlo_proto`` on — host-side timing is the span tracer's job
+    (docs/observability.md), the profiler's is the device. Falls back to
+    plain `jax.profiler.trace` when the options API is unavailable.
+
+    jax imports stay inside the context so module import remains jax-free.
+    """
+    import jax
+
+    try:
+        from jax._src.lib import xla_client
+
+        import jax.extend.backend as jax_backend
+
+        jax_backend.get_backend()   # TPU tracer needs an initialized backend
+        opts = xla_client.profiler.ProfileOptions()
+        opts.python_tracer_level = 0
+        opts.enable_hlo_proto = True
+        sess = xla_client.profiler.ProfilerSession(opts)
+    except Exception:
+        with jax.profiler.trace(str(profile_dir)):
+            yield
+        return
+    try:
+        yield
+    finally:
+        sess.export(sess.stop(), str(profile_dir))
+
+
+# ------------------------------------------------- telemetry-stream bridge
+
+def device_phase_events(profile_dir: str) -> list:
+    """The ``device_phase`` telemetry records for a profile dump: one per
+    phase (incl. ``(unattributed)``) with ``dur_s``/``share``/``ops`` and
+    the per-kind collective split. Appended to the run's `--trace-file` by
+    the CLIs so `obs summarize` renders device time next to host spans."""
+    trace = load_device_trace(profile_dir)
+    out = []
+    for g in trace.by_phase():
+        out.append({"phase": g["key"], "dur_s": round(g["dur_us"] / 1e6, 6),
+                    "share": round(g["share"], 4), "ops": g["count"],
+                    "collectives": {k: round(v / 1e6, 6)
+                                    for k, v in g["collectives"].items()}})
+    return out
+
+
+def emit_device_phases(profile_dir: str, tracer=None) -> int:
+    """Parse ``profile_dir`` and emit one ``device_phase`` event per phase
+    into ``tracer`` (or the process-active tracer). Returns the number of
+    events emitted; swallows parse errors (a broken profiler dump must
+    never fail the run that produced it) but NOT tracer write errors."""
+    from . import tracer as obs_tracer
+
+    tr = tracer if tracer is not None else obs_tracer.active()
+    if tr is None:
+        return 0
+    try:
+        events = device_phase_events(profile_dir)
+    except Exception as e:
+        tr.emit("device_phase_error", error=f"{type(e).__name__}: {e}",
+                profile_dir=str(profile_dir))
+        return 0
+    for rec in events:
+        tr.emit("device_phase", **rec)
+    return len(events)

@@ -30,8 +30,14 @@ class Summary:
     """Accumulator over parsed JSONL records."""
 
     def __init__(self):
-        self.spans: dict[str, list[float]] = {}
+        #: span durations keyed (source stream id, path) — the source
+        #: column only renders when more than one file was ingested
+        self.spans: dict[tuple, list[float]] = {}
         self.compiles: list[dict] = []
+        #: `device_phase` records (skelly-pulse: the profiler dump folded
+        #: into the stream by the run CLIs — docs/observability.md
+        #: "Device-time attribution")
+        self.device_phases: list[dict] = []
         #: fault events by kind (`ev == "fault"` — solver health verdicts,
         #: lane quarantines, chaos injections, wire-frame rejects,
         #: fused-ring fallbacks; docs/robustness.md)
@@ -50,6 +56,10 @@ class Summary:
         #: restart at 0 per ensemble run, so wall dedupe must never merge
         #: round 0 of file A with round 0 of file B
         self._stream = 0
+        #: stream id -> display label (file basename, "#N"-deduped) for
+        #: the per-file provenance columns; direct `add_line` callers
+        #: (tests) land on stream 0 / label "-"
+        self.sources: dict[int, str] = {}
 
     # ------------------------------------------------------------- ingest
 
@@ -69,11 +79,14 @@ class Summary:
         if ev == "telemetry":
             self.versions.add(rec.get("version"))
         elif ev == "span":
-            self.spans.setdefault(rec.get("path") or rec.get("name", "?"),
-                                  []).append(float(rec.get("dur_s", 0.0)))
+            key = (self._stream, rec.get("path") or rec.get("name", "?"))
+            self.spans.setdefault(key, []).append(
+                float(rec.get("dur_s", 0.0)))
             # ensemble batched-step spans carry lane-occupancy fields
             if "live" in rec and "lanes" in rec:
-                self.lane_rounds.append(rec)
+                self.lane_rounds.append(dict(rec, _stream=self._stream))
+        elif ev == "device_phase":
+            self.device_phases.append(dict(rec, _stream=self._stream))
         elif ev == "compile":
             self.compiles.append(rec)
         elif ev == "fault":
@@ -95,10 +108,23 @@ class Summary:
                 self.steps.append(dict(rec, _stream=self._stream))
 
     def add_file(self, path: str):
+        import os
+
         self._stream += 1
+        label = os.path.basename(path) or path
+        if label in self.sources.values():
+            label = f"{label}#{self._stream}"
+        self.sources[self._stream] = label
         with open(path) as fh:
             for line in fh:
                 self.add_line(line)
+
+    def _label(self, stream: int) -> str:
+        return self.sources.get(stream, "-")
+
+    @property
+    def _multi_source(self) -> bool:
+        return len(self.sources) > 1
 
     # ------------------------------------------------------------- render
 
@@ -106,12 +132,47 @@ class Summary:
         if not self.spans:
             return
         out.append("== spans ==")
-        rows = [("span", "count", "total_s", "mean_s", "max_s")]
-        for path in sorted(self.spans):
-            durs = self.spans[path]
-            rows.append((path, str(len(durs)), _fmt_s(sum(durs)),
-                         _fmt_s(sum(durs) / len(durs)), _fmt_s(max(durs))))
-        widths = [max(len(r[i]) for r in rows) for i in range(5)]
+        # with several input files the span table carries per-file
+        # provenance (a serve run's multiple --trace-files used to
+        # interleave indistinguishably)
+        multi = self._multi_source
+        header = (("source",) if multi else ()) + (
+            "span", "count", "total_s", "mean_s", "max_s")
+        rows = [header]
+        for stream, path in sorted(self.spans,
+                                   key=lambda k: (k[1], self._label(k[0]))):
+            durs = self.spans[(stream, path)]
+            src = ((self._label(stream),) if multi else ())
+            rows.append(src + (path, str(len(durs)), _fmt_s(sum(durs)),
+                               _fmt_s(sum(durs) / len(durs)),
+                               _fmt_s(max(durs))))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(header))]
+        out.extend("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                   for r in rows)
+        out.append("")
+
+    def _device_phase_section(self, out: list[str]):
+        """Device time by phase (skelly-pulse): the profiler dump's
+        attribution table folded into the stream as `device_phase` events
+        — rendered next to the host spans so one summarize answers both
+        "where did the host wait" and "where did the device work"."""
+        if not self.device_phases:
+            return
+        out.append("== device time by phase ==")
+        multi = self._multi_source
+        header = (("source",) if multi else ()) + (
+            "phase", "time_s", "share", "ops", "collectives")
+        rows = [header]
+        for rec in sorted(self.device_phases,
+                          key=lambda r: -float(r.get("dur_s", 0.0))):
+            colls = "  ".join(f"{k}={float(v):.4f}s" for k, v in
+                              sorted((rec.get("collectives") or {}).items()))
+            src = ((self._label(rec.get("_stream", 0)),) if multi else ())
+            rows.append(src + (str(rec.get("phase", "?")),
+                               _fmt_s(float(rec.get("dur_s", 0.0))),
+                               f"{float(rec.get('share', 0.0)):.1%}",
+                               str(rec.get("ops", "?")), colls))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(header))]
         out.extend("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
                    for r in rows)
         out.append("")
@@ -167,11 +228,19 @@ class Summary:
             out.append("events: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(self.lane_events.items())))
         if self.lane_rounds:
-            live = [float(r["live"]) for r in self.lane_rounds]
-            lanes = max(float(r["lanes"]) for r in self.lane_rounds)
-            occ = sum(live) / (len(live) * lanes) if lanes else 0.0
-            out.append(f"rounds: {len(self.lane_rounds)}  lanes: "
-                       f"{int(lanes)}  mean occupancy: {occ:.1%}")
+            by_stream: dict = {}
+            for r in self.lane_rounds:
+                by_stream.setdefault(r.get("_stream", 0), []).append(r)
+            for stream in sorted(by_stream,
+                                 key=lambda s: self._label(s)):
+                rounds = by_stream[stream]
+                live = [float(r["live"]) for r in rounds]
+                lanes = max(float(r["lanes"]) for r in rounds)
+                occ = sum(live) / (len(live) * lanes) if lanes else 0.0
+                src = (f"[{self._label(stream)}] " if self._multi_source
+                       else "")
+                out.append(f"{src}rounds: {len(rounds)}  lanes: "
+                           f"{int(lanes)}  mean occupancy: {occ:.1%}")
         if self.queue_waits:
             w = self.queue_waits
             out.append(f"admission wait: mean {sum(w) / len(w):.4f}s  "
@@ -303,6 +372,7 @@ class Summary:
             out.append(f"telemetry version(s): {vs}")
             out.append("")
         self._span_section(out)
+        self._device_phase_section(out)
         self._compile_section(out)
         self._fault_section(out)
         self._lane_section(out)

@@ -52,6 +52,37 @@ from typing import Optional
 TELEMETRY_VERSION = 1
 
 
+def provenance(downscaled=None) -> dict:
+    """The self-description stamp timelines and bench artifacts share:
+    ``jax_version`` + ``device_kind`` (+ ``downscaled`` when the caller
+    states it) — skelly-pulse's answer to "which hardware/runtime
+    produced these numbers?" (bench artifacts used to hand-stamp
+    ``telemetry_version`` only).
+
+    jax-free-safe: consults ``sys.modules`` instead of importing — a
+    process that never imported jax (bench's parent) gets ``None``
+    placeholders rather than a backend init, and the tracer header stays
+    zero-cost in jax-free contexts. In a process whose backend is live
+    (every CLI/run/bench child), ``jax.devices()`` is already cached.
+    """
+    import sys
+
+    jax = sys.modules.get("jax")
+    info = {"jax_version": getattr(jax, "__version__", None)
+            if jax is not None else None}
+    kind = None
+    if jax is not None:
+        try:
+            devs = jax.devices()
+            kind = devs[0].device_kind if devs else None
+        except Exception:
+            kind = None
+    info["device_kind"] = kind
+    if downscaled is not None:
+        info["downscaled"] = bool(downscaled)
+    return info
+
+
 class _Span:
     """Mutable handle yielded by `Tracer.span`: attach fields / a sync tree."""
 
@@ -92,7 +123,10 @@ class Tracer:
             self._host = socket.gethostname()
         except Exception:
             self._host = "unknown"
-        self.emit("telemetry", version=TELEMETRY_VERSION)
+        # header carries the provenance stamp: a telemetry stream is
+        # self-describing about runtime + hardware (None placeholders in
+        # jax-free processes — provenance() never imports jax itself)
+        self.emit("telemetry", version=TELEMETRY_VERSION, **provenance())
 
     # ------------------------------------------------------------------ emit
 

@@ -529,7 +529,10 @@ def _tree_eval(plan: TreePlan, lo, r_src, payload, r_trg, near_fn, far_fn,
     C = payload.shape[-1]
     centers = _cell_centers(plan, lo, plan.depth, dtype)
     src_b, pay_b = _bucket(plan, lo, centers, r_src, payload)
-    proxy_pts, proxy_f = _upward(plan, lo, src_b, pay_b, dtype)
+    # "upward"/"near"/"far" device-time scopes (obs/profile.py): metadata
+    # only — op counts, accuracy, and the stokeslet_tree contract unchanged
+    with jax.named_scope("upward"):
+        proxy_pts, proxy_f = _upward(plan, lo, src_b, pay_b, dtype)
 
     nid_np, uniq_np = _neighbor_table(plan.depth)
     nid = jnp.asarray(nid_np)
@@ -554,8 +557,9 @@ def _tree_eval(plan: TreePlan, lo, r_src, payload, r_trg, near_fn, far_fn,
         return near_fn(t_pts, s_pts,
                        pay.reshape(t_pts.shape[0], 27 * mo, C))
 
-    u = _chunked_map(near_rows, (trg_s, leaf_s), n_trg,
-                     27 * mo * (3 + C)) * scale_near
+    with jax.named_scope("near"):
+        u = _chunked_map(near_rows, (trg_s, leaf_s), n_trg,
+                         27 * mo * (3 + C)) * scale_near
 
     def far_rows(t_pts, leaf):
         ids = ilist[leaf]                              # [B, maxI]
@@ -563,8 +567,9 @@ def _tree_eval(plan: TreePlan, lo, r_src, payload, r_trg, near_fn, far_fn,
         s_f = proxy_f[ids].reshape(t_pts.shape[0], maxI * p3, C)
         return far_fn(t_pts, s_pts, s_f)
 
-    u = u + _chunked_map(far_rows, (trg_s, leaf_s), n_trg,
-                         maxI * p3 * (3 + C)) * scale_far
+    with jax.named_scope("far"):
+        u = u + _chunked_map(far_rows, (trg_s, leaf_s), n_trg,
+                             maxI * p3 * (3 + C)) * scale_far
 
     out = jnp.zeros((n_trg, 3), dtype=dtype)
     return out.at[order].set(u)

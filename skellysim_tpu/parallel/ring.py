@@ -85,9 +85,13 @@ def _ring_accumulate(block_fn, axis_name: str, n_dev: int, u0, *rotating,
 
     def step(i, carry):
         u, rot = carry
-        nxt = jax.tree_util.tree_map(
-            lambda a: lax.ppermute(a, axis_name, perm), rot)
-        u = u + block_fn(*rot)
+        # "ring-step" device-time scope (obs/profile.py): one hop's
+        # ppermute + resident-block pair math — metadata only, the
+        # collective inventory contracts are unchanged
+        with jax.named_scope("ring-step"):
+            nxt = jax.tree_util.tree_map(
+                lambda a: lax.ppermute(a, axis_name, perm), rot)
+            u = u + block_fn(*rot)
         return u, nxt
 
     carry = (u0, tuple(rotating))
@@ -97,7 +101,8 @@ def _ring_accumulate(block_fn, axis_name: str, n_dev: int, u0, *rotating,
         u, rot = carry
     else:
         u, rot = lax.fori_loop(0, n_dev - 1, step, carry)
-    return u + block_fn(*rot)
+    with jax.named_scope("ring-step"):
+        return u + block_fn(*rot)
 
 
 def _pallas_interpret(impl: str) -> bool:

@@ -148,8 +148,11 @@ def _make_rdot(axis: str, nonrep_end: int) -> Callable:
     `psum` the sharded partial, add the replicated tail exactly once (it is
     bitwise identical on every shard, so no collective is needed for it)."""
     def rdot(A, w):
-        part = lax.psum(A[..., :nonrep_end] @ w[:nonrep_end], axis)
-        return part + A[..., nonrep_end:] @ w[nonrep_end:]
+        # "psum-dots" device-time scope (obs/profile.py): THE solver
+        # collective the s-step ladder exists to batch — metadata only
+        with jax.named_scope("psum-dots"):
+            part = lax.psum(A[..., :nonrep_end] @ w[:nonrep_end], axis)
+            return part + A[..., nonrep_end:] @ w[nonrep_end:]
     return rdot
 
 
@@ -499,7 +502,8 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
             if has_shell:
                 if sharded_shell:
                     v_shell = v_loc[nf_l:]
-                    x_full = lax.all_gather(x_shell, axis, tiled=True)
+                    with jax.named_scope("allgather-density"):
+                        x_full = lax.all_gather(x_shell, axis, tiled=True)
                     res.append(peri.matvec(f_state.shell,
                                            x_full.astype(lo_dtype),
                                            v_shell).astype(hi))
@@ -535,11 +539,18 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
         nf_l = sum(g.n_fibers * g.n_nodes for g in buckets)
 
         def precond(x):
+            # scoped like System._apply_precond: device time lands under
+            # gmres/arnoldi/precond in the obs profile table
+            with jax.named_scope("precond"):
+                return precond_impl(x)
+
+        def precond_impl(x):
             y_shell = None
             if has_shell:
                 x_shell = x[fib_size:fib_size + shell_size]
                 if sharded_shell:
-                    x_full = lax.all_gather(x_shell, axis, tiled=True)
+                    with jax.named_scope("allgather-density"):
+                        x_full = lax.all_gather(x_shell, axis, tiled=True)
                     shell = st.shell
                     y_shell = (shell.M_inv
                                @ x_full.astype(shell.M_inv.dtype)
@@ -613,17 +624,21 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
     # ------------------------------------------------------------ local step
 
     def local_step(st, anchors=None):
-        st, caches, body_caches, shell_rhs, body_rhs = prep(st, anchors)
-        buckets = fiber_buckets(st.fibers)
-        b_list = body_buckets(st.bodies)
-        fib_size, shell_size, _ = system._sizes(st)
+        # skelly-pulse phase scopes (obs/profile.py): metadata-only — the
+        # audited mesh programs (collective inventory, replication
+        # analysis, cost baselines) are byte-identical
+        with jax.named_scope("prep"):
+            st, caches, body_caches, shell_rhs, body_rhs = prep(st, anchors)
+            buckets = fiber_buckets(st.fibers)
+            b_list = body_buckets(st.bodies)
+            fib_size, shell_size, _ = system._sizes(st)
 
-        rhs_parts = [c.RHS.reshape(-1) for c in caches]
-        if shell_rhs is not None:
-            rhs_parts.append(shell_rhs)
-        for br in (body_rhs or []):
-            rhs_parts.append(br.reshape(-1))
-        rhs = jnp.concatenate(rhs_parts)
+            rhs_parts = [c.RHS.reshape(-1) for c in caches]
+            if shell_rhs is not None:
+                rhs_parts.append(shell_rhs)
+            for br in (body_rhs or []):
+                rhs_parts.append(br.reshape(-1))
+            rhs = jnp.concatenate(rhs_parts)
 
         nonrep_end = fib_size + (shell_size if sharded_shell else 0)
         rdot = _make_rdot(axis, nonrep_end)
@@ -631,72 +646,79 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
         krylov_pair = pair if has_pair else None
         if precision == "mixed":
             lo = _cast_floats((st, caches, body_caches), jnp.float32)
-            result = gmres_ir(
-                # hi residual matvec: dense regardless of the spec — the
-                # fast evaluator's tol must not cap residual_true
-                make_matvec(st, caches, body_caches, flow_impl=hi_impl),
-                make_matvec(st, caches, body_caches, lo=lo,
-                            pair_spec=krylov_pair, pair_anchors=anchors),
-                rhs,
-                precond_lo=make_precond(lo[0], lo[1], lo[2]),
-                tol=p.gmres_tol, inner_tol=p.inner_tol,
-                restart=p.gmres_restart, maxiter=p.gmres_maxiter,
-                max_refine=p.max_refine, rdot=rdot,
-                block_s=p.gmres_block_s)
+            with jax.named_scope("gmres"):
+                result = gmres_ir(
+                    # hi residual matvec: dense regardless of the spec —
+                    # the fast evaluator's tol must not cap residual_true
+                    make_matvec(st, caches, body_caches,
+                                flow_impl=hi_impl),
+                    make_matvec(st, caches, body_caches, lo=lo,
+                                pair_spec=krylov_pair,
+                                pair_anchors=anchors),
+                    rhs,
+                    precond_lo=make_precond(lo[0], lo[1], lo[2]),
+                    tol=p.gmres_tol, inner_tol=p.inner_tol,
+                    restart=p.gmres_restart, maxiter=p.gmres_maxiter,
+                    max_refine=p.max_refine, rdot=rdot,
+                    block_s=p.gmres_block_s)
         else:
-            result = gmres(
-                make_matvec(st, caches, body_caches, pair_spec=krylov_pair,
-                            pair_anchors=anchors), rhs,
-                precond=make_precond(st, caches, body_caches),
-                tol=p.gmres_tol, restart=p.gmres_restart,
-                maxiter=p.gmres_maxiter, rdot=rdot,
-                block_s=p.gmres_block_s)
+            with jax.named_scope("gmres"):
+                result = gmres(
+                    make_matvec(st, caches, body_caches,
+                                pair_spec=krylov_pair,
+                                pair_anchors=anchors), rhs,
+                    precond=make_precond(st, caches, body_caches),
+                    tol=p.gmres_tol, restart=p.gmres_restart,
+                    maxiter=p.gmres_maxiter, rdot=rdot,
+                    block_s=p.gmres_block_s)
 
         # ------------------------------------------------ advance components
-        new_state = st
-        off = 0
-        stepped = []
-        sol_fibs = []
-        for g in buckets:
-            size = fc.solution_size(g)
-            sol_fib = result.x[off:off + size].reshape(g.n_fibers, -1)
-            sol_fibs.append(sol_fib)
-            stepped.append(fc.step(g, sol_fib))
-            off += size
-        new_state = new_state._replace(
-            fibers=_rewrap_fibers(st.fibers, stepped))
-        sol_shell = None
-        if has_shell:
-            sol_shell = result.x[fib_size:fib_size + shell_size]
-            new_state = new_state._replace(shell=st.shell._replace(
-                density=sol_shell))
-        sol_body = None
-        if b_list:
-            off_b = fib_size + shell_size
-            sol_body = result.x[off_b:]
-            new_b = []
-            for g in b_list:
-                size = g.solution_size
-                sol_bod = result.x[off_b:off_b + size].reshape(g.n_bodies, -1)
-                new_b.append(bd.step(g, sol_bod, st.dt))
-                off_b += size
+        with jax.named_scope("advance"):
+            new_state = st
+            off = 0
+            stepped = []
+            sol_fibs = []
+            for g in buckets:
+                size = fc.solution_size(g)
+                sol_fib = result.x[off:off + size].reshape(g.n_fibers, -1)
+                sol_fibs.append(sol_fib)
+                stepped.append(fc.step(g, sol_fib))
+                off += size
             new_state = new_state._replace(
-                bodies=_rewrap_bodies(st.bodies, new_b))
-            # fibers re-pin to their (moved) nucleation sites — per-shard
-            # local fibers against the replicated moved bodies
-            nbt = bd.n_total(new_b)
-            repinned = list(fiber_buckets(new_state.fibers))
-            for gb in new_b:
-                _, _, new_sites = bd.place(gb)
-                repinned = [
-                    g._replace(x=bd.repin_to_bodies(
-                        bd.local_binding(g, gb, nbt), new_sites, gb).x)
-                    for g in repinned]
-            new_state = new_state._replace(
-                fibers=_rewrap_fibers(new_state.fibers, repinned))
-        err_local = jnp.max(jnp.stack(
-            [fc.fiber_error(g) for g in fiber_buckets(new_state.fibers)]))
-        fiber_error = lax.pmax(err_local, axis)
+                fibers=_rewrap_fibers(st.fibers, stepped))
+            sol_shell = None
+            if has_shell:
+                sol_shell = result.x[fib_size:fib_size + shell_size]
+                new_state = new_state._replace(shell=st.shell._replace(
+                    density=sol_shell))
+            sol_body = None
+            if b_list:
+                off_b = fib_size + shell_size
+                sol_body = result.x[off_b:]
+                new_b = []
+                for g in b_list:
+                    size = g.solution_size
+                    sol_bod = result.x[off_b:off_b + size].reshape(
+                        g.n_bodies, -1)
+                    new_b.append(bd.step(g, sol_bod, st.dt))
+                    off_b += size
+                new_state = new_state._replace(
+                    bodies=_rewrap_bodies(st.bodies, new_b))
+                # fibers re-pin to their (moved) nucleation sites —
+                # per-shard local fibers against the replicated moved bodies
+                nbt = bd.n_total(new_b)
+                repinned = list(fiber_buckets(new_state.fibers))
+                for gb in new_b:
+                    _, _, new_sites = bd.place(gb)
+                    repinned = [
+                        g._replace(x=bd.repin_to_bodies(
+                            bd.local_binding(g, gb, nbt), new_sites, gb).x)
+                        for g in repinned]
+                new_state = new_state._replace(
+                    fibers=_rewrap_fibers(new_state.fibers, repinned))
+            err_local = jnp.max(jnp.stack(
+                [fc.fiber_error(g) for g in fiber_buckets(new_state.fibers)]))
+            fiber_error = lax.pmax(err_local, axis)
 
         # the guard health word rides the mesh program too: the solver's
         # bits are replicated (psum'd reductions), the fiber-error check is
@@ -758,12 +780,13 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
         else:
             new_state, (sol_fibs, sol_shell, sol_body), info = sharded(st)
         if flat_solution:
-            parts = [s.reshape(-1) for s in sol_fibs]
-            if sol_shell is not None:
-                parts.append(sol_shell)
-            if sol_body is not None:
-                parts.append(sol_body)
-            solution = jnp.concatenate(parts)
+            with jax.named_scope("advance"):
+                parts = [s.reshape(-1) for s in sol_fibs]
+                if sol_shell is not None:
+                    parts.append(sol_shell)
+                if sol_body is not None:
+                    parts.append(sol_body)
+                solution = jnp.concatenate(parts)
         else:
             solution = SpmdSolution(fibers=tuple(sol_fibs), shell=sol_shell,
                                     bodies=sol_body)

@@ -184,7 +184,16 @@ def di_update(state, params, di_rng, *, sample_fn=None):
     mirroring the host path's numpy-f64 discipline, so f32 states see the
     same update the host loop would apply. On ``needs_growth`` every
     output equals its input (the member's round never happened).
+
+    Scoped ``dynamic-instability`` for device-time attribution
+    (obs/profile.py PHASE_SCOPES — metadata only, the ensemble_step
+    contract is unchanged).
     """
+    with jax.named_scope("dynamic-instability"):
+        return _di_update_impl(state, params, di_rng, sample_fn=sample_fn)
+
+
+def _di_update_impl(state, params, di_rng, *, sample_fn=None):
     di = params.dynamic_instability
     fibers = state.fibers
     # no validation HERE: this body runs at trace time, where the host-side

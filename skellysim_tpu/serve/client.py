@@ -100,6 +100,14 @@ class ServeClient:
     def stats(self) -> dict:
         return self._checked(protocol.make_request("stats"))["stats"]
 
+    def stats_prometheus(self) -> str:
+        """`/stats` rendered as a Prometheus text exposition page
+        (`protocol.render_prometheus`) — counters, fault/retire labels,
+        and the SLO latency histograms with cumulative ``le`` buckets.
+        Pair with the node-exporter textfile collector or any sidecar
+        scraper (docs/serving.md "SLO histograms")."""
+        return protocol.render_prometheus(self.stats())
+
     def chaos(self, action: str, tenant: Optional[str] = None) -> dict:
         """Fault injection (`guard.chaos`) — the server refuses unless its
         config sets ``[serve] chaos_enabled``."""
@@ -214,3 +222,34 @@ class SpawnedServer:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+def main(argv=None) -> int:
+    """Scraper-facing CLI: ``python -m skellysim_tpu.serve.client stats
+    [--prometheus]`` prints a running server's `/stats` as JSON or as the
+    Prometheus text page. jax-free (this module's import discipline), so
+    a metrics sidecar costs no backend init."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="python -m skellysim_tpu.serve.client",
+        description="skelly-serve client utility (docs/serving.md)")
+    ap.add_argument("command", choices=("stats",),
+                    help="request to perform")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--prometheus", action="store_true",
+                    help="render stats as Prometheus text exposition "
+                         "(GET /metrics-style) instead of JSON")
+    args = ap.parse_args(argv)
+    with ServeClient(host=args.host, port=args.port) as client:
+        if args.prometheus:
+            print(client.stats_prometheus(), end="")
+        else:
+            print(json.dumps(client.stats(), indent=1, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,11 +5,20 @@ reports is folded from the SAME telemetry events the tracer already emits
 (`obs.tracer` schema, docs/observability.md) — ``lane`` events carry
 admissions/backfills/retirements and the `queue_wait_s` admission latency,
 ``span`` events named ``ensemble_step`` carry per-round lane occupancy and
-wall time, ``compile`` events mark program (re)compiles. `StatsTracer` tees
-the stream: each event updates the in-memory `ServeMetrics` accumulator AND
-flows on to the ordinary tracer sink (JSONL file or in-memory list), so a
-`--trace-file` from a service run renders under ``obs summarize`` exactly
-like an ensemble sweep's.
+wall time, ``span`` events named ``stream_frames`` carry per-drain frame
+counts and stream latency, ``compile`` events mark program (re)compiles.
+`StatsTracer` tees the stream: each event updates the in-memory
+`ServeMetrics` accumulator AND flows on to the ordinary tracer sink (JSONL
+file or in-memory list), so a `--trace-file` from a service run renders
+under ``obs summarize`` exactly like an ensemble sweep's.
+
+SLO distributions (skelly-pulse, docs/serving.md "SLO histograms"): the
+three latency streams — admission wait, per-round batched-step wall,
+frame-stream drain — fold into fixed-bucket log-scale `obs.hist.
+LogHistogram`s, so `/stats` answers p50/p95/p99 under sustained traffic
+with BOUNDED memory (the pre-pulse ``queue_waits`` list grew per
+admission, forever), and ``render_prometheus`` in `serve.protocol` turns
+the same buckets into a scrape-able ``GET /metrics``-style text page.
 
 The one serving-specific counter the event stream cannot carry is
 ``compiles_after_warm``: the server calls `mark_warm()` once every
@@ -24,6 +33,16 @@ from __future__ import annotations
 from typing import Optional
 
 from ..obs import tracer as obs_tracer
+from ..obs.hist import LogHistogram
+
+#: the /stats SLO histogram inventory: name -> (lo, hi) seconds. One
+#: place, so the stats payload, the prometheus rendering, and the tests
+#: agree on the set (docs/serving.md).
+SLO_HISTOGRAMS = {
+    "admission_wait_s": (1e-4, 1e3),
+    "round_wall_s": (1e-4, 1e3),
+    "frame_stream_s": (1e-6, 1e2),
+}
 
 
 class ServeMetrics:
@@ -34,7 +53,6 @@ class ServeMetrics:
         self.retired = 0           # lanes freed, by reason
         self.retire_reasons: dict[str, int] = {}
         self.rejected = 0          # admission rejections (server increments)
-        self.queue_waits: list[float] = []
         self.rounds = 0            # batched ensemble_step rounds
         self.round_wall_s = 0.0
         self.occupancy_sum = 0.0   # sum of live/lanes per round
@@ -52,6 +70,10 @@ class ServeMetrics:
         self.loss_of_accuracy_steps = 0
         #: DI capacity-growth reseats (lane ``growth`` events)
         self.growth_reseats = 0
+        #: SLO latency distributions (skelly-pulse): fixed log buckets,
+        #: bounded memory under unbounded traffic
+        self.hists = {name: LogHistogram(lo, hi)
+                      for name, (lo, hi) in SLO_HISTOGRAMS.items()}
 
     # ------------------------------------------------------------ ingest
 
@@ -62,7 +84,8 @@ class ServeMetrics:
             if action in ("admit", "backfill"):
                 self.admitted += 1
                 if "queue_wait_s" in fields:
-                    self.queue_waits.append(float(fields["queue_wait_s"]))
+                    self.hists["admission_wait_s"].observe(
+                        float(fields["queue_wait_s"]))
             elif action == "retire":
                 self.retired += 1
                 reason = fields.get("reason", "finished")
@@ -75,12 +98,25 @@ class ServeMetrics:
                 self.growth_reseats += 1
         elif ev == "span" and fields.get("name") == "ensemble_step":
             self.rounds += 1
-            self.round_wall_s += float(fields.get("dur_s", 0.0))
+            dur = float(fields.get("dur_s", 0.0))
+            self.round_wall_s += dur
+            self.hists["round_wall_s"].observe(dur)
             live = fields.get("live")
             lanes = fields.get("lanes")
             if live is not None and lanes:
                 self.occupancy_sum += float(live) / float(lanes)
                 self.steps += int(live)
+        elif ev == "span" and fields.get("name") == "stream_frames":
+            # the `stream` request's drain span: frame accounting AND the
+            # frame-stream latency distribution from ONE event
+            n = int(fields.get("frames", 0))
+            tenant = fields.get("tenant")
+            if n and tenant:
+                self.frames_streamed[tenant] = (
+                    self.frames_streamed.get(tenant, 0) + n)
+            if n:
+                self.hists["frame_stream_s"].observe(
+                    float(fields.get("dur_s", 0.0)))
         elif ev == "compile":
             self.compiles += 1
             if self.warm:
@@ -94,11 +130,6 @@ class ServeMetrics:
         compile event means a warm-path retrace (SLO violation)."""
         self.warm = True
 
-    def note_frames_streamed(self, tenant_id: str, n: int):
-        if n:
-            self.frames_streamed[tenant_id] = (
-                self.frames_streamed.get(tenant_id, 0) + n)
-
     def note_rejected(self):
         self.rejected += 1
 
@@ -108,8 +139,12 @@ class ServeMetrics:
     # ------------------------------------------------------------ report
 
     def stats(self) -> dict:
-        """The `/stats` response body (also the shape tests pin)."""
-        w = self.queue_waits
+        """The `/stats` response body (also the shape tests pin).
+
+        The three SLO latency keys each carry
+        ``{n, mean, max, p50, p95, p99}`` (`LogHistogram.summary`);
+        ``histograms`` carries the full cumulative buckets for scrapers
+        (`serve.protocol.render_prometheus`)."""
         return {
             "admitted": self.admitted,
             "rejected": self.rejected,
@@ -122,11 +157,11 @@ class ServeMetrics:
             "round_wall_s": round(self.round_wall_s, 6),
             "mean_occupancy": (self.occupancy_sum / self.rounds
                                if self.rounds else 0.0),
-            "admission_wait_s": {
-                "n": len(w),
-                "mean": (sum(w) / len(w)) if w else 0.0,
-                "max": max(w) if w else 0.0,
-            },
+            "admission_wait_s": self.hists["admission_wait_s"].summary(),
+            "round_wall_s_hist": self.hists["round_wall_s"].summary(),
+            "frame_stream_s": self.hists["frame_stream_s"].summary(),
+            "histograms": {name: h.to_wire()
+                           for name, h in self.hists.items()},
             "compiles": self.compiles,
             "compiles_after_warm": self.compiles_after_warm,
             "warm": self.warm,

@@ -662,9 +662,15 @@ class SimulationServer:
         # None = drain everything; an explicit 0 drains NOTHING (a client
         # probing eof/pending must not lose frames to a falsy check)
         limit = len(t.frames) if limit is None else int(limit)
-        frames = [t.frames.popleft() for _ in range(min(limit, len(t.frames)))]
-        t.frames_streamed += len(frames)
-        self.metrics.note_frames_streamed(t.tenant_id, len(frames))
+        # the drain rides ONE `stream_frames` span: frames-streamed
+        # accounting AND the frame-stream latency histogram both fold from
+        # it in ServeMetrics.observe (no second bookkeeping path), and a
+        # service --trace-file shows per-tenant streaming under summarize
+        with obs_tracer.span("stream_frames", tenant=t.tenant_id) as sp:
+            frames = [t.frames.popleft()
+                      for _ in range(min(limit, len(t.frames)))]
+            t.frames_streamed += len(frames)
+            sp.note(frames=len(frames))
         eof = (t.status not in ("queued", "running")) and not t.frames
         return protocol.ok(tenant=t.tenant_id, frames=frames, eof=eof,
                            pending=len(t.frames))

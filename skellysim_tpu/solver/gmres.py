@@ -221,28 +221,33 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
 
         def body(state):
             k, V, H, cs, sn, g, done = state
-            w = matvec(M(V[k]))
-            w, h = _icgs(V, w, k, m, rdot)
-            h_norm = _norm(w)
-            h = h.at[k + 1].set(h_norm)
-            V = V.at[k + 1].set(w / jnp.where(h_norm > 0.0, h_norm, 1.0))
+            # skelly-pulse phase scopes (obs/profile.py): metadata-only —
+            # the compiled program, contracts, and baselines are unchanged
+            with jax.named_scope("arnoldi"):
+                w = matvec(M(V[k]))
+            with jax.named_scope("gram"):
+                w, h = _icgs(V, w, k, m, rdot)
+                h_norm = _norm(w)
+                h = h.at[k + 1].set(h_norm)
+                V = V.at[k + 1].set(w / jnp.where(h_norm > 0.0, h_norm, 1.0))
 
-            # apply accumulated Givens rotations to the new column
-            def rot(i, hcol):
-                hi, hip = hcol[i], hcol[i + 1]
-                return hcol.at[i].set(cs[i] * hi + sn[i] * hip).at[i + 1].set(-sn[i] * hi + cs[i] * hip)
+            with jax.named_scope("givens"):
+                # apply accumulated Givens rotations to the new column
+                def rot(i, hcol):
+                    hi, hip = hcol[i], hcol[i + 1]
+                    return hcol.at[i].set(cs[i] * hi + sn[i] * hip).at[i + 1].set(-sn[i] * hi + cs[i] * hip)
 
-            h = lax.fori_loop(0, k, rot, h)
-            # new rotation to zero h[k+1]
-            denom = jnp.sqrt(h[k] ** 2 + h[k + 1] ** 2)
-            denom_safe = jnp.where(denom > 0.0, denom, 1.0)
-            c_new = jnp.where(denom > 0.0, h[k] / denom_safe, 1.0)
-            s_new = jnp.where(denom > 0.0, h[k + 1] / denom_safe, 0.0)
-            h = h.at[k].set(denom).at[k + 1].set(0.0)
-            cs = cs.at[k].set(c_new)
-            sn = sn.at[k].set(s_new)
-            g = g.at[k + 1].set(-s_new * g[k]).at[k].set(c_new * g[k])
-            H = H.at[:, k].set(h)
+                h = lax.fori_loop(0, k, rot, h)
+                # new rotation to zero h[k+1]
+                denom = jnp.sqrt(h[k] ** 2 + h[k + 1] ** 2)
+                denom_safe = jnp.where(denom > 0.0, denom, 1.0)
+                c_new = jnp.where(denom > 0.0, h[k] / denom_safe, 1.0)
+                s_new = jnp.where(denom > 0.0, h[k + 1] / denom_safe, 0.0)
+                h = h.at[k].set(denom).at[k + 1].set(0.0)
+                cs = cs.at[k].set(c_new)
+                sn = sn.at[k].set(s_new)
+                g = g.at[k + 1].set(-s_new * g[k]).at[k].set(c_new * g[k])
+                H = H.at[:, k].set(h)
 
             done = jnp.abs(g[k + 1]) <= tol_abs
             return k + 1, V, H, cs, sn, g, done
@@ -318,35 +323,41 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
                 prev = jnp.where(j == 0, V[k], P[jnp.maximum(j - 1, 0)])
                 return P.at[j].set(matvec(M(prev)))
 
-            P = lax.fori_loop(0, s, gen, jnp.zeros((s, n), dtype=dtype))
+            with jax.named_scope("arnoldi"):
+                P = lax.fori_loop(0, s, gen, jnp.zeros((s, n), dtype=dtype))
 
-            # ---- BCGS + Cholesky-QR: first batched Gram (collective 1)
-            mask = (jnp.arange(m + 1, dtype=jnp.int32) <= k).astype(dtype)
-            Vm = V * mask[:, None]
-            G = rdot(jnp.concatenate([Vm, P], axis=0), P.T)
-            C1, S1 = G[:m + 1], G[m + 1:]
-            scale1 = rows * jnp.max(jnp.diagonal(S1))
-            W = P - C1.T @ Vm
-            L1 = _chol_ridge(S1 - C1.T @ C1, scale1)
-            Q1 = jax.scipy.linalg.solve_triangular(L1, W, lower=True)
+            with jax.named_scope("gram"):
+                # ---- BCGS + Cholesky-QR: first batched Gram (collective 1)
+                mask = (jnp.arange(m + 1,
+                                   dtype=jnp.int32) <= k).astype(dtype)
+                Vm = V * mask[:, None]
+                G = rdot(jnp.concatenate([Vm, P], axis=0), P.T)
+                C1, S1 = G[:m + 1], G[m + 1:]
+                scale1 = rows * jnp.max(jnp.diagonal(S1))
+                W = P - C1.T @ Vm
+                L1 = _chol_ridge(S1 - C1.T @ C1, scale1)
+                Q1 = jax.scipy.linalg.solve_triangular(L1, W, lower=True)
 
-            # ---- CGS2 re-orthogonalization: second batched Gram (coll. 2)
-            G2 = rdot(jnp.concatenate([Vm, Q1], axis=0), Q1.T)
-            C2, S2 = G2[:m + 1], G2[m + 1:]
-            W2 = Q1 - C2.T @ Vm
-            L2 = _chol_ridge(S2 - C2.T @ C2, rows * jnp.max(jnp.diagonal(S2)))
-            Q = jax.scipy.linalg.solve_triangular(L2, W2, lower=True)
+                # ---- CGS2 re-orthogonalization: second batched Gram
+                # (collective 2)
+                G2 = rdot(jnp.concatenate([Vm, Q1], axis=0), Q1.T)
+                C2, S2 = G2[:m + 1], G2[m + 1:]
+                W2 = Q1 - C2.T @ Vm
+                L2 = _chol_ridge(S2 - C2.T @ C2,
+                                 rows * jnp.max(jnp.diagonal(S2)))
+                Q = jax.scipy.linalg.solve_triangular(L2, W2, lower=True)
 
-            # effective change of basis over BOTH passes:
-            #   p_j = C[:, j] . V  +  sum_u Rm[u, j] q_u
-            C = C1 + C2 @ L1.T
-            Rm = (L1 @ L2).T                    # upper triangular [s, s]
-            # a fully converged/dependent candidate block can still leave
-            # NaN rows in Q (0/0 through the triangular solves); those rows
-            # are never ACCEPTED (col_ok below) but they must not poison V
-            # — a NaN row times a zero back-substitution weight is NaN
-            Q = jnp.where(jnp.isfinite(Q), Q, 0.0)
-            V = lax.dynamic_update_slice(V, Q, (k + 1, jnp.int32(0)))
+                # effective change of basis over BOTH passes:
+                #   p_j = C[:, j] . V  +  sum_u Rm[u, j] q_u
+                C = C1 + C2 @ L1.T
+                Rm = (L1 @ L2).T                # upper triangular [s, s]
+                # a fully converged/dependent candidate block can still
+                # leave NaN rows in Q (0/0 through the triangular solves);
+                # those rows are never ACCEPTED (col_ok below) but they
+                # must not poison V — a NaN row times a zero
+                # back-substitution weight is NaN
+                Q = jnp.where(jnp.isfinite(Q), Q, 0.0)
+                V = lax.dynamic_update_slice(V, Q, (k + 1, jnp.int32(0)))
             # breakdown floor for the recovered subdiagonals: below the
             # projected Gram's noise floor the computed q direction is
             # cancellation noise, not a Krylov direction — end the cycle
@@ -379,34 +390,36 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
 
             accepted = jnp.int32(0)
             prev_e = jnp.zeros(m + 1, dtype=dtype)
-            for t in range(s):       # static: s is small, no collectives
-                j = k + t
-                e_t = ecol(t)
-                if t == 0:
-                    hraw = e_t
-                    rdiag = jnp.asarray(1.0, dtype=dtype)   # no division
-                else:
-                    rdiag = prev_e[j]
-                    coef = prev_e.at[j].set(0.0)[:m]
-                    hraw = (e_t - Hr @ coef) / jnp.where(rdiag > tiny,
-                                                         rdiag, 1.0)
-                col_ok = jnp.isfinite(hraw).all() & (rdiag > tiny)
-                acc = ~done & col_ok
-                # a rejected column while the cycle was still live is the
-                # Cholesky-ridge breakdown the health word reports (the
-                # outer loop's explicit residual decides whether the solve
-                # still converged; the BREAKDOWN bit survives either way)
-                brk = brk | (~done & ~col_ok)
-                hrot, cs_n, sn_n, g_n = givens_col(j, hraw, cs, sn, g)
-                Hr = jnp.where(acc, Hr.at[:, j].set(hraw), Hr)
-                H = jnp.where(acc, H.at[:, j].set(hrot), H)
-                cs = jnp.where(acc, cs_n, cs)
-                sn = jnp.where(acc, sn_n, sn)
-                g = jnp.where(acc, g_n, g)
-                accepted = accepted + acc.astype(jnp.int32)
-                done = done | (~done & ~col_ok) \
-                    | (acc & (jnp.abs(g[j + 1]) <= tol_abs))
-                prev_e = e_t
+            with jax.named_scope("givens"):
+                for t in range(s):   # static: s is small, no collectives
+                    j = k + t
+                    e_t = ecol(t)
+                    if t == 0:
+                        hraw = e_t
+                        rdiag = jnp.asarray(1.0, dtype=dtype)  # no division
+                    else:
+                        rdiag = prev_e[j]
+                        coef = prev_e.at[j].set(0.0)[:m]
+                        hraw = (e_t - Hr @ coef) / jnp.where(rdiag > tiny,
+                                                             rdiag, 1.0)
+                    col_ok = jnp.isfinite(hraw).all() & (rdiag > tiny)
+                    acc = ~done & col_ok
+                    # a rejected column while the cycle was still live is
+                    # the Cholesky-ridge breakdown the health word reports
+                    # (the outer loop's explicit residual decides whether
+                    # the solve still converged; the BREAKDOWN bit survives
+                    # either way)
+                    brk = brk | (~done & ~col_ok)
+                    hrot, cs_n, sn_n, g_n = givens_col(j, hraw, cs, sn, g)
+                    Hr = jnp.where(acc, Hr.at[:, j].set(hraw), Hr)
+                    H = jnp.where(acc, H.at[:, j].set(hrot), H)
+                    cs = jnp.where(acc, cs_n, cs)
+                    sn = jnp.where(acc, sn_n, sn)
+                    g = jnp.where(acc, g_n, g)
+                    accepted = accepted + acc.astype(jnp.int32)
+                    done = done | (~done & ~col_ok) \
+                        | (acc & (jnp.abs(g[j + 1]) <= tol_abs))
+                    prev_e = e_t
             return k + accepted, V, Hr, H, cs, sn, g, brk, done
 
         k, V, Hr, H, cs, sn, g, brk, done = lax.while_loop(
@@ -558,8 +571,12 @@ def gmres_ir(matvec_hi: Callable, matvec_lo: Callable, b: jnp.ndarray, *,
                   restart=restart, maxiter=maxiter, rdot=rdot,
                   block_s=block_s)
         x = x + d.x
-        r = b - matvec_hi(x)
-        r_rel = _norm(r) / safe_b_norm
+        # the HIGH-precision residual matvec is the refinement sweep's
+        # dominant cost — scoped "refine" for device-time attribution
+        # (obs/profile.py; metadata only, the program is unchanged)
+        with jax.named_scope("refine"):
+            r = b - matvec_hi(x)
+            r_rel = _norm(r) / safe_b_norm
         # accumulate the inner solves' verdicts, plus a nonfinite check on
         # the f64 explicit residual (a poisoned correction shows up here
         # even when the f32 inner loop "converged"). The inner STAGNATION

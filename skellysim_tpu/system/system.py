@@ -729,7 +729,18 @@ class System:
         coupling that dominates clamped-fiber configs. One extra
         shell->fiber/body kernel evaluation per application (through the
         same `_shell_flow` evaluator seam as the matvec, so ring/Ewald
-        paths serve it too)."""
+        paths serve it too).
+
+        The whole application is scoped ``precond`` for device-time
+        attribution (obs/profile.py) — nested under whatever solver phase
+        invoked it (``gmres/arnoldi/precond`` in the Krylov loop)."""
+        with jax.named_scope("precond"):
+            return self._apply_precond_impl(state, caches, body_caches,
+                                            x_flat, pair=pair,
+                                            pair_anchors=pair_anchors)
+
+    def _apply_precond_impl(self, state: SimState, caches, body_caches,
+                            x_flat, pair=None, pair_anchors=None):
         buckets = fiber_buckets(state.fibers)
         fib_size, shell_size, body_size = self._sizes(state)
         nf_nodes, ns_nodes, nb_nodes = self._counts(state)
@@ -822,19 +833,23 @@ class System:
         the full-precision f64 operator instead of the configured ones."""
         p = self.params
         bs = p.gmres_block_s if block_s is None else block_s
-        state, caches, body_caches, shell_rhs, body_rhs = self._prep(
-            state, pair=pair, pair_anchors=pair_anchors)
+        # skelly-pulse phase scopes (obs/profile.py PHASE_SCOPES): pure HLO
+        # metadata — op counts, dtypes, collectives, retraces all unchanged,
+        # so every audit contract and cost baseline stays byte-identical
+        with jax.named_scope("prep"):
+            state, caches, body_caches, shell_rhs, body_rhs = self._prep(
+                state, pair=pair, pair_anchors=pair_anchors)
 
-        rhs_parts = []
-        for c in (caches or []):
-            rhs_parts.append(c.RHS.reshape(-1))
-        if shell_rhs is not None:
-            rhs_parts.append(shell_rhs)
-        for br in (body_rhs or []):
-            rhs_parts.append(br.reshape(-1))
-        if not rhs_parts:
-            raise ValueError("state has no implicit components to solve")
-        rhs = jnp.concatenate(rhs_parts)
+            rhs_parts = []
+            for c in (caches or []):
+                rhs_parts.append(c.RHS.reshape(-1))
+            if shell_rhs is not None:
+                rhs_parts.append(shell_rhs)
+            for br in (body_rhs or []):
+                rhs_parts.append(br.reshape(-1))
+            if not rhs_parts:
+                raise ValueError("state has no implicit components to solve")
+            rhs = jnp.concatenate(rhs_parts)
 
         precision = "full" if force_full else self._precision_for(state)
         if precision == "mixed":
@@ -847,82 +862,90 @@ class System:
             # accelerators); state must be f64 for the df split to pay off
             hi_impl = (self._refine_impl
                        if state.time.dtype == jnp.float64 else p.kernel_impl)
-            result = gmres_ir(
-                # hi residual matvec: dense (no ewald plan) regardless of the
-                # refinement tile — ewald_tol must not cap residual_true
-                lambda v: self._apply_matvec(state, caches, body_caches, v,
-                                             flow_impl=hi_impl),
-                lambda v: self._apply_matvec(state, caches, body_caches, v,
-                                             lo=lo, pair=pair,
-                                             pair_anchors=pair_anchors),
-                rhs,
-                precond_lo=lambda v: self._apply_precond(
-                    lo[0], lo[1], lo[2], v, pair=pair,
-                    pair_anchors=pair_anchors),
-                tol=p.gmres_tol, inner_tol=p.inner_tol,
-                restart=p.gmres_restart, maxiter=p.gmres_maxiter,
-                max_refine=p.max_refine, history=p.gmres_history,
-                block_s=bs)
+            with jax.named_scope("gmres"):
+                result = gmres_ir(
+                    # hi residual matvec: dense (no ewald plan) regardless
+                    # of the refinement tile — ewald_tol must not cap
+                    # residual_true
+                    lambda v: self._apply_matvec(state, caches, body_caches,
+                                                 v, flow_impl=hi_impl),
+                    lambda v: self._apply_matvec(state, caches, body_caches,
+                                                 v, lo=lo, pair=pair,
+                                                 pair_anchors=pair_anchors),
+                    rhs,
+                    precond_lo=lambda v: self._apply_precond(
+                        lo[0], lo[1], lo[2], v, pair=pair,
+                        pair_anchors=pair_anchors),
+                    tol=p.gmres_tol, inner_tol=p.inner_tol,
+                    restart=p.gmres_restart, maxiter=p.gmres_maxiter,
+                    max_refine=p.max_refine, history=p.gmres_history,
+                    block_s=bs)
         else:
-            result = gmres(
-                lambda v: self._apply_matvec(state, caches, body_caches, v,
-                                             pair=pair,
-                                             pair_anchors=pair_anchors),
-                rhs,
-                precond=lambda v: self._apply_precond(
-                    state, caches, body_caches, v, pair=pair,
-                    pair_anchors=pair_anchors),
-                tol=p.gmres_tol, restart=p.gmres_restart,
-                maxiter=p.gmres_maxiter, history=p.gmres_history,
-                block_s=bs)
+            with jax.named_scope("gmres"):
+                result = gmres(
+                    lambda v: self._apply_matvec(state, caches, body_caches,
+                                                 v, pair=pair,
+                                                 pair_anchors=pair_anchors),
+                    rhs,
+                    precond=lambda v: self._apply_precond(
+                        state, caches, body_caches, v, pair=pair,
+                        pair_anchors=pair_anchors),
+                    tol=p.gmres_tol, restart=p.gmres_restart,
+                    maxiter=p.gmres_maxiter, history=p.gmres_history,
+                    block_s=bs)
 
-        fib_size, shell_size, body_size = self._sizes(state)
-        new_state = state
-        fiber_error = jnp.asarray(0.0, dtype=rhs.dtype)
-        buckets = fiber_buckets(state.fibers)
-        if buckets:
-            off = 0
-            stepped = []
-            for g in buckets:
-                size = fc.solution_size(g)
-                sol_fib = result.x[off:off + size].reshape(g.n_fibers, -1)
-                stepped.append(fc.step(g, sol_fib))
-                off += size
-            new_state = new_state._replace(
-                fibers=_rewrap_fibers(state.fibers, stepped))
-        if state.shell is not None:
-            new_state = new_state._replace(shell=state.shell._replace(
-                density=result.x[fib_size:fib_size + shell_size]))
-        b_list = body_buckets(state.bodies)
-        if b_list:
-            off_b = fib_size + shell_size
-            new_b = []
-            for g in b_list:
-                size = g.solution_size
-                sol_bod = result.x[off_b:off_b + size].reshape(g.n_bodies, -1)
-                new_b.append(bd.step(g, sol_bod, state.dt))
-                off_b += size
-            new_state = new_state._replace(
-                bodies=_rewrap_bodies(state.bodies, new_b))
+        with jax.named_scope("advance"):
+            fib_size, shell_size, body_size = self._sizes(state)
+            new_state = state
+            fiber_error = jnp.asarray(0.0, dtype=rhs.dtype)
+            buckets = fiber_buckets(state.fibers)
             if buckets:
-                # fibers re-pin to their (moved) nucleation sites
-                # (`system.cpp:488`, `repin_to_bodies`); applied per body
-                # bucket with global->local binding remaps — a fiber is
-                # bound to at most one bucket, so the moves compose
-                nbt = bd.n_total(new_b)
-                repinned = list(fiber_buckets(new_state.fibers))
-                for gb in new_b:
-                    _, _, new_sites = bd.place(gb)
-                    repinned = [
-                        g._replace(x=bd.repin_to_bodies(
-                            bd.local_binding(g, gb, nbt), new_sites, gb).x)
-                        for g in repinned]
+                off = 0
+                stepped = []
+                for g in buckets:
+                    size = fc.solution_size(g)
+                    sol_fib = result.x[off:off + size].reshape(g.n_fibers,
+                                                               -1)
+                    stepped.append(fc.step(g, sol_fib))
+                    off += size
                 new_state = new_state._replace(
-                    fibers=_rewrap_fibers(new_state.fibers, repinned))
-        if buckets:
-            fiber_error = jnp.max(jnp.stack(
-                [fc.fiber_error(g)
-                 for g in fiber_buckets(new_state.fibers)]))
+                    fibers=_rewrap_fibers(state.fibers, stepped))
+            if state.shell is not None:
+                new_state = new_state._replace(shell=state.shell._replace(
+                    density=result.x[fib_size:fib_size + shell_size]))
+            b_list = body_buckets(state.bodies)
+            if b_list:
+                off_b = fib_size + shell_size
+                new_b = []
+                for g in b_list:
+                    size = g.solution_size
+                    sol_bod = result.x[off_b:off_b + size].reshape(
+                        g.n_bodies, -1)
+                    new_b.append(bd.step(g, sol_bod, state.dt))
+                    off_b += size
+                new_state = new_state._replace(
+                    bodies=_rewrap_bodies(state.bodies, new_b))
+                if buckets:
+                    # fibers re-pin to their (moved) nucleation sites
+                    # (`system.cpp:488`, `repin_to_bodies`); applied per
+                    # body bucket with global->local binding remaps — a
+                    # fiber is bound to at most one bucket, so the moves
+                    # compose
+                    nbt = bd.n_total(new_b)
+                    repinned = list(fiber_buckets(new_state.fibers))
+                    for gb in new_b:
+                        _, _, new_sites = bd.place(gb)
+                        repinned = [
+                            g._replace(x=bd.repin_to_bodies(
+                                bd.local_binding(g, gb, nbt), new_sites,
+                                gb).x)
+                            for g in repinned]
+                    new_state = new_state._replace(
+                        fibers=_rewrap_fibers(new_state.fibers, repinned))
+            if buckets:
+                fiber_error = jnp.max(jnp.stack(
+                    [fc.fiber_error(g)
+                     for g in fiber_buckets(new_state.fibers)]))
 
         # the packed health word (guard.verdict): the solver's own bits,
         # plus a nonfinite check on the post-advance fiber error — a
@@ -1061,7 +1084,14 @@ class System:
                              pair_anchors=anchors)
 
     def _check_collision(self, state: SimState):
-        """Fiber/shell + body collision gate (`check_collision`, `system.cpp:576-595`)."""
+        """Fiber/shell + body collision gate (`check_collision`, `system.cpp:576-595`).
+
+        Scoped ``collision`` for device-time attribution
+        (obs/profile.py PHASE_SCOPES — metadata only)."""
+        with jax.named_scope("collision"):
+            return self._check_collision_impl(state)
+
+    def _check_collision_impl(self, state: SimState):
         collided = jnp.asarray(False)
         if state.bodies is not None:
             collided = collided | bd.check_collision_pairwise_multi(
@@ -1290,18 +1320,35 @@ class System:
         metrics_fh = open(metrics_path, "a") if metrics_path else None
         # XLA/TPU profiler capture of the whole loop (the structured upgrade
         # over the reference's omp_get_wtime logging, SURVEY.md §5.1); open
-        # with TensorBoard or xprof
-        prof = (jax.profiler.trace(profile_dir) if profile_dir is not None
-                else contextlib.nullcontext())
+        # with TensorBoard/xprof, `obs profile DIR`, or `obs timeline`.
+        # obs.profile.profile_session keeps the Python tracer OFF so the
+        # device op events survive the trace buffer (span telemetry covers
+        # the host side)
+        if profile_dir is not None:
+            from ..obs.profile import profile_session
+
+            prof = profile_session(profile_dir)
+        else:
+            prof = contextlib.nullcontext()
         tracer = obs_tracer.Tracer(trace_path) if trace_path else None
         scope = (obs_tracer.use(tracer) if tracer is not None
                  else contextlib.nullcontext())
         try:
-            with prof, scope:
-                with obs_tracer.span("run", t_final=self.params.t_final):
-                    state = self._run_loop(state, writer=writer,
-                                           max_steps=max_steps, rng=rng,
-                                           metrics_fh=metrics_fh)
+            with scope:
+                with prof:
+                    with obs_tracer.span("run", t_final=self.params.t_final):
+                        state = self._run_loop(state, writer=writer,
+                                               max_steps=max_steps, rng=rng,
+                                               metrics_fh=metrics_fh)
+                if profile_dir is not None:
+                    # fold the dump into the SAME telemetry stream: one
+                    # `device_phase` event per attributed phase, so `obs
+                    # summarize` prints device time next to the host spans
+                    # and the profile dir stops being write-only dead
+                    # weight (docs/observability.md)
+                    from ..obs.profile import emit_device_phases
+
+                    emit_device_phases(profile_dir, tracer)
         finally:
             if tracer is not None:
                 tracer.close()

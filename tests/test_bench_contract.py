@@ -64,6 +64,11 @@ def test_bench_prints_one_parseable_json_line(tmp_path):
         assert doc["telemetry_version"] == TELEMETRY_VERSION
         # CPU-forced run must be flagged, never silently downscaled
         assert doc["extra"].get("downscaled") is True
+        # provenance stamp (skelly-pulse): artifacts self-describe the
+        # runtime + hardware via obs.tracer.provenance — the same keys
+        # the telemetry header carries
+        assert doc["extra"].get("jax_version"), doc["extra"].keys()
+        assert doc["extra"].get("device_kind"), doc["extra"].keys()
         # the mirror artifact parses identically
         with open(BENCH_JSON) as fh:
             assert json.load(fh)["metric"] == doc["metric"]

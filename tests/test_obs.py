@@ -528,3 +528,416 @@ def test_summarize_dedupes_shared_round_wall(tmp_path):
     p2 = str(tmp_path / "ens2.jsonl")
     shutil.copy(p, p2)
     assert "batched-round wall: total 0.040s" in summarize_files([p, p2])
+
+
+# ----------------------------------------------- skelly-pulse: profile dumps
+
+import os
+
+PROFILE_FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "golden", "profile_fixture")
+
+
+def test_profile_fixture_phase_attribution():
+    """Phase-table parsing on the checked-in miniature trace-event fixture
+    (a 2-virtual-device shard_map program using the real phase vocabulary;
+    no TPU, no profiling at test time)."""
+    from skellysim_tpu.obs import profile as profile_mod
+
+    trace = profile_mod.load_device_trace(PROFILE_FIXTURE)
+    assert trace.total_us > 0
+    phases = {g["key"]: g for g in trace.by_phase()}
+    for key in ("prep", "gmres/arnoldi", "gmres/psum-dots", "advance"):
+        assert key in phases, sorted(phases)
+    # the fixture's psum lands as an all_reduce, split out by kind under
+    # the audit contract's spelling
+    assert "all_reduce" in phases["gmres/psum-dots"]["collectives"]
+    kinds = {g["key"] for g in trace.by_collective()}
+    assert "all_reduce" in kinds and "(computation)" in kinds
+    # >= 90% attributed, unattributed reported (not hidden)
+    assert trace.attributed_frac >= 0.9
+    assert "(unattributed)" in phases or trace.attributed_frac == 1.0
+    # shares are a partition of the total
+    assert sum(g["share"] for g in trace.by_phase()) == pytest.approx(1.0)
+
+
+def test_profile_render_and_json():
+    from skellysim_tpu.obs import profile as profile_mod
+
+    trace = profile_mod.load_device_trace(PROFILE_FIXTURE)
+    table = profile_mod.render_table(trace, by="phase")
+    assert "attributed to named phases" in table
+    assert "gmres/psum-dots" in table
+    doc = profile_mod.profile_json(trace)
+    assert doc["total_us"] > 0
+    assert {"by_phase", "by_collective", "by_op"} <= set(doc)
+
+
+def test_profile_cli(tmp_path, capsys):
+    from skellysim_tpu.obs.cli import main
+
+    assert main(["profile", PROFILE_FIXTURE]) == 0
+    assert "prep" in capsys.readouterr().out
+    assert main(["profile", PROFILE_FIXTURE, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["attributed_frac"] >= 0.9
+    assert main(["profile", PROFILE_FIXTURE, "--by", "collective"]) == 0
+    capsys.readouterr()
+    assert main(["profile", str(tmp_path / "nope")]) == 2
+
+
+def test_phase_of_and_collective_kind():
+    from skellysim_tpu.obs.profile import collective_kind, phase_of
+
+    assert phase_of("jit(step)/jit(main)/prep/dot_general") == "prep"
+    assert phase_of("jit(step)/gmres/jit(gmres)/arnoldi/precond/mul") \
+        == "gmres/arnoldi/precond"
+    # immediate repeats dedupe (scopes re-entered per ring hop)
+    assert phase_of("a/ring-step/ring-step/b") == "ring-step"
+    assert phase_of("jit(f)/jit(main)/transpose/mul") is None
+    assert collective_kind("all-reduce.17") == "all_reduce"
+    assert collective_kind("all-gather") == "all_gather"
+    assert collective_kind("collective-permute.3") == "collective_permute"
+    # the TPU lowering's async pairs + fused thunks classify too
+    assert collective_kind("all-reduce-start.5") == "all_reduce"
+    assert collective_kind("all-gather-done.2") == "all_gather"
+    assert collective_kind("all-reduce-fusion") == "all_reduce"
+    assert collective_kind("dot.3") is None
+    assert collective_kind("reduce-scatter-start") == "reduce_scatter"
+
+
+def test_device_phase_events_and_emit(tmp_path):
+    """`device_phase` telemetry records from a dump, emitted into a tracer
+    (the --profile auto-append workflow) and rendered by summarize."""
+    from skellysim_tpu.obs import profile as profile_mod
+    from skellysim_tpu.obs.summarize import summarize_files
+
+    recs = profile_mod.device_phase_events(PROFILE_FIXTURE)
+    assert any(r["phase"] == "gmres/psum-dots" for r in recs)
+    assert all(r["dur_s"] >= 0.0 and "share" in r for r in recs)
+
+    tr = Tracer(str(tmp_path / "t.jsonl"))
+    n = profile_mod.emit_device_phases(PROFILE_FIXTURE, tr)
+    tr.close()
+    assert n == len(recs) > 0
+    report = summarize_files([str(tmp_path / "t.jsonl")])
+    assert "== device time by phase ==" in report
+    assert "gmres/psum-dots" in report
+    # a broken dump emits a device_phase_error event, never raises
+    tr2 = Tracer()
+    assert profile_mod.emit_device_phases(str(tmp_path), tr2) == 0
+    assert [e["ev"] for e in tr2.events[1:]] == ["device_phase_error"]
+
+
+@pytest.mark.slow
+def test_d2_spmd_profile_attribution(tmp_path):
+    """Acceptance pin (ISSUE 14): `obs profile` on a CPU-run profile dir
+    of the d2 SPMD coupled solve attributes >= 90% of device op time to a
+    named phase, with collective kinds split out matching the audit
+    contract inventory. Slow-marked: one d2 mesh compile."""
+    import numpy as np
+
+    from skellysim_tpu.audit import fixtures
+    from skellysim_tpu.obs import profile as profile_mod
+    from skellysim_tpu.parallel.mesh import make_mesh
+
+    system = fixtures.make_system(shell=True)
+    state = fixtures.coupled_state(system)
+    mesh = make_mesh(2)
+    _, sol, _ = system.step_spmd(state, mesh, donate=False)
+    np.asarray(sol)   # compile + drain outside the capture window
+    prof_dir = str(tmp_path / "prof_d2")
+    with profile_mod.profile_session(prof_dir):
+        _, sol, _ = system.step_spmd(state, mesh, donate=False)
+        np.asarray(sol)
+    trace = profile_mod.load_device_trace(prof_dir)
+    assert trace.attributed_frac >= 0.9, profile_mod.render_table(trace)
+    kinds = {g["key"] for g in trace.by_collective()}
+    # the audit contract inventory of the SPMD step: psum'd dots/flows,
+    # the density all-gather, the ppermute source rings
+    assert {"all_reduce", "all_gather", "collective_permute"} <= kinds
+    phases = {g["key"] for g in trace.by_phase()}
+    assert {"prep", "gmres/arnoldi", "advance"} <= phases
+
+
+# ------------------------------------------------ skelly-pulse: timeline
+
+def test_timeline_roundtrip(tmp_path):
+    """Emit spans -> perfetto JSON -> re-parse -> the same span tree
+    (names + nesting by slice containment), with compile instants and
+    process/thread metadata."""
+    from skellysim_tpu.obs.timeline import HOST_PID, write_timeline
+
+    path = str(tmp_path / "trace.jsonl")
+    tr = Tracer(path)
+    with obs_tracer.use(tr):
+        with obs_tracer.span("run"):
+            with obs_tracer.span("step", step=0):
+                with obs_tracer.span("write_frame"):
+                    pass
+            with obs_tracer.span("step", step=1):
+                pass
+        tr.emit("compile", name="system.solve", wall_s=1.0, trace_s=0.5,
+                traces=1)
+        tr.emit("lane", action="admit", lane=0, member="m0")
+    tr.close()
+
+    out = str(tmp_path / "tl.json")
+    counts = write_timeline([path], out)
+    assert counts["host_slices"] == 4
+    assert counts["instants"] == 2  # compile + lane
+
+    doc = json.load(open(out))
+    evs = doc["traceEvents"]
+    procs = [e for e in evs if e.get("ph") == "M"
+             and e.get("name") == "process_name"]
+    assert any(e["args"]["name"] == "host telemetry" for e in procs)
+    slices = sorted((e for e in evs if e.get("ph") == "X"
+                     and e["pid"] == HOST_PID), key=lambda e: e["ts"])
+    assert [s["name"] for s in slices] == ["run", "step", "write_frame",
+                                           "step"]
+
+    def contains(a, b):   # slice a covers slice b (small float slack)
+        return (a["ts"] <= b["ts"] + 1e-6
+                and a["ts"] + a["dur"] >= b["ts"] + b["dur"] - 1e-6)
+
+    run, s0, wf, s1 = slices
+    assert contains(run, s0) and contains(run, s1) and contains(s0, wf)
+    assert not contains(s0, s1) and not contains(s1, s0)
+    assert s1["args"]["step"] == 1
+    (compile_i,) = [e for e in evs if e.get("ph") == "i"
+                    and e["name"].startswith("compile ")]
+    assert compile_i["args"]["wall_s"] == 1.0
+    assert any(e.get("ph") == "i" and e["name"] == "lane:admit"
+               for e in evs)
+
+
+def test_timeline_with_device_track(tmp_path):
+    from skellysim_tpu.obs.timeline import DEVICE_PID, write_timeline
+
+    path = str(tmp_path / "trace.jsonl")
+    tr = Tracer(path)
+    with tr.span("step"):
+        pass
+    tr.close()
+    out = str(tmp_path / "tl.json")
+    counts = write_timeline([path], out, profile_dir=PROFILE_FIXTURE)
+    assert counts["device_slices"] > 0
+    doc = json.load(open(out))
+    evs = doc["traceEvents"]
+    dev_threads = {e["args"]["name"] for e in evs
+                   if e.get("ph") == "M" and e.get("name") == "thread_name"
+                   and e.get("pid") == DEVICE_PID}
+    # multi-device-thread profiles suffix "[dev k]" per source thread
+    # (per-tid slices must nest — overlapping same-phase slices from two
+    # devices on one tid would render wrong in Perfetto)
+    assert any(n == "gmres/psum-dots" or n.startswith("gmres/psum-dots [")
+               for n in dev_threads), dev_threads
+    # host and device tracks are separate processes
+    procs = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert {"host telemetry", "device (profiler)"} <= procs
+
+
+def test_timeline_cli(tmp_path, capsys):
+    from skellysim_tpu.obs.cli import main
+
+    path = str(tmp_path / "trace.jsonl")
+    tr = Tracer(path)
+    with tr.span("a"):
+        pass
+    tr.close()
+    out = str(tmp_path / "out.json")
+    assert main(["timeline", path, "-o", out]) == 0
+    assert json.load(open(out))["traceEvents"]
+    capsys.readouterr()
+    assert main(["timeline", str(tmp_path / "nope.jsonl"),
+                 "-o", out]) == 2
+
+
+# ----------------------------------------------- skelly-pulse: histograms
+
+def test_log_histogram_percentiles_vs_numpy():
+    """Percentile math against a numpy oracle on synthetic lognormal
+    latencies: the geometric-interpolation estimate must sit within one
+    bucket ratio of the true quantile."""
+    from skellysim_tpu.obs.hist import LogHistogram
+
+    rng = np.random.default_rng(42)
+    vals = np.exp(rng.normal(np.log(0.05), 1.2, size=50000))
+    h = LogHistogram(lo=1e-4, hi=1e3, per_decade=8)
+    for v in vals:
+        h.observe(v)
+    ratio = 10.0 ** (1.0 / 8)   # one bucket edge step
+    for q in (50.0, 90.0, 95.0, 99.0):
+        est = h.percentile(q)
+        true = float(np.percentile(vals, q))
+        assert true / ratio <= est <= true * ratio, (q, est, true)
+    s = h.summary()
+    assert s["n"] == len(vals)
+    assert s["mean"] == pytest.approx(float(vals.mean()))
+    assert s["max"] == pytest.approx(float(vals.max()))
+    assert s["p50"] <= s["p95"] <= s["p99"]
+
+
+def test_log_histogram_edges_and_wire():
+    from skellysim_tpu.obs.hist import (LogHistogram,
+                                        render_prometheus_histogram)
+
+    h = LogHistogram(lo=1e-3, hi=10.0, per_decade=4)
+    assert h.summary() == {"n": 0, "mean": 0.0, "max": 0.0, "p50": 0.0,
+                           "p95": 0.0, "p99": 0.0}
+    for v in (0.0, 1e-5, 0.02, 0.02, 5.0, 1e9, float("nan")):
+        h.observe(v)
+    assert h.n == 7
+    wire = h.to_wire()
+    # cumulative buckets are monotone and terminate at +Inf == n
+    counts = [c for _, c in wire["buckets"]]
+    assert counts == sorted(counts)
+    assert wire["buckets"][-1] == ["+Inf", 7] or \
+        wire["buckets"][-1] == ("+Inf", 7)
+    lines = render_prometheus_histogram("x_seconds", wire, help_text="t")
+    assert lines[0] == "# HELP x_seconds t"
+    assert lines[1] == "# TYPE x_seconds histogram"
+    assert lines[-2] .startswith("x_seconds_sum ")
+    assert lines[-1] == "x_seconds_count 7"
+    assert any('le="+Inf"} 7' in ln for ln in lines)
+    with pytest.raises(ValueError):
+        LogHistogram(lo=1.0, hi=0.5)
+
+
+# ------------------------------------------------ skelly-pulse: perf gate
+
+def _write_round(dirpath, group, number, doc):
+    p = os.path.join(str(dirpath), f"{group}_r{number:02d}.json")
+    with open(p, "w") as fh:
+        json.dump(doc, fh)
+    return p
+
+
+def test_perf_compare_gate_on_synthetic_rounds(tmp_path):
+    from skellysim_tpu.obs.perf import render_report
+
+    _write_round(tmp_path, "GROUPX", 1,
+                 {"solve": {"d8": {"speedup_vs_1dev": 2.0}},
+                  "rate": {"gpairs_per_s": 1.0}})
+    _write_round(tmp_path, "GROUPX", 2,
+                 {"solve": {"d8": {"speedup_vs_1dev": 1.0}},
+                  "rate": {"gpairs_per_s": 1.05}})
+    report, rc = render_report(str(tmp_path), gate_pct=25.0)
+    assert rc == 1
+    assert "REGRESSION" in report and "-50.0%" in report
+    # within the gate: passes
+    report, rc = render_report(str(tmp_path), gate_pct=60.0)
+    assert rc == 0 and "within gate" in report
+
+
+def test_perf_compare_downscaled_rounds_warn_only(tmp_path):
+    from skellysim_tpu.obs.perf import render_report
+
+    _write_round(tmp_path, "TOY", 1, {"m": {"speedup_vs_1dev": 4.0}})
+    _write_round(tmp_path, "TOY", 2, {"m": {"speedup_vs_1dev": 1.0},
+                                      "downscaled": True})
+    report, rc = render_report(str(tmp_path), gate_pct=25.0)
+    assert rc == 0
+    assert "WARN (downscaled" in report
+
+
+def test_perf_compare_skips_unparseable_rounds(tmp_path):
+    """The r01-r05 failure shells ({"rc": 124}) render as incomplete and
+    the diff picks the latest two PARSEABLE rounds."""
+    from skellysim_tpu.obs.perf import render_report, scan_rounds
+
+    _write_round(tmp_path, "G", 1, {"rc": 124, "ok": False})
+    _write_round(tmp_path, "G", 2, {"m": {"speedup_vs_1dev": 1.0}})
+    _write_round(tmp_path, "G", 3, {"m": {"speedup_vs_1dev": 2.0}})
+    rounds = scan_rounds(str(tmp_path))["g"]
+    assert [r.parseable for r in rounds] == [False, True, True]
+    report, rc = render_report(str(tmp_path), gate_pct=25.0)
+    assert rc == 0
+    assert "incomplete" in report
+    assert "diff r02 -> r03" in report
+    # a single parseable round: trajectory only, nothing to diff
+    two = tmp_path / "single"
+    two.mkdir()
+    _write_round(two, "G", 1, {"m": {"speedup_vs_1dev": 1.0}})
+    report, rc = render_report(str(two), gate_pct=25.0)
+    assert rc == 0 and "nothing to diff" in report
+
+
+def test_perf_real_benchmarks_trajectory():
+    """Acceptance pin: `obs perf --compare benchmarks/` renders the
+    r01..r07 multichip trajectory and the gate passes on the checked-in
+    (downscaled) rounds."""
+    from skellysim_tpu.obs.perf import render_report
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report, rc = render_report(os.path.join(repo, "benchmarks"))
+    assert rc == 0
+    assert "== multichip trajectory (7 round(s)) ==" in report
+    for label in ("r01", "r06", "r07"):
+        assert label in report
+    assert "diff r06 -> r07" in report
+    assert "coupled_spmd.d8.speedup_vs_1dev: 0.25 -> 0.44" in report
+
+
+def test_perf_cli_exit_codes(tmp_path, capsys):
+    from skellysim_tpu.obs.cli import main
+
+    _write_round(tmp_path, "G", 1, {"m": {"speedup_vs_1dev": 2.0}})
+    _write_round(tmp_path, "G", 2, {"m": {"speedup_vs_1dev": 1.0}})
+    assert main(["perf", "--compare", str(tmp_path)]) == 1
+    assert main(["perf", "--compare", str(tmp_path), "--gate", "60"]) == 0
+    capsys.readouterr()  # drain the text reports before the JSON one
+    assert main(["perf", "--compare", str(tmp_path), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["groups"]["g"]["diff"]["metrics"][0]["regressed"]
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["perf", "--compare", str(empty)]) == 2
+    assert main(["perf", "--compare", str(tmp_path / "nope")]) == 2
+    assert main(["perf"]) == 2
+
+
+# ------------------------------- skelly-pulse: provenance + summarize extras
+
+def test_tracer_header_carries_provenance():
+    """The telemetry header self-describes runtime + hardware (jax is
+    imported in this process, so real values, not placeholders)."""
+    from skellysim_tpu.obs.tracer import provenance
+
+    tr = Tracer()
+    header = tr.events[0]
+    assert header["ev"] == "telemetry"
+    assert header["jax_version"] == jax.__version__
+    assert header["device_kind"]  # "cpu" on the test platform
+    assert provenance(downscaled=True)["downscaled"] is True
+    assert "downscaled" not in provenance()
+
+
+def test_summarize_multifile_source_columns(tmp_path):
+    """Several --trace-files summarize with per-file provenance on the
+    span and lane-occupancy tables; a single file keeps the old layout."""
+    from skellysim_tpu.obs.summarize import summarize_files
+
+    def write(name, rounds):
+        p = str(tmp_path / name)
+        tr = Tracer(p)
+        for i in range(rounds):
+            with tr.span("ensemble_step", round=i, live=2, lanes=4):
+                pass
+        tr.close()
+        return p
+
+    a = write("serve_a.jsonl", 2)
+    b = write("serve_b.jsonl", 3)
+    single = summarize_files([a])
+    assert "source" not in single.split("== spans ==")[1].splitlines()[1]
+    assert "rounds: 2  lanes: 4" in single
+
+    multi = summarize_files([a, b])
+    span_header = multi.split("== spans ==")[1].splitlines()[1]
+    assert span_header.startswith("source")
+    assert "serve_a.jsonl" in multi and "serve_b.jsonl" in multi
+    assert "[serve_a.jsonl] rounds: 2" in multi
+    assert "[serve_b.jsonl] rounds: 3" in multi

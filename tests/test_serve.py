@@ -365,12 +365,28 @@ def test_stats_shape_and_stream_accounting(server):
                 "rounds", "steps", "steps_per_s", "mean_occupancy",
                 "admission_wait_s", "compiles", "compiles_after_warm",
                 "warm", "frames_streamed", "frames_streamed_total",
-                "tenants", "buckets"):
+                "tenants", "buckets",
+                # skelly-pulse SLO histograms (docs/serving.md)
+                "round_wall_s_hist", "frame_stream_s", "histograms"):
         assert key in stats, key
     assert stats["warm"] is True
     assert stats["buckets"][0]["lanes"] == 2
     assert stats["frames_streamed_total"] >= 3
     assert stats["admission_wait_s"]["n"] == stats["admitted"]
+    # percentile read-out from the folded events, ordered as percentiles
+    for key in ("admission_wait_s", "round_wall_s_hist", "frame_stream_s"):
+        slo = stats[key]
+        assert slo["p50"] <= slo["p95"] <= slo["p99"], (key, slo)
+    assert stats["round_wall_s_hist"]["n"] == stats["rounds"] > 0
+    assert stats["frame_stream_s"]["n"] >= 1
+    # the prometheus text page renders from the same payload
+    from skellysim_tpu.serve import protocol
+
+    prom = protocol.render_prometheus(stats)
+    assert "skellysim_serve_round_wall_seconds_bucket" in prom
+    assert 'le="+Inf"' in prom
+    assert prom.strip().splitlines()[-1].startswith(
+        "skellysim_serve_frame_stream_seconds_count")
 
 
 def test_unknown_tenant_and_malformed_requests(server):
@@ -801,6 +817,22 @@ def test_socket_end_to_end(tmp_path):
                 assert len(frames) >= 2
             stats = c.stats()
             assert stats["compiles_after_warm"] == 0
+            # skelly-pulse SLO histograms, folded from REAL events over
+            # the wire: admission wait + round wall distributions report
+            # percentiles, and the prometheus rendering carries them
+            for key in ("admission_wait_s", "round_wall_s_hist",
+                        "frame_stream_s"):
+                slo = stats[key]
+                for q in ("p50", "p95", "p99"):
+                    assert q in slo, (key, slo)
+                assert slo["p50"] <= slo["p95"] <= slo["p99"]
+            assert stats["admission_wait_s"]["n"] == stats["admitted"] == 2
+            assert stats["round_wall_s_hist"]["n"] == stats["rounds"] > 0
+            assert stats["frame_stream_s"]["n"] >= 2  # one drain per tenant
+            prom = c.stats_prometheus()
+            assert "skellysim_serve_admission_wait_seconds_bucket" in prom
+            assert 'le="+Inf"' in prom
+            assert "skellysim_serve_compiles_after_warm_total 0" in prom
         rc = srv.stop()
     assert rc == 0
 
