@@ -184,6 +184,12 @@ class Params:
     guard_dt_halvings: int = 0
     guard_block_fallback: bool = False
     guard_f64_fallback: bool = False
+    # skelly-flight physics flight recorder: device-side [K, 13] ring of
+    # per-step diagnostics (strain/speed/clearance/norms/health) with
+    # nonfinite anomaly provenance (offender field/fiber/node); 0 = off
+    # (see skellysim_tpu/params.py `flight_window` and
+    # docs/observability.md "Flight recorder")
+    flight_window: int = 0
     fiber_error_tol: float = 0.1
     seed: int = 130319
     implicit_motor_activation_delay: float = 0.0
@@ -842,6 +848,7 @@ def to_runtime_params(p: Params) -> runtime_params.Params:
         guard_dt_halvings=p.guard_dt_halvings,
         guard_block_fallback=p.guard_block_fallback,
         guard_f64_fallback=p.guard_f64_fallback,
+        flight_window=p.flight_window,
         fiber_error_tol=p.fiber_error_tol,
         seed=p.seed,
         implicit_motor_activation_delay=p.implicit_motor_activation_delay,

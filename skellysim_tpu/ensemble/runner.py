@@ -244,7 +244,10 @@ class EnsembleRunner:
         per member) seeds the [B, 3] ``di_rng`` stream carry — rng-less
         lanes (idle templates) carry a zero stream that never advances
         (frozen/idle lanes do not draw)."""
-        states = list(states)
+        # normalize the flight-recorder ring (skelly-flight) so every
+        # member shares the template's pytree structure — snapshot-decoded
+        # states carry no ring (the wire never does)
+        states = [self.system.ensure_flight(s) for s in states]
         stacked = stack_states(states)
         t_final = jnp.asarray(list(t_finals), dtype=jnp.float64)
         if t_final.shape != (stacked.time.shape[0],):
@@ -377,6 +380,16 @@ class EnsembleRunner:
         reject = running & ~accept & ~dt_underflow & ~failed & ~growth
 
         merged = _where_lanes(advance, new_states, states)
+        if states.flight is not None:
+            # the flight ring advances for every lane that RAN a trial —
+            # including rejected, underflowed, and quarantined ones: the
+            # fatal row is the recorder's whole point, and freezing it
+            # with the physics rollback would discard exactly the evidence
+            # the provenance report needs. Growth-frozen lanes never ran
+            # (their round re-runs at the next rung), so they keep their
+            # ring untouched, like their RNG counter.
+            merged = merged._replace(flight=_where_lanes(
+                running & ~growth, new_states.flight, states.flight))
         t_new64 = states.time.astype(jnp.float64) + dt64
         time_out = jnp.where(advance, t_new64.astype(states.time.dtype),
                              states.time)

@@ -26,6 +26,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..guard import verdict as _verdict
+from ..obs import flight as flight_mod
 from ..obs import tracer as obs_tracer
 from ..solver.gmres import history_rows
 from ..system.system import SimState, crossed_write_boundary
@@ -182,6 +183,9 @@ class EnsembleScheduler:
         return spec.rng.dump_state() if spec.rng is not None else None
 
     def _start_member(self, lane: int, spec: MemberSpec):
+        # snapshot-decoded states carry no flight-recorder ring (the wire
+        # never does) — normalize to the lanes' armed/stripped structure
+        spec.state = self.runner.system.ensure_flight(spec.state)
         if self.runner.di_enabled and spec.rng is None:
             raise ValueError(
                 f"member {spec.member_id}: dynamic-instability members need "
@@ -350,6 +354,12 @@ class EnsembleScheduler:
                                  "active_fibers", "needs_growth")}
             hist = (np.asarray(info.history)
                     if info.history is not None else None)
+            # skelly-flight: the per-member recorder rings ride the stacked
+            # state ([B, K, 13] + [B] counts) — one fetch serves the step
+            # records, the failure payloads, and the telemetry events
+            fl = self.ens.states.flight
+            flight_rows = np.asarray(fl.rows) if fl is not None else None
+            flight_counts = np.asarray(fl.count) if fl is not None else None
             wall_s = _time.perf_counter() - wall0
         self.rounds += 1
         if self.runner.di_enabled:
@@ -377,6 +387,9 @@ class EnsembleScheduler:
             health = int(fetched["health"][lane])
             dt_used = float(fetched["dt_used"][lane])
             t_new = float(fetched["t"][lane])
+            flight_row = (flight_mod.last_row(flight_rows[lane],
+                                              flight_counts[lane])
+                          if flight_rows is not None else None)
             if bool(fetched["needs_growth"][lane]):
                 # the member's nucleation burst outgrew this capacity
                 # bucket: the runner froze the lane un-advanced (state and
@@ -403,11 +416,22 @@ class EnsembleScheduler:
                 # terminal health verdict: the runner froze the lane
                 # un-advanced (quarantine — siblings bitwise-unaffected);
                 # retire it as "failed" with the decoded verdict, or
-                # mirror the sequential loop's abort
+                # mirror the sequential loop's abort. The flight
+                # recorder's last-window tail + anomaly provenance ride
+                # the failure record and the fault event (obs.flight —
+                # "who and where" next to "something died").
                 verdict_s = _verdict.describe(health)
+                payload = (flight_mod.failure_payload(
+                    flight_rows[lane], flight_counts[lane])
+                    if flight_rows is not None else None)
+                prov = (payload or {}).get("provenance") or {}
+                prov_fields = ({"prov_field": prov.get("field"),
+                                "prov_fiber": prov.get("fiber"),
+                                "prov_node": prov.get("node")}
+                               if prov else {})
                 obs_tracer.emit("fault", kind="lane_failed", lane=lane,
                                 member=ln.spec.member_id, health=health,
-                                verdict=verdict_s, t=ln.t)
+                                verdict=verdict_s, t=ln.t, **prov_fields)
                 if self.on_failure == "raise":
                     raise RuntimeError(
                         f"ensemble member {ln.spec.member_id}: terminal "
@@ -415,7 +439,8 @@ class EnsembleScheduler:
                         f"(health={health:#x}) at t={ln.t:.6g}")
                 self._retire_member(lane, reason="failed",
                                     extra={"health": health,
-                                           "verdict": verdict_s})
+                                           "verdict": verdict_s,
+                                           "flight": payload})
                 continue
             if underflow:
                 # the sequential loop raises before writing this trial's
@@ -431,7 +456,13 @@ class EnsembleScheduler:
                 self._retire_member(lane, reason="dt_underflow",
                                     extra={"health": health,
                                            "verdict":
-                                               _verdict.describe(health)})
+                                               _verdict.describe(health),
+                                           "flight": (
+                                               flight_mod.failure_payload(
+                                                   flight_rows[lane],
+                                                   flight_counts[lane])
+                                               if flight_rows is not None
+                                               else None)})
                 continue
             ln.steps += 1
             self._emit({
@@ -456,7 +487,13 @@ class EnsembleScheduler:
                 "wall_ms": round(wall_s * 1e3, 3),
                 "gmres_history": history_rows(
                     hist[lane] if hist is not None else None,
-                    fetched["cycles"][lane])})
+                    fetched["cycles"][lane]),
+                "flight": flight_row})
+            if flight_row is not None:
+                # telemetry twin of the metrics column: `obs timeline`
+                # renders these as per-member counter tracks
+                obs_tracer.emit("flight", member=ln.spec.member_id,
+                                lane=lane, **flight_row)
             ln.t = t_new
             ln.dt = float(fetched["dt_next"][lane])
             if (accepted and self.writer is not None

@@ -518,11 +518,18 @@ def generate_constant_force(group: FiberGroup, caches: FiberCaches) -> jnp.ndarr
     return group.force_scale[:, None, None] * caches.xs
 
 
-def fiber_error(group: FiberGroup) -> jnp.ndarray:
-    """Max inextensibility violation over active fibers (`fiber_error_local`)."""
+def fiber_errors(group: FiberGroup) -> jnp.ndarray:
+    """[nf] per-fiber inextensibility violation, inactive slots masked to 0
+    — the flight recorder's per-fiber strain diagnostic (obs.flight);
+    `fiber_error` is its max."""
     mats = group.mats
     errs = jax.vmap(lambda x, L: fd_fiber.fiber_error(x, L, mats))(group.x, group.length)
-    return jnp.max(jnp.where(group.active, errs, 0.0))
+    return jnp.where(group.active, errs, 0.0)
+
+
+def fiber_error(group: FiberGroup) -> jnp.ndarray:
+    """Max inextensibility violation over active fibers (`fiber_error_local`)."""
+    return jnp.max(fiber_errors(group))
 
 
 def solution_size(group: FiberGroup) -> int:

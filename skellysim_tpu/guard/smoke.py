@@ -34,6 +34,11 @@ def _scene(shift: float):
     cfg.params.t_final = 0.02
     cfg.params.gmres_tol = 1e-10
     cfg.params.adaptive_timestep_flag = False
+    # skelly-flight armed: the quarantine assertion below must come WITH
+    # anomaly provenance naming the poisoned fiber (docs/observability.md
+    # "Flight recorder"); tenants share the server's params contract, so
+    # every scene carries the same window
+    cfg.params.flight_window = 16
     fib = Fiber(n_nodes=8, length=1.0, bending_rigidity=0.01)
     fib.fill_node_positions(np.array([shift, 0.0, 0.0]),
                             np.array([0.0, 0.0, 1.0]))
@@ -67,7 +72,8 @@ def main(workdir: str) -> int:
     # ---- act 1: NaN quarantine, sibling survives. Tenants are seated at
     # submit time (free lanes); the horizons are long enough (20 rounds)
     # that the chaos request lands while A is still running.
-    srv = SpawnedServer(path, args=cache)
+    trace = os.path.join(workdir, "chaos_trace.jsonl")
+    srv = SpawnedServer(path, args=cache + ["--trace-file", trace])
     with srv.client() as c:
         ta = c.submit(toml_of(_scene(0.1)), t_final=0.1)["tenant"]
         tb = c.submit(toml_of(_scene(0.3)), t_final=0.1)["tenant"]
@@ -77,6 +83,16 @@ def main(workdir: str) -> int:
         assert sa["status"] == "failed", sa
         assert sa["health"] & NONFINITE, sa
         assert sa["verdict"], sa
+        # skelly-flight provenance: the failed status must NAME the
+        # poisoned lane's offender — poison_lane NaNs every fiber
+        # position, so the first offender is fiber 0 of the fiber_x field
+        prov = (sa.get("flight") or {}).get("provenance")
+        assert prov, sa.get("flight")
+        assert prov["field"] == "fiber_x", prov
+        assert prov["fiber"] == 0, prov
+        assert (sa["flight"]["tail"]
+                and sa["flight"]["tail"][-1]["health"] & NONFINITE), \
+            sa["flight"]
         assert sb["status"] == "finished", sb
         assert sb["health"] == 0, sb
         frames_b = c.stream(tb)["frames"]
@@ -84,9 +100,24 @@ def main(workdir: str) -> int:
         stats = c.stats()
         assert stats["faults"].get("chaos_nan") == 1, stats["faults"]
         assert stats["faults"].get("lane_failed") == 1, stats["faults"]
+        # fault localization counters (/stats): the offender FIELD
+        assert stats["fault_fields"].get("fiber_x") == 1, \
+            stats["fault_fields"]
         print(f"chaos smoke act 1 ok: {ta} failed "
-              f"(verdict {sa['verdict']}), {tb} finished with "
+              f"(verdict {sa['verdict']}, offender {prov['field']} fiber "
+              f"{prov['fiber']}), {tb} finished with "
               f"{len(frames_b)} frames")
+
+        # the blast-radius CLI over the server's own telemetry stream
+        # must localize the same fault (jax-free parse, flushed per
+        # event — readable while the server is live)
+        from ..obs.flight import render_flight_report
+
+        report = render_flight_report([trace])
+        assert f"{ta}: FAULT" in report, report
+        assert "field=fiber_x fiber 0" in report, report
+        print("chaos smoke: obs flight report localizes the fault "
+              f"({ta}: fiber_x fiber 0)")
 
         # ---- act 2: SIGKILL mid-flight, journal recovery
         tc = c.submit(toml_of(_scene(0.5)), t_final=0.5)["tenant"]
@@ -110,8 +141,14 @@ def main(workdir: str) -> int:
             sc = c.wait(tc, timeout=120)
             assert sc["status"] == "finished", sc
             assert abs(sc["t"] - 0.5) < 1e-9, sc
-            # ...and the failed/finished records survived the crash
-            assert c.status(ta)["status"] == "failed", c.status(ta)
+            # ...and the failed/finished records survived the crash —
+            # including the failed tenant's journaled blast radius (the
+            # provenance must outlive the server that observed it)
+            sa2 = c.status(ta)
+            assert sa2["status"] == "failed", sa2
+            prov2 = (sa2.get("flight") or {}).get("provenance")
+            assert prov2 and prov2["field"] == "fiber_x" \
+                and prov2["fiber"] == 0, sa2.get("flight")
             assert c.status(tb)["status"] == "finished", c.status(tb)
             stats = c.stats()
             assert stats["journal"], stats

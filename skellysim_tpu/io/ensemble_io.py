@@ -30,7 +30,7 @@ ENSEMBLE_STEP_FIELDS = ("event", "member", "lane", "round", "step", "t",
                         "refines", "loss_of_accuracy", "health",
                         "guard_retries", "nucleations", "catastrophes",
                         "active_fibers", "wall_s", "wall_ms",
-                        "gmres_history")
+                        "gmres_history", "flight")
 
 #: keys of an ``event == "start"`` record (member entered a lane);
 #: ``queue_wait_s`` is the admission latency (queue entry -> lane seat) —
@@ -42,9 +42,15 @@ ENSEMBLE_START_FIELDS = ("event", "member", "lane", "t", "t_final",
 ENSEMBLE_RETIRE_FIELDS = ("event", "member", "lane", "t", "steps", "frames")
 
 #: keys of an ``event == "failed"`` / ``"dt_underflow"`` record (lane
-#: quarantined/frozen): the retire keys plus the packed health word and
-#: its decoded bit names (`guard.verdict` — docs/robustness.md)
-ENSEMBLE_FAILURE_FIELDS = ENSEMBLE_RETIRE_FIELDS + ("health", "verdict")
+#: quarantined/frozen): the retire keys plus the packed health word, its
+#: decoded bit names (`guard.verdict` — docs/robustness.md), and the
+#: flight recorder's blast-radius payload — ``{"tail": [decoded rows...],
+#: "provenance": {field, fiber, node} | None}`` (`obs.flight
+#: .failure_payload`; None at `Params.flight_window == 0`) — the
+#: diagnostics trajectory INTO the fault plus the first nonfinite's
+#: coordinates (docs/observability.md "Flight recorder")
+ENSEMBLE_FAILURE_FIELDS = ENSEMBLE_RETIRE_FIELDS + ("health", "verdict",
+                                                    "flight")
 
 #: keys of an ``event == "growth"`` record: a dynamic-instability member's
 #: nucleation outgrew its fiber ``capacity`` bucket — the lane froze

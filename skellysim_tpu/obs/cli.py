@@ -1,5 +1,12 @@
 """skelly-scope CLI:
-`python -m skellysim_tpu.obs <summarize|cost|profile|timeline|perf>`.
+`python -m skellysim_tpu.obs <summarize|flight|cost|profile|timeline|perf>`.
+
+``flight FILE [FILE...]`` renders the skelly-flight blast-radius report
+from any mix of metrics/telemetry JSONL: each faulted member's
+diagnostics trajectory into the fault (strain/speed/clearance/norm rows
+from the device-side recorder ring) plus the anomaly provenance naming
+the first nonfinite's field/fiber/node (docs/observability.md "Flight
+recorder"). jax-free, torn-trailing-line tolerant.
 
 ``summarize FILE [FILE...]`` renders any mix of telemetry/metrics JSONL
 streams (run-loop metrics, `System.run(trace_path=...)` traces, ensemble
@@ -109,6 +116,20 @@ def _cmd_cost(args) -> int:
     return 0
 
 
+def _cmd_flight(args) -> int:
+    import os
+
+    from .flight import render_flight_report
+
+    missing = [p for p in args.files if not os.path.exists(p)]
+    if missing:
+        print(f"skelly-flight: no such file(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    print(render_flight_report(args.files), end="")
+    return 0
+
+
 def _cmd_profile(args) -> int:
     import json as json_mod
 
@@ -189,6 +210,13 @@ def main(argv=None) -> int:
                           "span/compile/lane/convergence tables")
     p_sum.add_argument("files", nargs="+", metavar="JSONL")
 
+    p_flight = sub.add_parser(
+        "flight", help="skelly-flight blast-radius report: diagnostics "
+                       "trajectory into each fault + anomaly provenance "
+                       "(offender field/fiber/node) from metrics/"
+                       "telemetry JSONL")
+    p_flight.add_argument("files", nargs="+", metavar="JSONL")
+
     p_prof = sub.add_parser(
         "profile", help="attribute a --profile dump's device op time to "
                         "named phases (docs/observability.md)")
@@ -239,6 +267,8 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.cmd == "summarize":
         return _cmd_summarize(args)
+    if args.cmd == "flight":
+        return _cmd_flight(args)
     if args.cmd == "profile":
         return _cmd_profile(args)
     if args.cmd == "timeline":

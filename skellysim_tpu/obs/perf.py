@@ -39,16 +39,26 @@ ROUND_RE = re.compile(r"^([A-Za-z0-9]+(?:_[A-Za-z0-9]+)*)_r(\d+)\.json$")
 #: numeric-leaf key suffixes that gate (all higher-is-better ratios/rates)
 GATED_SUFFIXES = ("speedup_vs_1dev", "tree_vs_direct", "gpairs_per_s",
                   "equiv_gpairs_per_s", "members_per_s", "steps_per_s",
-                  "warm_speedup")
+                  "warm_speedup", "hit_speedup", "armed_vs_off")
 
 #: per-group headline metrics for the trajectory table (dotted paths);
-#: groups not listed fall back to their first few gated metrics
+#: groups not listed fall back to their first few gated metrics.
+#: scenarios/compile/flight joined the archive in the skelly-flight round
+#: (bench.py `_archive_round`) — their members/s, warm/bucket-hit, and
+#: recorder-overhead ratios now diff like the MULTICHIP/TREECODE ladders.
+#: A headline absent from a round (e.g. the B8/B32 rungs on CPU-downscaled
+#: rounds) renders "-", never an error.
 HEADLINES = {
     "multichip": ["coupled_spmd.d2.speedup_vs_1dev",
                   "coupled_spmd.d4.speedup_vs_1dev",
                   "coupled_spmd.d8.speedup_vs_1dev",
                   "matvec.d8.speedup_vs_1dev"],
     "treecode": ["n65536.tree_vs_direct", "n16384.tree_vs_direct"],
+    "scenarios": ["ladder.B1.members_per_s", "ladder.B2.members_per_s",
+                  "ladder.B4.members_per_s", "ladder.B8.members_per_s",
+                  "ladder.B32.members_per_s"],
+    "compile": ["warm_speedup", "bucket_hit.hit_speedup"],
+    "flight": ["armed_vs_off", "k0.steps_per_s", "k32.steps_per_s"],
 }
 
 

@@ -49,9 +49,26 @@ class Summary:
         #: (`queue_wait_s`, emitted by the ensemble scheduler)
         self.queue_waits: list[float] = []
         self.steps: list[dict] = []
+        #: flight-recorder rows keyed by member (skelly-flight): the
+        #: metrics records' ``flight`` column and the telemetry stream's
+        #: ``flight`` events both land here (docs/observability.md)
+        self.flight_rows: dict[str, list[dict]] = {}
+        #: fault-event offender fields (``prov_field`` — anomaly
+        #: provenance, `obs.flight.PROV_FIELDS`)
+        self.fault_fields: dict[str, int] = {}
+        #: metrics-column vs telemetry-event flight-row pairing: the run
+        #: loop writes the SAME trial row to both streams — summarizing
+        #: the pair must count it once, while two separate
+        #: (bitwise-identical) runs' rows must NOT collapse
+        #: (`obs.flight.FlightRowDedup` credit matching)
+        self._flight_dedup = None
         self.resumes = 0
         self.versions: set[int] = set()
         self.unparsed = 0
+        #: torn trailing lines (kill-9 mid-write): tolerated, reported
+        #: separately from mid-file garbage — the `serve/journal.py`
+        #: replay discipline applied to report inputs
+        self.torn_tails = 0
         #: source-stream id stamped on ingested step records: `round` ids
         #: restart at 0 per ensemble run, so wall dedupe must never merge
         #: round 0 of file A with round 0 of file B
@@ -75,6 +92,9 @@ class Summary:
         if not isinstance(rec, dict):
             self.unparsed += 1
             return
+        self.add_record(rec)
+
+    def add_record(self, rec: dict):
         ev = rec.get("ev")
         if ev == "telemetry":
             self.versions.add(rec.get("version"))
@@ -95,6 +115,13 @@ class Summary:
             if rec.get("verdict"):
                 v = str(rec["verdict"])
                 self.fault_verdicts[v] = self.fault_verdicts.get(v, 0) + 1
+            if rec.get("prov_field"):
+                f = str(rec["prov_field"])
+                self.fault_fields[f] = self.fault_fields.get(f, 0) + 1
+        elif ev == "flight":
+            row = {k: rec.get(k) for k in rec
+                   if k not in ("ev", "ts", "pid", "host")}
+            self._add_flight_row(rec, row, "trace")
         elif ev == "lane":
             action = rec.get("action", "?")
             self.lane_events[action] = self.lane_events.get(action, 0) + 1
@@ -106,6 +133,19 @@ class Summary:
             elif "iters" in rec and rec.get("event", "step") == "step":
                 # run-loop METRICS_FIELDS record, or an ensemble step record
                 self.steps.append(dict(rec, _stream=self._stream))
+                if isinstance(rec.get("flight"), dict):
+                    self._add_flight_row(rec, rec["flight"], "metrics")
+
+    def _add_flight_row(self, rec: dict, row: dict, kind: str):
+        from .flight import FlightRowDedup, flight_row_key, member_of
+
+        if self._flight_dedup is None:
+            self._flight_dedup = FlightRowDedup()
+        member = member_of(rec)
+        if self._flight_dedup.is_duplicate(flight_row_key(member, row),
+                                           kind):
+            return
+        self.flight_rows.setdefault(member, []).append(row)
 
     def add_file(self, path: str):
         import os
@@ -115,9 +155,21 @@ class Summary:
         if label in self.sources.values():
             label = f"{label}#{self._stream}"
         self.sources[self._stream] = label
-        with open(path) as fh:
-            for line in fh:
-                self.add_line(line)
+        # torn-trailing-line tolerance (kill -9 mid-write, same replay
+        # discipline as serve/journal.py): THE one rule lives in
+        # `obs.flight.iter_jsonl_tolerant`, shared with `obs flight` — a
+        # torn final line is a partial write, reported as such; mid-file
+        # garbage stays an unparseable-line count
+        from .flight import iter_jsonl_tolerant
+
+        for rec, torn in iter_jsonl_tolerant(path):
+            if rec is None:
+                if torn:
+                    self.torn_tails += 1
+                else:
+                    self.unparsed += 1
+                continue
+            self.add_record(rec)
 
     def _label(self, stream: int) -> str:
         return self.sources.get(stream, "-")
@@ -218,6 +270,10 @@ class Summary:
         if self.fault_verdicts:
             out.append("verdicts: " + ", ".join(
                 f"{v}={n}" for v, n in sorted(self.fault_verdicts.items())))
+        if self.fault_fields:
+            # skelly-flight anomaly provenance: which FIELD blew up first
+            out.append("offender fields: " + ", ".join(
+                f"{f}={n}" for f, n in sorted(self.fault_fields.items())))
         out.append("")
 
     def _lane_section(self, out: list[str]):
@@ -284,6 +340,50 @@ class Summary:
         total_c = sum(int(s.get("catastrophes", 0)) for s in self.steps)
         out.append(f"events: nucleations={total_n}  catastrophes={total_c}"
                    + (f"  growth-reseats={growths}" if growths else ""))
+        out.append("")
+
+    def _flight_section(self, out: list[str]):
+        """Physics-diagnostics table (skelly-flight,
+        docs/observability.md "Flight recorder"): per-member extrema of
+        the recorder's per-step rows — strain, node speed, signed wall
+        clearance, solution norm — plus any anomaly provenance. Rendered
+        only when the stream carries flight rows (Params.flight_window >
+        0)."""
+        if not self.flight_rows:
+            return
+        out.append("== physics diagnostics (flight recorder) ==")
+        rows = [("member", "steps", "max_strain", "max_speed",
+                 "min_clear", "max_|x|", "flagged")]
+
+        def vals(rs, key):
+            return [r[key] for r in rs
+                    if isinstance(r.get(key), (int, float))]
+
+        for member in sorted(self.flight_rows):
+            rs = self.flight_rows[member]
+            strains = vals(rs, "max_strain")
+            speeds = vals(rs, "max_speed")
+            clears = vals(rs, "min_clearance")
+            norms = vals(rs, "solution_norm")
+            flagged = sum(1 for r in rs if r.get("health"))
+            rows.append((
+                member, str(len(rs)),
+                f"{max(strains):.3g}" if strains else "-",
+                f"{max(speeds):.3g}" if speeds else "-",
+                f"{min(clears):.3g}" if clears else "-",
+                f"{max(norms):.3g}" if norms else "-",
+                str(flagged) if flagged else "-"))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+        out.extend("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                   for r in rows)
+        provs = [(m, r["provenance"]) for m, rs in self.flight_rows.items()
+                 for r in rs if isinstance(r.get("provenance"), dict)]
+        for m, p in provs[-4:]:
+            where = (f"fiber {p.get('fiber')} node {p.get('node')}"
+                     if p.get("fiber", -1) not in (None, -1)
+                     else f"row {p.get('node')}")
+            out.append(f"provenance: {m}: first nonfinite in "
+                       f"{p.get('field')} ({where})")
         out.append("")
 
     def _convergence_section(self, out: list[str]):
@@ -377,7 +477,11 @@ class Summary:
         self._fault_section(out)
         self._lane_section(out)
         self._scenario_section(out)
+        self._flight_section(out)
         self._convergence_section(out)
+        if self.torn_tails:
+            out.append(f"({self.torn_tails} torn trailing line(s) ignored "
+                       "— partial write, e.g. kill -9 mid-record)")
         if self.unparsed:
             out.append(f"({self.unparsed} unparseable line(s) skipped)")
         if not out:

@@ -28,6 +28,7 @@ jax-free (json only), like every obs parser.
 from __future__ import annotations
 
 import json
+import math
 
 from . import profile as profile_mod
 
@@ -126,6 +127,29 @@ def timeline_events(trace_paths, profile_dir=None) -> list:
                          if k in ("name", "wall_s", "trace_s", "traces",
                                   "arg_sig", "persistent_cache")}})
             n_compiles += 1
+        elif ev == "flight":
+            # skelly-flight recorder rows as perfetto COUNTER tracks (one
+            # per diagnostic, per member), so the physics trajectory into
+            # a fault renders next to the host spans and the device-phase
+            # tracks (docs/observability.md "Flight recorder")
+            member = rec.get("member")
+            suffix = f" [{member}]" if member not in (None, "run") else ""
+            for field in ("max_strain", "max_speed", "min_clearance",
+                          "solution_norm", "residual_true", "health"):
+                v = rec.get(field)
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                if not math.isfinite(v):
+                    # an inf strain (a blow-up row) would serialize as the
+                    # bare `Infinity` token and make the WHOLE artifact
+                    # unloadable in Perfetto — exactly the traces this
+                    # counter exists to render; drop the point, the fault
+                    # instant still marks the event
+                    continue
+                events.append({"ph": "C", "pid": HOST_PID,
+                               "ts": us(ts),
+                               "name": f"flight:{field}{suffix}",
+                               "args": {"value": v}})
         elif ev in ("lane", "fault", "journal", "device_phase_error"):
             label = rec.get("action") or rec.get("kind") or ev
             events.append({
@@ -201,6 +225,7 @@ def write_timeline(trace_paths, out_path: str, profile_dir=None) -> dict:
                            if e.get("ph") == "X"
                            and e.get("pid") == HOST_PID),
         "instants": sum(1 for e in events if e.get("ph") == "i"),
+        "counters": sum(1 for e in events if e.get("ph") == "C"),
         "device_slices": sum(1 for e in events
                              if e.get("ph") == "X"
                              and e.get("pid") == DEVICE_PID),

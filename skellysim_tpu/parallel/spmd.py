@@ -140,7 +140,11 @@ def _state_specs(state: SimState, shell_mode: str) -> SimState:
             shell_spec = rep(state.shell)
     return SimState(time=P(), dt=P(), fibers=fib_spec,
                     points=rep(state.points), background=rep(state.background),
-                    shell=shell_spec, bodies=rep(state.bodies))
+                    shell=shell_spec, bodies=rep(state.bodies),
+                    # the flight-recorder ring replicates: every shard
+                    # writes the bitwise-identical row (psum'd/pmax'd
+                    # reductions — obs.flight; repflow-verified)
+                    flight=rep(state.flight))
 
 
 def _make_rdot(axis: str, nonrep_end: int) -> Callable:
@@ -728,6 +732,22 @@ def build_spmd_step(system, mesh: Mesh, state: SimState, *,
 
         health = (jnp.asarray(result.health, dtype=jnp.int32)
                   | nonfinite_word(fiber_error))
+        if st.flight is not None:
+            # skelly-flight on the mesh program: the SAME diagnostics row,
+            # with every reduction an explicit collective (pmax/pmin via
+            # record_step's axis_name spelling, the solution norm through
+            # the replication-restoring rdot seam) so all shards write the
+            # bitwise-identical replicated ring — `audit.repflow` analyzes
+            # the armed build clean (tests/test_flight.py)
+            from ..obs import flight as flight_mod
+
+            new_state = new_state._replace(flight=flight_mod.record_step(
+                st, new_state, result.x,
+                residual_true=result.residual_true, health=health,
+                dt_used=st.dt, shell_shape=system.shell_shape,
+                solution_norm=jnp.sqrt(rdot(result.x, result.x)),
+                axis_name=axis, axis_size=n_dev,
+                sol_scan_rows=nonrep_end, shell_sharded=sharded_shell))
         info = StepInfo(
             converged=result.converged, iters=result.iters,
             residual=result.residual, fiber_error=fiber_error,

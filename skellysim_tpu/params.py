@@ -75,6 +75,21 @@ class Params:
     # the loop, so audit's host-sync contract stays empty. 0 disables (the
     # [N,3] carry vanishes from the lowered program entirely).
     gmres_history: int = 16
+    # skelly-flight physics flight recorder (obs.flight,
+    # docs/observability.md "Flight recorder"): ring-buffer capacity (rows)
+    # of per-step physics diagnostics — fiber max |strain| + argmax id, max
+    # node speed, min signed node-periphery clearance, body/solution norms,
+    # dt_used, the guard health word, and nonfinite anomaly provenance
+    # (field/fiber/node of the first offender) — carried device-side
+    # through the trial step as a [K, 13] f32 ring on `SimState.flight`.
+    # Same discipline as gmres_history: pure masked `.at[].set` writes, no
+    # host sync (audit's host-sync contract stays empty), vmaps per
+    # ensemble member, psum'd/pmax'd under step_spmd so shards agree
+    # bitwise. 0 (the default) disables — the carry vanishes from the
+    # pytree and every pre-flight program is bitwise identical (the armed
+    # K=32 twin is contract-pinned as its own auditable program,
+    # `step_flight`).
+    flight_window: int = 0
     fiber_error_tol: float = 1e-1
     # --- skelly-guard escalation ladder (guard.escalate,
     # docs/robustness.md): on a RETRYABLE solver health verdict

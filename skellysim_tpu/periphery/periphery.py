@@ -371,6 +371,36 @@ def flow_local(shell: PeripheryState, r_loc, r_rep, density, eta, *,
 
 # ------------------------------------------------- shape-specific interactions
 
+def signed_clearance(shape: PeripheryShape, points):
+    """[n] signed node-periphery clearance: positive inside (clear of the
+    wall), NEGATIVE once a point crosses it — so penetration is visible
+    as a magnitude, unlike `check_collision`'s bool (the flight
+    recorder's ``min_clearance`` diagnostic, obs.flight).
+
+    sphere: ``radius - |p|``; ellipsoid: the radial distance to the
+    cortex point of `check_collision`'s comparison, ``|r_cortex| - |p|``
+    (exact on the axes, a radial-ray approximation elsewhere — a
+    diagnostic, not a force); generic: +inf (no wall physics, stub
+    parity with the zero steric force)."""
+    if shape.kind == "sphere":
+        return shape.radius - jnp.linalg.norm(points, axis=-1)
+    if shape.kind == "ellipsoid":
+        a, b, c = shape.abc
+        abc = jnp.asarray(shape.abc, dtype=points.dtype)
+        r_scaled = points / abc
+        r_scaled_mag = jnp.linalg.norm(r_scaled, axis=-1)
+        phi = jnp.arctan2(r_scaled[:, 1], r_scaled[:, 0] + 1e-12)
+        theta = jnp.arccos(jnp.clip(r_scaled[:, 2] / (1e-12 + r_scaled_mag),
+                                    -1, 1))
+        sin_t = jnp.sin(theta)
+        r_cortex = jnp.stack([a * sin_t * jnp.cos(phi),
+                              b * sin_t * jnp.sin(phi),
+                              c * jnp.cos(theta)], axis=-1)
+        return (jnp.linalg.norm(r_cortex, axis=-1)
+                - jnp.linalg.norm(points, axis=-1))
+    return jnp.full(points.shape[:-1], jnp.inf, dtype=points.dtype)
+
+
 def check_collision(shape: PeripheryShape, points, threshold):
     """True if any point crosses the shell (vectorized over [n, 3] points).
 

@@ -62,9 +62,13 @@ class TenantJournal:
 
     def record(self, kind: str, tenant_id: str, *, bucket: int,
                t_final: float, status: str, frame: Optional[bytes] = None,
-               health: int = 0, t: float = 0.0):
+               health: int = 0, t: float = 0.0,
+               flight: Optional[dict] = None):
         """Append one entry. ``frame`` is one trajectory-v1 snapshot (None
-        only for terminal entries whose final frame is already journaled)."""
+        only for terminal entries whose final frame is already journaled);
+        ``flight`` is the skelly-flight blast-radius payload of a failed
+        tenant (`obs.flight.failure_payload`) — journaled so a restarted
+        server still answers the fault's provenance on `status`."""
         entry = {
             "kind": kind, "tenant": tenant_id, "bucket": int(bucket),
             "t_final": float(t_final), "status": status, "t": float(t),
@@ -72,6 +76,8 @@ class TenantJournal:
         }
         if frame is not None:
             entry["frame"] = bytes(frame)
+        if flight is not None:
+            entry["flight"] = flight
         self._seq += 1
         buf = protocol.pack_message(entry)
         self._fh.write(protocol.HEADER.pack(len(buf)) + buf)

@@ -65,6 +65,11 @@ class ServeMetrics:
         #: dt_underflow / chaos_nan / frame_oversized / ... —
         #: docs/robustness.md)
         self.faults: dict[str, int] = {}
+        #: skelly-flight fault localization: offender FIELD of each fault
+        #: event carrying anomaly provenance (``prov_field`` — fiber_x /
+        #: shell_density / ..., `obs.flight.PROV_FIELDS`), so /stats
+        #: answers "what keeps blowing up" across tenants
+        self.fault_fields: dict[str, int] = {}
         #: steps flagged loss_of_accuracy across every tenant (server
         #: increments via `note_loss_of_accuracy`)
         self.loss_of_accuracy_steps = 0
@@ -124,6 +129,9 @@ class ServeMetrics:
         elif ev == "fault":
             kind = fields.get("kind", "?")
             self.faults[kind] = self.faults.get(kind, 0) + 1
+            if fields.get("prov_field"):
+                f = str(fields["prov_field"])
+                self.fault_fields[f] = self.fault_fields.get(f, 0) + 1
 
     def mark_warm(self):
         """Every bucket has compiled + completed a round: from here on a
@@ -166,6 +174,7 @@ class ServeMetrics:
             "compiles_after_warm": self.compiles_after_warm,
             "warm": self.warm,
             "faults": dict(self.faults),
+            "fault_fields": dict(self.fault_fields),
             "loss_of_accuracy_steps": self.loss_of_accuracy_steps,
             "growth_reseats": self.growth_reseats,
             "frames_streamed": dict(self.frames_streamed),

@@ -261,7 +261,8 @@ class SimulationServer:
             return
         self.journal.record(kind, tenant.tenant_id, bucket=tenant.bucket,
                             t_final=tenant.t_final, status=tenant.status,
-                            frame=frame, health=tenant.health, t=tenant.t)
+                            frame=frame, health=tenant.health, t=tenant.t,
+                            flight=tenant.flight)
 
     def _checkpoint_live(self):
         """One journal snapshot per seated tenant (queued tenants' admit
@@ -307,7 +308,11 @@ class SimulationServer:
                     tenant_id=tid, bucket=int(entry.get("bucket", 0)),
                     t_final=float(entry.get("t_final", 0.0)),
                     t=float(entry.get("t", 0.0)),
-                    health=int(entry.get("health", 0)))
+                    health=int(entry.get("health", 0)),
+                    # a failed tenant's blast radius survives the restart
+                    # (journaled at retirement — `status` keeps answering
+                    # the provenance after recovery)
+                    flight=entry.get("flight"))
                 live = (status in journal_mod.LIVE_STATES and frame
                         and bucket is not None)
                 if live:
@@ -412,6 +417,10 @@ class SimulationServer:
             t.status = reason if reason in tenants_mod.TENANT_STATES \
                 else "finished"
             t.health |= int(extra.get("health", 0))
+            if extra.get("flight") is not None:
+                # skelly-flight blast radius (failed/dt_underflow retires):
+                # the ring tail + provenance, surfaced via `status`
+                t.flight = extra["flight"]
             t.retired_at = time.monotonic()   # [serve] record_ttl_s clock
             # terminal journal entry: the final snapshot + verdict, so a
             # restarted server still answers status/snapshot for this
@@ -652,7 +661,11 @@ class SimulationServer:
             health=t.health, verdict=_verdict.decode(t.health),
             loss_of_accuracy_steps=t.loss_of_accuracy_steps,
             dt_underflow=(t.status == "dt_underflow"
-                          or bool(t.health & _verdict.DT_UNDERFLOW)))
+                          or bool(t.health & _verdict.DT_UNDERFLOW)),
+            # skelly-flight: the last-window diagnostics tail + anomaly
+            # provenance for failed tenants (None while healthy or with
+            # the recorder off — docs/observability.md "Flight recorder")
+            flight=t.flight)
 
     def _req_stream(self, req, conn) -> dict:
         t, err = self._find(req)

@@ -74,6 +74,13 @@ class Tenant:
     #: (Belos' loss-of-accuracy analogue) — previously died in the
     #: metrics JSONL, now surfaced in `status`/`stats`
     loss_of_accuracy_steps: int = 0
+    #: skelly-flight blast radius, captured at a failed/underflowed
+    #: retirement: ``{"tail": [decoded diagnostic rows...], "provenance":
+    #: {field, fiber, node} | None}`` (`obs.flight.failure_payload`) —
+    #: the trajectory into the fault + the first nonfinite's coordinates,
+    #: surfaced on ``status`` responses; None while healthy or with the
+    #: recorder off (Params.flight_window == 0)
+    flight: Optional[dict] = None
 
     def snapshot_pending(self) -> int:
         return len(self.frames)

@@ -60,6 +60,13 @@ class SimState(NamedTuple):
     background: Optional[BackgroundFlow]
     shell: Optional[PeripheryState] = None
     bodies: Optional[bd.BodyGroup] = None
+    #: skelly-flight recorder ring (`obs.flight.FlightRecorder`, a
+    #: [Params.flight_window, 13] f32 ring + write counter) — per-step
+    #: physics diagnostics with anomaly provenance, written in-trace by
+    #: `_solve_impl`. None when `Params.flight_window == 0` (the default):
+    #: an absent pytree field, so pre-flight programs are bitwise
+    #: identical. Arm/strip with `System.ensure_flight`.
+    flight: Optional[tuple] = None
 
 
 #: tuple-of-buckets view of a fibers field (`fc.as_buckets`)
@@ -93,7 +100,8 @@ METRICS_FIELDS = ("step", "t", "dt", "iters", "gmres_cycles",
                   "collective_rounds", "residual", "residual_true",
                   "fiber_error", "accepted", "refines", "loss_of_accuracy",
                   "health", "guard_retries", "nucleations", "catastrophes",
-                  "active_fibers", "wall_s", "wall_ms", "gmres_history")
+                  "active_fibers", "wall_s", "wall_ms", "gmres_history",
+                  "flight")
 
 
 def crossed_write_boundary(t_new: float, dt: float, dt_write: float) -> bool:
@@ -384,11 +392,36 @@ class System:
             dtype = body_buckets(bodies)[0].solution.dtype
         else:
             dtype = jnp.float64
+        from ..obs import flight as flight_mod
+
         return SimState(
             time=jnp.asarray(0.0, dtype=dtype),
             dt=jnp.asarray(self.params.dt_initial, dtype=dtype),
             fibers=fibers, points=points, background=background,
-            shell=shell, bodies=bodies)
+            shell=shell, bodies=bodies,
+            # skelly-flight ring (None at flight_window=0: the pytree is
+            # bit-identical to a pre-flight state)
+            flight=flight_mod.new_ring(self.params.flight_window))
+
+    def ensure_flight(self, state: SimState) -> SimState:
+        """``state`` with its flight-recorder ring matching
+        `Params.flight_window`: arm a fresh ring when the window is on
+        and the state carries none (frame-decoded resumes, snapshots —
+        the wire never carries rings), strip it when the window is off,
+        re-arm on a window-size mismatch. Host-side normalization — the
+        run loop, the ensemble seating paths, and `step_spmd` all call
+        it, so every state entering a compiled step shares the template's
+        pytree structure."""
+        from ..obs import flight as flight_mod
+
+        window = self.params.flight_window
+        if window <= 0:
+            return (state if state.flight is None
+                    else state._replace(flight=None))
+        if (state.flight is None
+                or state.flight.rows.shape[-2] != window):
+            return state._replace(flight=flight_mod.new_ring(window))
+        return state
 
     # ----------------------------------------------------------------- helpers
 
@@ -817,13 +850,32 @@ class System:
         run-loop twin all share one implementation."""
         out = self._solve_once(state, pair=pair, pair_anchors=pair_anchors)
         p = self.params
-        if not (p.guard_dt_halvings or p.guard_block_fallback
+        if (p.guard_dt_halvings or p.guard_block_fallback
                 or p.guard_f64_fallback):
-            return out
-        from ..guard.escalate import escalate
+            from ..guard.escalate import escalate
 
-        return escalate(self, state, out, pair=pair,
-                        pair_anchors=pair_anchors)
+            out = escalate(self, state, out, pair=pair,
+                           pair_anchors=pair_anchors)
+        if p.flight_window > 0:
+            # skelly-flight: ONE diagnostics row per trial (recording the
+            # attempt that actually advanced — below the escalation
+            # ladder's retries, like the health word). Pure masked jnp
+            # reductions + one `.at[].set`: no host sync, vmaps per
+            # ensemble member (obs.flight, docs/observability.md).
+            from ..obs import flight as flight_mod
+
+            new_state, x, info = out
+            if new_state.flight is None:
+                raise ValueError(
+                    "Params.flight_window > 0 but the state carries no "
+                    "recorder ring; arm it with System.ensure_flight "
+                    "(make_state-built states arm automatically)")
+            new_state = new_state._replace(flight=flight_mod.record_step(
+                state, new_state, x,
+                residual_true=info.residual_true, health=info.health,
+                dt_used=info.dt_used, shell_shape=self.shell_shape))
+            out = (new_state, x, info)
+        return out
 
     def _solve_once(self, state: SimState, pair=None, pair_anchors=None,
                     block_s: int | None = None, force_full: bool = False):
@@ -1212,12 +1264,14 @@ class System:
     def step(self, state: SimState):
         """One trial step at state.dt: solve + advance components (`step`,
         `system.cpp:482-492`). Returns (new_state, solution, info)."""
+        state = self.ensure_flight(state)
         pair, anchors = self._pair_args(state)
         return self._solve_jit(state, pair=pair, pair_anchors=anchors)
 
     def _step_donating(self, state: SimState):
         """`step` through the donating jit — the caller's ``state`` buffers
         are CONSUMED on backends with donation support (see __init__)."""
+        state = self.ensure_flight(state)
         pair, anchors = self._pair_args(state)
         return self._solve_jit_donated(state, pair=pair,
                                        pair_anchors=anchors)
@@ -1251,6 +1305,7 @@ class System:
         # itself (once per BUILD, not per step_spmd call): the mesh program
         # threads the health WORD but not the escalation ladder — see the
         # analyzer-backed follow-up note there and in docs/robustness.md
+        state = self.ensure_flight(state)
         buckets = fiber_buckets(state.fibers)
         pair = anchors = None
         if self.params.pair_evaluator == "tree" and all(
@@ -1360,7 +1415,10 @@ class System:
         from .dynamic_instability import (_count_active as _di_count_active,
                                           apply_dynamic_instability)
 
+        from ..obs import flight as flight_mod
+
         p = self.params
+        state = self.ensure_flight(state)
         n_steps = 0
         # with the adaptive gate off no step is ever rejected, so the
         # pre-step pytree is never rolled back to — donate it through the
@@ -1403,6 +1461,18 @@ class System:
             converged = bool(info.converged)
             fiber_error = float(info.fiber_error)
             health = int(info.health)
+            # skelly-flight: the trial's decoded diagnostics row (one small
+            # device fetch), consumed by the metrics JSONL, the telemetry
+            # stream (timeline counter tracks), and fault provenance below
+            flight_row = None
+            if new_state.flight is not None and (
+                    metrics_fh is not None or health
+                    or obs_tracer.active() is not None):
+                flight_row = flight_mod.last_row(new_state.flight.rows,
+                                                 new_state.flight.count)
+                if flight_row is not None:
+                    obs_tracer.emit("flight", step=n_steps - 1,
+                                    **flight_row)
             # the guard ladder may have retried this trial at a halved dt
             # (Params.guard_dt_halvings): the dt that actually advanced the
             # state is info.dt_used — identical to `dt` when the ladder is
@@ -1458,10 +1528,19 @@ class System:
                 # summarize fault table) plus the log line the reference
                 # would have aborted with
                 verdict_s = _verdict.describe(health)
+                # flight provenance rides the fault event when the recorder
+                # localized the offender (obs.flight — "who and where"
+                # next to guard's "something died")
+                prov = (flight_row or {}).get("provenance") or {}
+                prov_fields = ({"prov_field": prov.get("field"),
+                                "prov_fiber": prov.get("fiber"),
+                                "prov_node": prov.get("node")}
+                               if prov else {})
                 obs_tracer.emit("fault", kind="solver_health",
                                 verdict=verdict_s, health=health,
                                 t=t_cur, dt=dt,
-                                retries=int(info.guard_retries))
+                                retries=int(info.guard_retries),
+                                **prov_fields)
                 logger.warning(
                     "solver health verdict at t=%.6g: %s (health=%#x, "
                     "guard retries=%d)", t_cur, verdict_s, health,
@@ -1500,7 +1579,10 @@ class System:
                     "wall_s": round(wall_s, 4),
                     "wall_ms": round(wall_s * 1e3, 3),
                     "gmres_history": history_rows(info.history,
-                                                  info.cycles)}) + "\n")
+                                                  info.cycles),
+                    # the flight recorder's decoded row for THIS trial
+                    # (None at flight_window=0; docs/observability.md)
+                    "flight": flight_row}) + "\n")
                 metrics_fh.flush()
 
             if accept:
@@ -1517,7 +1599,12 @@ class System:
                         else:
                             writer(state, solution)
             else:
-                state = backup._replace(dt=jnp.asarray(dt_new, dtype=state.dt.dtype))
+                # a rejected trial rolls back the physics but KEEPS the
+                # flight ring: the recorder's whole point is the trajectory
+                # into trouble, and the rejected attempt's row is evidence
+                state = backup._replace(
+                    dt=jnp.asarray(dt_new, dtype=state.dt.dtype),
+                    flight=new_state.flight)
         return state
 
 
@@ -1541,22 +1628,24 @@ def auditable_programs():
             return built_from(fn, state, pair=None, pair_anchors=None)
         return _build
 
-    def retrace_probe():
-        from ..testing import trace_counting_jit
+    def retrace_probe(**overrides):
+        def _probe():
+            from ..testing import trace_counting_jit
 
-        system = fixtures.make_system()
-        step = trace_counting_jit(system._solve_impl,
-                                  static_argnames=("pair",))
-        new_state, _, _ = step(fixtures.free_state(system))
-        step(new_state)  # same structure, new values: must not retrace
-        return step.trace_count
+            system = fixtures.make_system(**overrides)
+            step = trace_counting_jit(system._solve_impl,
+                                      static_argnames=("pair",))
+            new_state, _, _ = step(fixtures.free_state(system))
+            step(new_state)  # same structure, new values: must not retrace
+            return step.trace_count
+        return _probe
 
     return [
         AuditProgram(
             name="step_single", layer="system",
             summary="single-chip implicit step (free fibers, f64, "
                     "non-donating jit)",
-            build=build(), retrace_probe=retrace_probe),
+            build=build(), retrace_probe=retrace_probe()),
         AuditProgram(
             name="step_single_donated", layer="system",
             summary="single-chip implicit step through the donating jit "
@@ -1566,4 +1655,16 @@ def auditable_programs():
             name="step_mixed", layer="system",
             summary="mixed-precision step (f32 Krylov + f64 df refinement)",
             build=build(solver_precision="mixed", refine_pair_impl="df")),
+        AuditProgram(
+            # skelly-flight: the ARMED (K=32) twin of the step is its own
+            # contracted program, so the recorder's overhead (op counts,
+            # bytes, retraces — and that it stays collective- and
+            # callback-free) is contract-pinned, not folklore; the K=0
+            # default program stays byte-identical to pre-flight and rides
+            # the step_single contract unchanged
+            name="step_flight", layer="system",
+            summary="single-chip implicit step with the K=32 flight "
+                    "recorder armed (skelly-flight diagnostics ring)",
+            build=build(flight_window=32),
+            retrace_probe=retrace_probe(flight_window=32)),
     ]

@@ -33,6 +33,10 @@ def test_bench_prints_one_parseable_json_line(tmp_path):
                 # trace path at startup)
                 "BENCH_MULTICHIP_PATH": str(tmp_path / "MULTICHIP.json"),
                 "BENCH_TREECODE_PATH": str(tmp_path / "TREECODE.json"),
+                # keep the smoke run's partial scenarios/compile/flight
+                # rounds out of the real benchmarks/ history the perf
+                # gate diffs
+                "BENCH_ARCHIVE_DIR": str(tmp_path / "benchmarks"),
                 "BENCH_TRACE_PATH": str(tmp_path / "bench_trace.jsonl")})
     env.pop("JAX_PLATFORMS", None)
     # scrub the conftest's 8-virtual-device pin too: a real `python bench.py`
