@@ -25,6 +25,19 @@ echo "== lint: skelly-lint static analysis (dtype/trace/sharding) =="
 # the class of defect value-checking tests miss (commit 46b498b; docs/lint.md)
 JAX_PLATFORMS=cpu python -m skellysim_tpu.lint skellysim_tpu/
 
+echo "== audit: skelly-fence Pallas DMA-race/VMEM verifier (docs/audit.md) =="
+# kernel-level static verification, in EVERY tier: the fused ring kernels
+# (which CPU CI can never execute — that is the point) and the gridded
+# tile kernels are traced and proven against their [dma] contracts:
+# read-before-arrival ordering, overwrite-in-flight (the ENTRY+EXIT
+# barrier protocol model-checked, phase skew bound pinned), semaphore
+# credit balance, and the VMEM footprint from the SAME formula
+# `fused_ring_fits` consults at build time. Zero suppressions. The full
+# audit below re-covers this; the explicit gate keeps the kernel exit
+# code visible on its own. Measured ~1.5 s total on the CI box — noise
+# against the fast tier's 780 s budget guard.
+JAX_PLATFORMS=cpu python -m skellysim_tpu.audit --check dma
+
 echo "== audit: skelly-audit lowered-program contracts (docs/audit.md) =="
 # the compiled-program twin of the lint gate, in EVERY tier: every
 # registered entry point (single-chip step, step_spmd on 2/4/8-device
@@ -35,7 +48,8 @@ echo "== audit: skelly-audit lowered-program contracts (docs/audit.md) =="
 # analysis (`--check replication`, docs/parallel.md "Replication
 # discipline"): the d2/d4/d8 mesh programs must statically PROVE they
 # cannot deadlock (no varying while/cond predicates, no collectives under
-# divergence, replicated outputs verified) with zero suppressions. Fails
+# divergence, replicated outputs verified) with zero suppressions, plus
+# the skelly-fence `dma` check over the Pallas kernel registry. Fails
 # on any unsuppressed finding or unused suppression. (Bootstraps its own
 # 8-device CPU + x64 backend.)
 python -m skellysim_tpu.audit

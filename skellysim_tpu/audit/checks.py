@@ -330,6 +330,44 @@ def check_replication(name, built, contract, probe):
     return out
 
 
+def check_dma(name, built, contract, probe):
+    """skelly-fence (`audit.dmaflow`): DMA happens-before, semaphore
+    balance, barrier-protocol model check, and VMEM accounting over one
+    registered Pallas kernel. Unlike the six program checks this one
+    consumes a `registry.BuiltKernel` (the engine routes it over
+    `kernels.all_kernels`, not the program matrix); the ``[dma]`` contract
+    section pins the analyzer's full observed inventory key by key."""
+    from . import dmaflow
+
+    cid = "dma"
+    report = dmaflow.analyze(built)
+    out = [Finding(name, cid, f.message) for f in report.findings]
+    spec = (contract or {}).get("dma")
+    if spec is None:
+        out.append(Finding(name, cid, (
+            "[dma] contract section missing — pin the kernel's slot "
+            "counts, semaphore inventory, and footprint (run "
+            f"`--dump-contract {name}` for the observed values)")))
+        return out
+    observed = report.observed
+    for key in sorted(set(spec) | set(observed)):
+        if key not in observed:
+            out.append(Finding(name, cid, (
+                f"stale pin `{key}`: the analyzer no longer reports it — "
+                "remove it or it documents an inventory that is not being "
+                "checked")))
+        elif key not in spec:
+            out.append(Finding(name, cid, (
+                f"[dma] has no `{key}` pin — the analyzer reports "
+                f"{observed[key]!r}; every inventory key must be pinned")))
+        elif spec[key] != observed[key]:
+            out.append(Finding(name, cid, (
+                f"{key} drifted: contract pins {spec[key]!r}, the traced "
+                f"kernel shows {observed[key]!r} — re-derive the contract "
+                "deliberately")))
+    return out
+
+
 @dataclass(frozen=True)
 class Check:
     id: str
@@ -337,6 +375,9 @@ class Check:
     run: object  # callable(name, built, contract, probe) -> [Finding]
     #: needs the (possibly expensive) retrace probe instead of artifacts
     wants_probe: bool = False
+    #: runs over the Pallas kernel registry (`kernels.all_kernels`), not
+    #: the program matrix — ``built`` is a `registry.BuiltKernel`
+    over_kernels: bool = False
 
 
 CHECKS = (
@@ -365,4 +406,10 @@ CHECKS = (
           "while/cond predicates (the manual-SPMD deadlock), no collectives "
           "under divergence, replicated outputs provably replicated",
           check_replication),
+    Check("dma",
+          "skelly-fence static DMA verifier over the Pallas kernel "
+          "registry: read-before-arrival, overwrite-in-flight (barrier "
+          "protocol model-checked), semaphore credit balance, VMEM "
+          "footprint vs the shared budget",
+          check_dma, over_kernels=True),
 )

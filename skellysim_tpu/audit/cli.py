@@ -30,7 +30,8 @@ def main(argv=None) -> int:
         prog="python -m skellysim_tpu.audit",
         description="Trace-time program auditor: lowered-jaxpr/StableHLO "
                     "contracts for collectives, dtype flow, host syncs, "
-                    "donation, and retrace budgets (see docs/audit.md).")
+                    "donation, retrace budgets, and Pallas DMA safety "
+                    "(see docs/audit.md).")
     parser.add_argument("--program", action="append", default=None,
                         metavar="NAME",
                         help="audit only this program (repeatable)")
@@ -64,30 +65,39 @@ def main(argv=None) -> int:
             return 2
 
     _bootstrap_backend()
-    from .engine import run_program_audit
+    from .engine import run_kernel_audit, run_program_audit
+    from .kernels import all_kernels
     from .programs import all_programs
 
     progs = all_programs()
+    kerns = all_kernels()
     if args.list_programs:
-        width = max(len(p.name) for p in progs)
+        width = max(len(p.name) for p in progs + kerns)
         for p in progs:
             print(f"{p.name:<{width}}  [{p.layer}] {p.summary}")
+        for k in kerns:
+            print(f"{k.name:<{width}}  [{k.layer}/kernel] {k.summary}")
         return 0
 
     if args.dump_contract:
-        from .engine import dump_contract
+        from .engine import dump_contract, dump_kernel_contract
 
-        try:
-            prog = next(p for p in progs if p.name == args.dump_contract)
-        except StopIteration:
-            print(f"skelly-audit: unknown program {args.dump_contract!r} "
-                  f"(try --list-programs)", file=sys.stderr)
-            return 2
-        print(dump_contract(prog), end="")
-        return 0
+        prog = next((p for p in progs if p.name == args.dump_contract),
+                    None)
+        if prog is not None:
+            print(dump_contract(prog), end="")
+            return 0
+        kern = next((k for k in kerns if k.name == args.dump_contract),
+                    None)
+        if kern is not None:
+            print(dump_kernel_contract(kern), end="")
+            return 0
+        print(f"skelly-audit: unknown program {args.dump_contract!r} "
+              f"(try --list-programs)", file=sys.stderr)
+        return 2
 
     if args.program:
-        known = {p.name for p in progs}
+        known = {p.name for p in progs} | {k.name for k in kerns}
         unknown = [n for n in args.program if n not in known]
         if unknown:
             print(f"skelly-audit: unknown program(s): "
@@ -95,19 +105,33 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         progs = [p for p in progs if p.name in set(args.program)]
+        kerns = [k for k in kerns if k.name in set(args.program)]
+
+    # --check filters route each matrix to its own checks: program checks
+    # over the program matrix, kernel-scoped checks (dma) over the Pallas
+    # kernel registry — a `--check dma` run never pays a program lowering
+    if args.check is not None:
+        selected = set(args.check)
+        if not selected & {c.id for c in CHECKS if not c.over_kernels}:
+            progs = []
+        if not selected & {c.id for c in CHECKS if c.over_kernels}:
+            kerns = []
 
     findings = []
     for prog in progs:
         findings.extend(run_program_audit(prog, checks=args.check))
+    for kern in kerns:
+        findings.extend(run_kernel_audit(kern, checks=args.check))
     for f in findings:
         print(f.render())
+    audited = len(progs) + len(kerns)
     if findings:
         print(f"skelly-audit: {len(findings)} finding(s) across "
-              f"{len(progs)} program(s). Fix the program, or record the "
+              f"{audited} program(s). Fix the program, or record the "
               "deliberate change in its audit/contracts/<name>.toml "
               "(docs/audit.md).", file=sys.stderr)
         return 1
-    print(f"skelly-audit: {len(progs)} program(s) contract-clean.")
+    print(f"skelly-audit: {audited} program(s) contract-clean.")
     return 0
 
 

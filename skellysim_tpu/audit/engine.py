@@ -27,7 +27,7 @@ CONTRACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: contract sections the engine understands; anything else is drift (a
 #: typo'd section would otherwise silently stop gating)
 _KNOWN_SECTIONS = ("program", "collectives", "dtype", "host_sync",
-                   "donation", "retrace", "replication", "suppress")
+                   "donation", "retrace", "replication", "dma", "suppress")
 
 
 @dataclass(frozen=True)
@@ -125,20 +125,59 @@ def run_program_audit(prog, contract=None, checks=None):
             return findings
     else:
         findings = []
+    # kernel-scoped checks (dma) belong to `run_kernel_audit`'s matrix
+    program_checks = tuple(c for c in CHECKS if not c.over_kernels)
     active_ids = (None if checks is None
-                  else {c.id for c in CHECKS if c.id in set(checks)})
+                  else {c.id for c in program_checks if c.id in set(checks)})
     try:
         built = prog.build()
     except Exception as e:  # a program that no longer lowers IS the finding
         findings.append(Finding(prog.name, "build", (
             f"entry point failed to trace/lower: {type(e).__name__}: {e}")))
         return apply_suppressions(prog.name, contract, findings, active_ids)
-    active = CHECKS if checks is None else tuple(
-        c for c in CHECKS if c.id in set(checks))
+    active = program_checks if checks is None else tuple(
+        c for c in program_checks if c.id in set(checks))
     for check in active:
         probe = prog.retrace_probe if check.wants_probe else None
         findings.extend(check.run(prog.name, built, contract, probe))
     return apply_suppressions(prog.name, contract, findings, active_ids)
+
+
+def run_kernel_audit(kern, contract=None, checks=None):
+    """Audit one registered `AuditKernel` (the Pallas-kernel twin of
+    `run_program_audit`): only the kernel-scoped checks (today: ``dma``)
+    apply; contract loading, suppression discipline, and build-failure
+    handling are identical."""
+    from .checks import CHECKS
+
+    if contract is None:
+        contract, findings = load_contract(kern.name)
+        if contract is None:
+            return findings
+    else:
+        findings = []
+    active = tuple(c for c in CHECKS if c.over_kernels
+                   and (checks is None or c.id in set(checks)))
+    active_ids = {c.id for c in active}
+    try:
+        built = kern.build()
+    except Exception as e:  # a kernel that no longer traces IS the finding
+        findings.append(Finding(kern.name, "build", (
+            f"kernel failed to trace: {type(e).__name__}: {e}")))
+        return apply_suppressions(kern.name, contract, findings, active_ids)
+    for check in active:
+        findings.extend(check.run(kern.name, built, contract, None))
+    return apply_suppressions(kern.name, contract, findings, active_ids)
+
+
+def dump_kernel_contract(kern) -> str:
+    """The observed ``[dma]`` inventory of one registered kernel in
+    contract TOML (round-trips through `config.toml_io`)."""
+    from . import dmaflow
+
+    report = dmaflow.analyze(kern.build())
+    data = {"program": {"name": kern.name}, "dma": dict(report.observed)}
+    return toml_io.dumps(data)
 
 
 def dump_contract(prog) -> str:

@@ -1,0 +1,37 @@
+"""Aggregate the auditable-kernel matrix from every Pallas-owning module.
+
+The kernel-level twin of `audit.programs`: modules that author Pallas
+kernels (`parallel.ring_fused`, `ops.pallas_kernels`) expose
+``auditable_kernels()`` returning `registry.AuditKernel`s, and the ``dma``
+check (`audit.dmaflow`) verifies each one. Defining ``auditable_kernels``
+is also the lint boundary: the ``raw-dma`` skelly-lint rule flags DMA /
+semaphore primitives in any module without it.
+"""
+
+from __future__ import annotations
+
+
+def all_kernels():
+    """Every registered `AuditKernel`, ops before parallel. Lazy module
+    imports, same rationale as `programs.all_programs`."""
+    from ..ops.pallas_kernels import auditable_kernels as ops_kernels
+    from ..parallel.ring_fused import auditable_kernels as ring_kernels
+
+    kerns = []
+    for layer in (ops_kernels, ring_kernels):
+        kerns.extend(layer())
+    names = [k.name for k in kerns]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise ValueError(f"duplicate auditable kernel name(s): "
+                         f"{', '.join(sorted(dupes))}")
+    return kerns
+
+
+def get_kernel(name: str):
+    for k in all_kernels():
+        if k.name == name:
+            return k
+    raise KeyError(
+        f"no auditable kernel named {name!r} "
+        f"(registered: {', '.join(k.name for k in all_kernels())})")

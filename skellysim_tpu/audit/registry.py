@@ -55,6 +55,41 @@ class AuditProgram:
     retrace_probe: Callable[[], int] | None = None
 
 
+@dataclass
+class BuiltKernel:
+    """The traced artifacts the ``dma`` check (`audit.dmaflow`) consumes.
+
+    ``kernel_jaxpr`` is the Pallas kernel-body jaxpr (ref semantics:
+    get/swap/dma_start/semaphore primitives); ``grid_mapping`` the
+    `pallas_call` GridMapping (block shapes, input/output/scratch
+    partition); ``n_dev`` the ring size the kernel was traced for;
+    ``scene`` the builder's shape parameters (``kind``/``n_trg``/
+    ``n_src`` for ring kernels, {} for gridded) so the verifier can
+    cross-check the build-time eligibility gate against the traced
+    artifact.
+    """
+
+    kernel_jaxpr: object
+    grid_mapping: object
+    n_dev: int
+    scene: dict
+
+
+@dataclass
+class AuditKernel:
+    """One registered Pallas kernel (the ``auditable_kernels()`` seam —
+    same shape as `AuditProgram`, but ``build()`` returns the kernel-level
+    artifact the DMA verifier walks rather than a whole-program lowering).
+    Modules defining ``auditable_kernels`` are the lint boundary for the
+    ``raw-dma`` rule: DMA/semaphore primitives are legal only inside them.
+    """
+
+    name: str
+    layer: str
+    summary: str
+    build: Callable[[], BuiltKernel]
+
+
 def built_from(jitted, *args, **kwargs) -> BuiltProgram:
     """Trace + lower a `jax.jit`-wrapped callable once, capturing every
     artifact from the same trace (no double tracing/lowering)."""

@@ -455,6 +455,50 @@ def check_sharding_annotation(mod: ModuleInfo, ctx: RepoContext):
     return out
 
 
+# --------------------------------------------------------- rule: raw-dma
+
+#: Pallas DMA / semaphore primitives whose safety argument (happens-before
+#: ordering, credit balance) only the `dma` audit check can verify
+RAW_DMA_CALLS = ("make_async_remote_copy", "semaphore_signal",
+                 "semaphore_wait", "get_barrier_semaphore")
+
+
+def check_raw_dma(mod: ModuleInfo, ctx: RepoContext):
+    """DMA/semaphore primitives outside the audited kernel modules.
+
+    `skellysim_tpu.audit`'s ``dma`` check (skelly-fence) statically proves
+    read-before-arrival, overwrite-in-flight, and credit-balance safety —
+    but only for kernels registered through the ``auditable_kernels()``
+    seam (`audit.kernels`). A raw `pltpu.make_async_remote_copy` /
+    semaphore call in any other jit-reachable code is an UNVERIFIED race
+    surface: the verifier never sees it, CI cannot execute it, and its
+    safety argument is whatever comment sits next to it. Modules defining
+    ``auditable_kernels`` at top level are the licensed boundary.
+    """
+    out = []
+    rid = "raw-dma"
+    if "auditable_kernels" in mod.functions:
+        return out
+    for qual, fi in mod.functions.items():
+        if not ctx.is_reachable(mod, qual):
+            continue
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name in RAW_DMA_CALLS:
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, rid,
+                    f"{name} inside jit-reachable `{qual}` is outside any "
+                    "module registered via auditable_kernels(): the dma "
+                    "audit check cannot verify its ordering/credit safety "
+                    "— move the kernel into a registered module (see "
+                    "audit/kernels.py)"))
+    return out
+
+
 RULES = (
     Rule("dtype-discipline",
          "array creation without explicit dtype / hardcoded f64-f32 casts "
@@ -477,6 +521,11 @@ RULES = (
          "shard_map without explicit in_specs/out_specs; device_put in "
          "parallel/ without an explicit sharding",
          check_sharding_annotation),
+    Rule("raw-dma",
+         "pltpu DMA/semaphore primitives in jit-reachable code outside "
+         "modules registered via auditable_kernels() (the dma audit "
+         "check's verified boundary)",
+         check_raw_dma),
     Rule("lint-pragma",
          "malformed, unknown-rule, reason-less, or unused suppression "
          "pragmas (engine-enforced; keeps every pragma load-bearing)",

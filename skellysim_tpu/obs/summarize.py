@@ -43,6 +43,9 @@ class Summary:
         #: fused-ring fallbacks; docs/robustness.md)
         self.faults: dict[str, int] = {}
         self.fault_verdicts: dict[str, int] = {}
+        #: fused-ring fallback eligibility legs (``leg`` — budget vs
+        #: platform vs missing-api, `parallel.compat._fused_fallback`)
+        self.fault_legs: dict[str, int] = {}
         self.lane_events: dict[str, int] = {}
         self.lane_rounds: list[dict] = []
         #: admission latencies from lane admit/backfill events
@@ -118,6 +121,9 @@ class Summary:
             if rec.get("prov_field"):
                 f = str(rec["prov_field"])
                 self.fault_fields[f] = self.fault_fields.get(f, 0) + 1
+            if rec.get("leg"):
+                leg = str(rec["leg"])
+                self.fault_legs[leg] = self.fault_legs.get(leg, 0) + 1
         elif ev == "flight":
             row = {k: rec.get(k) for k in rec
                    if k not in ("ev", "ts", "pid", "host")}
@@ -274,6 +280,11 @@ class Summary:
             # skelly-flight anomaly provenance: which FIELD blew up first
             out.append("offender fields: " + ", ".join(
                 f"{f}={n}" for f, n in sorted(self.fault_fields.items())))
+        if self.fault_legs:
+            # which fused-ring eligibility leg failed: "too big for VMEM"
+            # (budget) reads very differently from "not a TPU" (platform)
+            out.append("legs: " + ", ".join(
+                f"{leg}={n}" for leg, n in sorted(self.fault_legs.items())))
         out.append("")
 
     def _lane_section(self, out: list[str]):

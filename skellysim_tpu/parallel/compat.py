@@ -69,25 +69,45 @@ def fused_ring_mode(impl: str = "pallas") -> str:
     try:
         from jax.experimental.pallas import tpu as pltpu
     except Exception:  # pallas not shipped on this build
-        return _fused_fallback("pallas-unavailable")
+        return _fused_fallback("pallas-unavailable", leg="missing-api")
     if not hasattr(pltpu, "make_async_remote_copy"):
-        return _fused_fallback("no-remote-dma")
+        return _fused_fallback("no-remote-dma", leg="missing-api")
     if override == "interpret":
         return "fused-interpret"
     if jax.default_backend() != "tpu":
-        return _fused_fallback(f"backend-{jax.default_backend()}")
+        return _fused_fallback(f"backend-{jax.default_backend()}",
+                               leg="platform")
     return "fused"
 
 
-def _fused_fallback(reason: str) -> str:
+def _fused_fallback(reason: str, *, leg: str) -> str:
     """Log + emit the structured fault for an environmental fused-ring
-    fallback; always returns "ppermute"."""
+    fallback; always returns "ppermute".
+
+    ``leg`` names WHICH eligibility leg failed — ``missing-api`` (the jax
+    build lacks pallas or remote DMA), ``platform`` (not a compiled TPU
+    backend), or ``budget`` (`ring_fused.fused_ring_fits` rejected the
+    shape; emitted from the `parallel.ring` call site via
+    `fused_ring_budget_fallback`) — so `obs summarize`'s fault table
+    distinguishes "too big for VMEM" from "not a TPU".
+    """
     from ..obs import tracer as obs_tracer
 
     logger.warning("fused ring unavailable (%s): falling back to the "
                    "lax.ppermute ring", reason)
-    obs_tracer.emit("fault", kind="fused_ring_fallback", reason=reason)
+    obs_tracer.emit("fault", kind="fused_ring_fallback", reason=reason,
+                    leg=leg)
     return "ppermute"
+
+
+def fused_ring_budget_fallback(kind: str, n_trg: int, n_src: int,
+                               n_dev: int) -> None:
+    """Emit the budget-leg fallback fault from the ring call site: the
+    backend could run the fused kernel, but the shape failed the VMEM
+    eligibility check — without this event that fallback was silent, and
+    the fault table could not tell it apart from an environmental one."""
+    _fused_fallback(
+        f"vmem-budget-{kind}-{n_trg}x{n_src}x{n_dev}", leg="budget")
 
 
 def use_mesh(mesh):

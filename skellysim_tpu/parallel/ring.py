@@ -56,12 +56,17 @@ def _ring_or_fused(kind, impl: str, block_fn, axis_name: str, n_dev: int,
     mode = fused_ring_mode(impl) if kind is not None else "ppermute"
     if mode != "ppermute" and n_dev > 1:
         from . import ring_fused
+        from .compat import fused_ring_budget_fallback
 
         if ring_fused.fused_ring_fits(kind, r_trg.shape[0],
                                       rotating[0].shape[0], n_dev):
             return ring_fused.fused_ring_block_sum(
                 kind, r_trg, *rotating, axis_name=axis_name, n_dev=n_dev,
                 interpret=(mode == "fused-interpret"))
+        # eligible backend, ineligible shape: the budget leg (trace-time
+        # event — shapes are static, so this fires once per build)
+        fused_ring_budget_fallback(kind, r_trg.shape[0],
+                                   rotating[0].shape[0], n_dev)
     return _ring_accumulate(lambda *r: block_fn(r_trg, *r), axis_name,
                             n_dev, jnp.zeros_like(r_trg), *rotating,
                             unroll=unroll)

@@ -18,7 +18,8 @@ Scope (build-time checked, `fused_ring_fits`):
   tile math (`ops.pallas_kernels.stokeslet_tile_sums` /
   `stresslet_tile_sums`, one shared definition), so a user probing the
   exact/mxu tiles keeps the `ppermute` ring and its tile semantics;
-* whole-shard blocks resident in VMEM (`_VMEM_PAIR_BUDGET`): this is a
+* whole-shard blocks resident in VMEM (`audit.dmaflow.VMEM_PAIR_BUDGET`,
+  the shared build/verify-time accounting): this is a
   LATENCY optimization for the solve-scale regime where the ladder loses
   to one device — bandwidth-bound blocks too big for VMEM fall back to the
   `ppermute` ring at build time, which already streams fine at scale;
@@ -41,9 +42,14 @@ sends while a neighbor is still reading instance k — the counting makes
 phase skew >= 2 impossible even though barrier credits are anonymous
 (a single entry barrier alone would NOT be safe: a fast neighbor's next-
 instance signal could stand in for a slow neighbor's missing one, and the
-RDMA would overwrite comm slots still being read). The slot buffers cost
-``n_dev * (3 + payload_rows) * ns`` floats of VMEM, bounded by
-`fused_ring_fits` alongside the pair tile.
+RDMA would overwrite comm slots still being read). This argument is no
+longer only prose: the ``dma`` audit check (`audit.dmaflow`) re-derives it
+from the traced kernel every CI run — per-slot read/write ordering against
+the recv semaphores, credit balance, and an explicit-state search over the
+barrier protocol that both proves the ENTRY+EXIT pairing bounds phase skew
+to 1 and *derives* the entry-only counterexample as a reachable overwrite.
+The slot buffers cost ``n_dev * (3 + payload_rows) * ns`` floats of VMEM,
+bounded by `fused_ring_fits` alongside the pair tile.
 
 The accumulation order around the ring is the SAME as the ppermute ring's
 (my block first, then left neighbor's, ...), so the two paths agree to the
@@ -64,13 +70,6 @@ from jax.experimental.pallas import tpu as pltpu
 from ..ops.pallas_kernels import (_PAD_SENTINEL, _out_struct, _pad_to,
                                   stokeslet_tile_sums, stresslet_tile_sums)
 
-#: cap on nt_padded * ns_padded for the whole-block VMEM kernel: the pair
-#: intermediates are a handful of [nt, ns] f32 arrays, so this bounds VMEM
-#: at a few MB (the gridded tile sweep topped out at 512x2048-class tiles).
-#: Bigger blocks are bandwidth-bound, not latency-bound — they keep the
-#: ppermute ring.
-_VMEM_PAIR_BUDGET = 512 * 2048
-
 #: payload rows in the rotating comm block (3 coord rows + payload rows)
 _PAYLOAD_ROWS = {"stokeslet": 3, "stresslet": 9}
 
@@ -79,23 +78,22 @@ _PAYLOAD_ROWS = {"stokeslet": 3, "stresslet": 9}
 _COLLECTIVE_ID = 7
 
 
-#: cap on the n_dev-slot comm buffer (floats): slots are written/read once
-#: per instance (the no-reuse safety scheme above), so the buffer scales
-#: with mesh size — 4 MB of f32 leaves the pair tile its VMEM headroom
-_VMEM_COMM_BUDGET = 1 << 20
-
-
 def fused_ring_fits(kind: str, n_trg: int, n_src: int,
                     n_dev: int = 1) -> bool:
     """True when the whole-block fused kernel serves this shape: known
     kernel family, padded pair tile inside the VMEM budget, and the
-    n_dev-slot comm buffer inside its own."""
+    n_dev-slot comm buffer inside its own. The budget accounting itself
+    lives in `audit.dmaflow.fused_ring_within_budget` — ONE closed-form
+    consulted both here (build-time eligibility) and by the ``dma`` audit
+    check (verify-time gate on the traced kernel), so the two cannot
+    drift. `audit.dmaflow` is import-light (no jax)."""
+    from ..audit.dmaflow import fused_ring_within_budget
+
     if kind not in _PAYLOAD_ROWS:
         return False
     nt = -(-n_trg // 8) * 8
     ns = -(-n_src // 128) * 128
-    comm = n_dev * (3 + _PAYLOAD_ROWS[kind]) * ns
-    return nt * ns <= _VMEM_PAIR_BUDGET and comm <= _VMEM_COMM_BUDGET
+    return fused_ring_within_budget(_PAYLOAD_ROWS[kind], n_dev, nt, ns)
 
 
 def _ring_kernel(kind: str, axis_name: str, n_dev: int):
@@ -189,3 +187,50 @@ def fused_ring_block_sum(kind: str, r_trg, src, payload, *, axis_name: str,
         interpret=interpret,
     )(trg_T, blk)
     return u_T.T[:n_trg]
+
+
+def auditable_kernels():
+    """The fused rings' entries for the ``dma`` audit check: both kernel
+    families traced through `shard_map` on an 8-device ring at a shape
+    `fused_ring_fits` accepts (the scene parameters ride along so the
+    verifier can cross-check that build-time gate against the traced
+    comm-buffer accounting). Defining this seam is also what licenses this
+    module's DMA/semaphore callsites for the ``raw-dma`` lint rule."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..audit.dmaflow import pallas_calls
+    from ..audit.registry import AuditKernel, BuiltKernel
+    from .compat import shard_map
+    from .mesh import FIBER_AXIS, make_mesh
+
+    n_dev, n_trg, n_src = 8, 8, 128
+
+    def build(kind):
+        def _build():
+            payload_shape = ((n_src * n_dev, 3) if kind == "stokeslet"
+                             else (n_src * n_dev, 3, 3))
+            mesh = make_mesh(n_dev)
+            fn = shard_map(
+                lambda r, s, w: fused_ring_block_sum(
+                    kind, r, s, w, axis_name=FIBER_AXIS, n_dev=n_dev),
+                mesh=mesh,
+                in_specs=(P(FIBER_AXIS), P(FIBER_AXIS), P(FIBER_AXIS)),
+                out_specs=P(FIBER_AXIS))
+            closed = jax.make_jaxpr(fn)(
+                jnp.zeros((n_trg * n_dev, 3), jnp.float32),
+                jnp.zeros((n_src * n_dev, 3), jnp.float32),
+                jnp.zeros(payload_shape, jnp.float32))
+            (kernel_jaxpr, grid_mapping), = pallas_calls(closed.jaxpr)
+            return BuiltKernel(kernel_jaxpr=kernel_jaxpr,
+                               grid_mapping=grid_mapping, n_dev=n_dev,
+                               scene={"kind": kind, "n_trg": n_trg,
+                                      "n_src": n_src})
+        return _build
+
+    return [
+        AuditKernel(name=f"ring_{kind}_fused", layer="parallel",
+                    summary=(f"fused {kind} ring: RDMA ring collective "
+                             f"on an {n_dev}-device mesh"),
+                    build=build(kind))
+        for kind in ("stokeslet", "stresslet")
+    ]

@@ -153,6 +153,31 @@ def test_fused_ring_fallback_without_remote_dma(monkeypatch):
         assert fused_ring_mode("pallas") == "ppermute"
     faults = [e for e in tr.events if e["ev"] == "fault"]
     assert faults and faults[0]["reason"] == "no-remote-dma"
+    assert faults[0]["leg"] == "missing-api"
+
+
+def test_fused_ring_fallback_legs(monkeypatch):
+    """ISSUE-16 satellite: every fallback fault names WHICH eligibility
+    leg failed. The platform leg comes from `fused_ring_mode` (CPU
+    backend); the budget leg from the `parallel.ring` call site when the
+    shape fails the VMEM check on an otherwise-eligible backend."""
+    from skellysim_tpu.obs import tracer as obs_tracer
+    from skellysim_tpu.parallel.compat import fused_ring_budget_fallback
+
+    monkeypatch.delenv("SKELLY_FUSED_RING", raising=False)
+    tr = obs_tracer.Tracer()
+    with obs_tracer.use(tr):
+        assert fused_ring_mode("pallas") == "ppermute"
+    (fault,) = [e for e in tr.events if e["ev"] == "fault"]
+    assert fault["leg"] == "platform"
+
+    tr2 = obs_tracer.Tracer()
+    with obs_tracer.use(tr2):
+        fused_ring_budget_fallback("stokeslet", 4096, 4096, 8)
+    (fault,) = [e for e in tr2.events if e["ev"] == "fault"]
+    assert fault["kind"] == "fused_ring_fallback"
+    assert fault["leg"] == "budget"
+    assert "vmem-budget-stokeslet-4096x4096x8" == fault["reason"]
 
 
 @pytest.mark.skipif(jax.default_backend() != "tpu",

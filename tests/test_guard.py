@@ -442,3 +442,24 @@ def test_summarize_fault_table(tmp_path):
     assert "nonfinite=2" in out
     assert "HEALTH-FLAGGED steps: 1" in out
     assert "guard retries: 2" in out
+
+
+def test_summarize_fault_legs_line(tmp_path):
+    """Fused-ring fallbacks carry the eligibility leg that failed; the
+    fault table renders the leg counts so "too big for VMEM" (budget)
+    reads differently from "not a TPU" (platform)."""
+    from skellysim_tpu.obs.summarize import summarize_files
+
+    p = tmp_path / "trace.jsonl"
+    lines = [
+        {"ev": "telemetry", "version": 1},
+        {"ev": "fault", "kind": "fused_ring_fallback",
+         "reason": "backend-cpu", "leg": "platform"},
+        {"ev": "fault", "kind": "fused_ring_fallback",
+         "reason": "vmem-budget-stokeslet-4096x4096x8", "leg": "budget"},
+        {"ev": "fault", "kind": "fused_ring_fallback",
+         "reason": "vmem-budget-stresslet-4096x4096x8", "leg": "budget"},
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in lines) + "\n")
+    out = summarize_files([str(p)])
+    assert "legs: budget=2, platform=1" in out

@@ -406,6 +406,57 @@ def test_cli_refuses_paths_that_lint_nothing(tmp_path):
         assert run.returncode == 2, (bad, run.stdout, run.stderr)
 
 
+# ----------------------------------------------------------- raw-dma rule
+
+_RAW_DMA_SRC = (
+    "import jax\n"
+    "from jax.experimental.pallas import tpu as pltpu\n"
+    "@jax.jit\n"
+    "def entry(x):\n"
+    "    return body(x)\n"
+    "def body(x):\n"
+    "    sem = pltpu.get_barrier_semaphore()\n"
+    "    pltpu.semaphore_signal(sem, inc=1, device_id=0)\n"
+    "    pltpu.semaphore_wait(sem, 1)\n"
+    "    return x\n")
+
+
+def test_raw_dma_flags_unregistered_module(tmp_path):
+    p = _write(tmp_path, "parallel/mod.py", _RAW_DMA_SRC)
+    assert _rules(lint_paths([p])) == ["raw-dma"] * 3
+
+
+def test_raw_dma_exempts_auditable_kernels_modules(tmp_path):
+    """Defining the `auditable_kernels()` registration seam IS the
+    license: the dma audit check verifies every kernel the module
+    registers, so its primitives are not raw."""
+    p = _write(tmp_path, "parallel/mod.py", _RAW_DMA_SRC + (
+        "def auditable_kernels():\n"
+        "    return []\n"))
+    assert lint_paths([p]) == []
+
+
+def test_raw_dma_ignores_unreachable_code(tmp_path):
+    # no jit seed anywhere: nothing is jit-reachable, nothing flags
+    p = _write(tmp_path, "parallel/mod.py", (
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "def host_helper(x):\n"
+        "    return pltpu.get_barrier_semaphore()\n"))
+    assert lint_paths([p]) == []
+
+
+def test_raw_dma_suppressed_with_pragma(tmp_path):
+    p = _write(tmp_path, "parallel/mod.py", (
+        "import jax\n"
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "@jax.jit\n"
+        "def entry(x):\n"
+        "    # skelly-lint: ignore[raw-dma] — migration shim under test\n"
+        "    sem = pltpu.get_barrier_semaphore()\n"
+        "    return x\n"))
+    assert lint_paths([p]) == []
+
+
 def test_repo_tree_is_lint_clean():
     """The acceptance gate: the shipped tree has zero unsuppressed findings
     (CI runs the CLI equivalent in every tier)."""

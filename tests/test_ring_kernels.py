@@ -238,14 +238,16 @@ def test_fused_ring_traces_with_correct_shapes():
 
 
 def test_fused_ring_fits_budget():
-    from skellysim_tpu.parallel.ring_fused import (_VMEM_PAIR_BUDGET,
-                                                   fused_ring_fits)
+    # the budget constant moved to the audit analyzer (single source of
+    # truth shared by this build-time gate and the `dma` audit check)
+    from skellysim_tpu.audit.dmaflow import VMEM_PAIR_BUDGET
+    from skellysim_tpu.parallel.ring_fused import fused_ring_fits
 
     assert fused_ring_fits("stokeslet", 64, 64, 8)
     assert fused_ring_fits("stresslet", 512, 2048, 8)
     # beyond the whole-block VMEM budget: bandwidth-bound, keep ppermute
     assert not fused_ring_fits("stokeslet", 4096, 4096, 8)
-    assert 4096 * 4096 > _VMEM_PAIR_BUDGET
+    assert 4096 * 4096 > VMEM_PAIR_BUDGET
     # the n_dev-slot comm buffer has its own budget (slots are never
     # reused within an instance — the ring-safety scheme)
     assert not fused_ring_fits("stresslet", 8, 2048, 256)
