@@ -1650,6 +1650,83 @@ def _group_treecode(extra, ck, on_acc):
     publish()  # always leave an artifact, even if every rung was skipped
 
 
+#: current spectral round (bump when re-measuring deliberately); archived
+#: under benchmarks/ via `_archive_round` like the scenarios/compile rounds
+SPECTRAL_ROUND = "r01"
+
+
+def _group_spectral(extra, ck, on_acc):
+    """ISSUE 17: wall + pairs/sec for the dense Stokeslet tile vs the
+    spectral (particle-mesh) Ewald evaluator (`ops.spectral`) at N in
+    {1k, 4k, 16k, 64k} constant-density triply-periodic clouds in f32 at
+    tol 1e-4 — the f32 Krylov-interior role the evaluator serves in the
+    implicit solve. The spectral rate is EQUIVALENT dense pairs/sec
+    (N^2 / wall): since the evaluator is O(N log N), its equivalent rate
+    must GROW ~linearly with N while the dense tile's stays flat —
+    sub-quadratic scaling shows up as that growth, and the smallest N
+    with spectral_vs_direct > 1 is the measured crossover
+    (benchmarks/SPECTRAL_r01.json; downscale-flagged on CPU like the
+    treecode round). The dense tile is a FREE-SPACE sum — the comparison
+    is wall-per-matvec for the solver slot, not numerical parity."""
+    import jax.numpy as jnp
+
+    from skellysim_tpu.ops import kernels
+    from skellysim_tpu.ops import spectral as spec
+
+    tol = 1e-4
+    out = {"tol": tol, "dtype": "float32",
+           "ladder": [1024, 4096, 16384, 65536]}
+    if not on_acc:
+        _mark_downscaled(out, _CPU_FALLBACK)
+    extra["spectral"] = out
+    ck()
+
+    rng = np.random.default_rng(67)
+    crossover = None
+    for n in out["ladder"]:
+        if _remaining() < 45:
+            out[f"n{n}"] = {"skipped_budget": int(_remaining())}
+            ck()
+            continue
+        row = {}
+        out[f"n{n}"] = row  # attached up front so error markers survive
+        try:
+            # constant-density periodic cloud: the box grows as N^(1/3),
+            # so the FFT grid rung ladder absorbs the scale-up while cell
+            # occupancy stays flat
+            box_L = 4.0 * (n / 1024.0) ** (1.0 / 3.0)
+            box = (box_L, box_L, box_L)
+            pts = rng.uniform(0.0, box_L, (n, 3))
+            r = jnp.asarray(pts, dtype=jnp.float32)
+            f = jnp.asarray(rng.standard_normal((n, 3)), dtype=jnp.float32)
+            plan = spec.plan_spectral(pts, box, eta=1.0, tol=tol)
+            row["plan"] = {"M3": list(plan.M3), "P": plan.P,
+                           "xi": round(plan.xi, 3)}
+            rate_d = _rate(lambda: kernels.stokeslet_direct(r, r, f, 1.0),
+                           n * n, trials=2)
+            row["direct"] = {"gpairs_per_s": round(rate_d / 1e9, 4),
+                             "wall_s": round(n * n / rate_d, 4)}
+            rate_s = _rate(
+                lambda: spec.stokeslet_spectral(plan, r, r, f), n * n,
+                trials=2)
+            row["spectral"] = {"equiv_gpairs_per_s": round(rate_s / 1e9, 4),
+                               "wall_s": round(n * n / rate_s, 4)}
+            row["spectral_vs_direct"] = round(rate_s / rate_d, 3)
+            if crossover is None and rate_s > rate_d:
+                crossover = n
+                out["crossover_n"] = crossover
+        except Exception as e:
+            row["error"] = _short_err(e)
+        ck()
+        _archive_round("SPECTRAL", SPECTRAL_ROUND, out, extra)
+    out["crossover"] = (f"spectral beats direct at N>={crossover}"
+                        if crossover
+                        else "no crossover within the benched ladder")
+    ck()
+    # always leave an artifact, even if every rung was skipped
+    _archive_round("SPECTRAL", SPECTRAL_ROUND, out, extra)
+
+
 def _group_compile(extra, ck, on_acc):
     """skelly-bucket (ISSUE 12): the cold → warm → bucket-hit compile
     ladder. Three measured rungs per run entry point:
@@ -1842,6 +1919,7 @@ GROUPS = [
     ("multichip", _group_multichip, 1.3),
     ("collectives", _group_collectives, 0.7),
     ("treecode", _group_treecode, 1.0),
+    ("spectral", _group_spectral, 1.0),
     ("compile", _group_compile, 0.8),
     ("flight", _group_flight, 0.4),
     ("solves", _group_solves, 1.0),

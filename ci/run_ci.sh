@@ -270,6 +270,30 @@ JAX_PLATFORMS=cpu python -m skellysim_tpu.guard.smoke "$CHAOS_TMP" \
   || { echo "guard chaos smoke failed" >&2; rm -rf "$CHAOS_TMP"; exit 1; }
 rm -rf "$CHAOS_TMP"
 
+echo "== spectral: periodic-scene smoke (docs/spectral.md) =="
+# skelly-spectral acceptance, exit-code gated in EVERY tier: one implicit
+# step on a triply-periodic box under pair_evaluator="spectral" — the
+# plan builds off the rung ladder, the solve routes every flow through
+# the particle-mesh evaluator, and GMRES must converge below gmres_tol.
+# ~20 s (one compile; the periodic program shares no cache entry with the
+# free-space smokes above).
+JAX_PLATFORMS=cpu python -c "
+from skellysim_tpu.utils.bootstrap import force_cpu_devices
+force_cpu_devices(1)
+import jax
+jax.config.update('jax_enable_x64', True)
+from skellysim_tpu.audit import fixtures
+system = fixtures.make_system(pair_evaluator='spectral',
+                              periodic_box=(12.0, 12.0, 12.0),
+                              spectral_tol=1e-5)
+state = fixtures.free_state(system)
+_, _, info = system.step(state)
+assert bool(info.converged), f'periodic spectral step did not converge: {info}'
+res = float(info.residual)
+assert res < system.params.gmres_tol, res
+print(f'spectral smoke ok: periodic step converged, residual {res:.2e}')
+"
+
 echo "== docs: config reference in sync with the schema =="
 JAX_PLATFORMS=cpu python scripts/gen_config_reference.py --check
 

@@ -124,6 +124,20 @@ def callback_inventory(closed_jaxpr):
     return out
 
 
+def fft_inventory(closed_jaxpr):
+    """fft kind (FFT/IFFT/RFFT/IRFFT) -> static site count. One spectral
+    apply is one forward + one inverse transform per kernel; extra sites
+    mean an accidental per-component or per-axis re-transform — an
+    O(N log N) constant-factor regression invisible to correctness tests."""
+    out = {}
+    for eqn in walk_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "fft":
+            continue
+        kind = str(eqn.params.get("fft_type", "fft")).rsplit(".", 1)[-1]
+        out[kind] = out.get(kind, 0) + 1
+    return out
+
+
 DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
 
 
@@ -282,6 +296,35 @@ def check_retrace_budget(name, built, contract, probe):
     return []
 
 
+def check_fft_inventory(name, built, contract, probe):
+    out = []
+    cid = "fft-inventory"
+    observed = fft_inventory(built.closed_jaxpr)
+    total = sum(observed.values())
+    breakdown = ", ".join(f"{k} x{n}" for k, n in sorted(observed.items()))
+    spec = contract.get("fft")
+    if spec is None:
+        if total:
+            out.append(Finding(name, cid, (
+                f"{total} fft primitive site(s) ({breakdown}) with no "
+                "[fft] section — transforms are the spectral evaluator's "
+                "cost center; pin their static count")))
+        return out
+    pinned = spec.get("count")
+    if pinned is None:
+        out.append(Finding(name, cid, (
+            "[fft] has no `count` pin — a contracted fft inventory must "
+            "pin its static site count")))
+    elif pinned != total:
+        detail = breakdown if total else "none"
+        out.append(Finding(name, cid, (
+            f"fft count drifted: contract pins {pinned}, the jaxpr has "
+            f"{total} ({detail}) — a per-component or per-axis "
+            "re-transform crept in (or the contract is stale); re-derive "
+            "it deliberately")))
+    return out
+
+
 def check_replication(name, built, contract, probe):
     """Replication-flow analysis (`audit.repflow`, docs/parallel.md):
     statically prove the program's `shard_map` regions cannot deadlock —
@@ -401,6 +444,10 @@ CHECKS = (
           "trace_counting_jit compile count across same-structure calls "
           "stays within the contract budget",
           check_retrace_budget, wants_probe=True),
+    Check("fft-inventory",
+          "fft primitive sites in the closed jaxpr vs the contract's "
+          "[fft] count pin (the spectral evaluator's transform budget)",
+          check_fft_inventory),
     Check("replication",
           "replication-flow analysis over shard_map regions: no varying "
           "while/cond predicates (the manual-SPMD deadlock), no collectives "

@@ -27,7 +27,8 @@ CONTRACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: contract sections the engine understands; anything else is drift (a
 #: typo'd section would otherwise silently stop gating)
 _KNOWN_SECTIONS = ("program", "collectives", "dtype", "host_sync",
-                   "donation", "retrace", "replication", "dma", "suppress")
+                   "donation", "retrace", "fft", "replication", "dma",
+                   "suppress")
 
 
 @dataclass(frozen=True)
@@ -184,7 +185,7 @@ def dump_contract(prog) -> str:
     """The observed inventory of ``prog`` in contract TOML — the starting
     point for writing (or deliberately updating) its contract file."""
     from .checks import (callback_inventory, collective_inventory, dtype_flow,
-                         replication_summary)
+                         fft_inventory, replication_summary)
 
     built = prog.build()
     sites = collective_inventory(built.lowered_text)
@@ -210,6 +211,9 @@ def dump_contract(prog) -> str:
                                        for m in DONATION_MARKERS)}
     if prog.retrace_probe is not None:
         data["retrace"] = {"max_traces": 1}
+    ffts = fft_inventory(built.closed_jaxpr)
+    if ffts:
+        data["fft"] = {"count": sum(ffts.values())}
     _, replication = replication_summary(built.closed_jaxpr)
     if replication is not None:
         data["replication"] = replication

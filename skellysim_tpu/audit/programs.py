@@ -12,6 +12,7 @@ def all_programs():
     # FUNCTIONS (`solver.gmres`), which would shadow `from ..solver import
     # gmres`-style module lookups
     from ..ensemble.runner import auditable_programs as ensemble_programs
+    from ..ops.spectral import auditable_programs as spectral_programs
     from ..ops.treecode import auditable_programs as ops_programs
     from ..parallel.spmd import auditable_programs as parallel_programs
     from ..solver.gmres import auditable_programs as solver_programs
@@ -19,7 +20,7 @@ def all_programs():
 
     progs = []
     for layer in (system_programs, solver_programs, ops_programs,
-                  parallel_programs, ensemble_programs):
+                  spectral_programs, parallel_programs, ensemble_programs):
         progs.extend(layer())
     names = [p.name for p in progs]
     dupes = {n for n in names if names.count(n) > 1}

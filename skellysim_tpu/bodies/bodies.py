@@ -302,6 +302,13 @@ def flow(group: BodyGroup, caches: BodyCaches, r_trg, x_bodies, forces_torques,
             v = tcode._stresslet_tree_impl(
                 pair.plan, pair_anchors, caches.nodes.reshape(nb * n, 3),
                 r_trg, f_dl, eta)
+        elif (pair is not None and pair.evaluator == "spectral"
+                and pair.plan is not None):
+            from ..ops import spectral as spec
+
+            v = spec._stresslet_spectral_impl(
+                pair.plan, pair_anchors, caches.nodes.reshape(nb * n, 3),
+                r_trg, f_dl) * (pair.plan.eta / eta)
         elif ewald_plan is not None:
             from ..ops import ewald as ew
 

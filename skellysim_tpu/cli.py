@@ -57,6 +57,9 @@ def run(config_file: str, resume: bool = False, overwrite: bool = False,
 
     policy = bucket_mod.BucketPolicy.from_runtime(
         load_runtime_config(config_file))
+    # the spectral evaluator's grid rungs are plan data, not state shapes —
+    # they ride the System, not bucketize
+    system.grid_ladder = policy.grid_ladder
     state, bucket_key = bucket_mod.bucketize(
         state, policy, pair_evaluator=system.params.pair_evaluator)
     import logging

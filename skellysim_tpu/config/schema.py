@@ -207,6 +207,13 @@ class Params:
     solver_precision: str = "auto"
     ewald_tol: float = 1e-6
     tree_tol: float = 1e-4
+    # periodic boundary for the "spectral" evaluator: [] = free space,
+    # [Lx, Ly, Lz] = triply periodic, [Lx, Ly] = doubly periodic slab
+    # (x/y periodic, z free); validate() requires it for "spectral" and
+    # rejects it for every other evaluator (docs/spectral.md)
+    periodic_box: list = field(default_factory=list)
+    # target relative accuracy of the spectral Ewald evaluator
+    spectral_tol: float = 1e-6
     kernel_impl: str = "exact"
     refine_pair_impl: str = "auto"
     ewald_min_sources: int = 2048
@@ -542,6 +549,12 @@ class RuntimeConfig:
     #: masked quadrature rows (identity-padded operators). [] = off.
     #: Incompatible with pair_evaluator = "ewald"/"tree".
     shell_ladder: List[int] = field(default_factory=list)
+    #: spectral-evaluator FFT grid-dimension ladder (ascending ints): a
+    #: drifting scene's per-axis grid requirement snaps UP onto a rung so
+    #: the SpectralPlan — the jit key — is stable under drift. [] = the
+    #: built-in 2^a 3^b ladder (ops.spectral.GRID_RUNGS). Rungs should be
+    #: FFT-friendly sizes (2^a 3^b 5^c).
+    grid_ladder: List[int] = field(default_factory=list)
 
 
 def load_runtime_config(path_or_data) -> RuntimeConfig:
@@ -558,7 +571,8 @@ def load_runtime_config(path_or_data) -> RuntimeConfig:
         raise ValueError(f"unknown [runtime] keys {sorted(unknown)}; "
                          f"valid keys: {sorted(known)}")
     cfg = RuntimeConfig(**table)
-    for name in ("bucket_ladder", "node_ladder", "shell_ladder"):
+    for name in ("bucket_ladder", "node_ladder", "shell_ladder",
+                 "grid_ladder"):
         lad = getattr(cfg, name)
         if name == "bucket_ladder" and list(lad) == [-1]:
             continue  # the "geometric" spelling
@@ -681,6 +695,7 @@ class Config:
 
     def validate(self) -> list[str]:
         problems = _validate(self)
+        problems += _validate_periodic(self)
         for j, b in enumerate(self.bodies):
             if getattr(b, "shape", None) == "deformable":
                 # fail at schema-validation time with the stub named, not
@@ -718,6 +733,40 @@ class ConfigRevolution(Config):
 
 # ---------------------------------------------------------------------------
 # validation / (de)serialization
+
+def _validate_periodic(cfg) -> list[str]:
+    """Periodic-box / evaluator pairing rules (docs/spectral.md).
+
+    The box shapes the spectral evaluator's FFT grid: [Lx, Ly, Lz] =
+    triply periodic, [Lx, Ly] = doubly periodic slab, [] = free space.
+    Only "spectral" can honor periodic images, so the pairing is validated
+    both ways — a periodic box under a dense evaluator would silently
+    simulate free space.
+    """
+    problems: list[str] = []
+    box = cfg.params.periodic_box
+    if len(box) not in (0, 2, 3):
+        problems.append(
+            "params.periodic_box: length must be 2 (doubly periodic slab "
+            f"[Lx, Ly]) or 3 (triply periodic [Lx, Ly, Lz]), got {len(box)}")
+    for j, L in enumerate(box):
+        if isinstance(L, bool) or not isinstance(L, (int, float)) or L <= 0:
+            problems.append(
+                f"params.periodic_box[{j}]: must be a positive length, "
+                f"got {L!r}")
+    ev = _EVALUATOR_NAMES.get(str(cfg.params.pair_evaluator).strip().lower())
+    if ev == "spectral" and not box:
+        problems.append(
+            "params.pair_evaluator: 'spectral' is the periodic/confined "
+            "evaluator and needs params.periodic_box ([Lx, Ly, Lz] or "
+            "[Lx, Ly]); for free space use 'ewald' or 'tree'")
+    if ev is not None and ev != "spectral" and box:
+        problems.append(
+            f"params.periodic_box: set, but pair_evaluator {ev!r} sums "
+            "free-space kernels and would ignore the periodic images; "
+            "use pair_evaluator = 'spectral'")
+    return problems
+
 
 def _validate(obj, prefix: str = "") -> list[str]:
     """Type-check every field against its annotation; flag unknown attributes
@@ -862,6 +911,8 @@ def to_runtime_params(p: Params) -> runtime_params.Params:
         solver_precision=p.solver_precision,
         ewald_tol=p.ewald_tol,
         tree_tol=p.tree_tol,
+        periodic_box=tuple(float(L) for L in p.periodic_box),
+        spectral_tol=p.spectral_tol,
         ewald_min_sources=p.ewald_min_sources,
         kernel_impl=p.kernel_impl,
         refine_pair_impl=p.refine_pair_impl,

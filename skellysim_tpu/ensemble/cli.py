@@ -70,6 +70,9 @@ def _members_from_sweep(sweep_file: str):
     for plan in plans:
         cfg = apply_overrides(base, plan.overrides)
         sys_i, state_i, _ = build_simulation(cfg, config_dir=config_dir)
+        # spectral grid rungs are plan data, not state shapes — they ride
+        # the System (cli.py does the same for single runs)
+        sys_i.grid_ladder = policy.grid_ladder
         state_i, key_i = bucket_mod.bucketize(
             state_i, policy, pair_evaluator=sys_i.params.pair_evaluator)
         if system is None:

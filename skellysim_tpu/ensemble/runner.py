@@ -188,9 +188,15 @@ class EnsembleRunner:
     """The jit'd batched trial step with masked per-member adaptive dt.
 
     One compiled program for a fixed lane count B: the scheduler swaps
-    member leaves in and out of lanes without retracing. The host-planned
-    fast evaluators are incompatible with a closed batched trace, so they
-    are rejected at construction rather than silently degraded.
+    member leaves in and out of lanes without retracing. The host-REBUILT
+    fast evaluators (ewald/tree re-plan per step) are incompatible with a
+    closed batched trace, so they are rejected at construction rather than
+    silently degraded. The spectral evaluator is the exception: its plan
+    is bucket-quantized data that never rebuilds under drift, so
+    `make_ensemble` builds the pair spec ONCE from the template member and
+    `step` threads it (static) plus its anchors (traced operand — NOT a
+    closure constant, which would go stale on a rung hop) through every
+    batched call.
 
     Dynamic instability runs IN-TRACE when the params enable it
     (`scenarios.di_device`, docs/scenarios.md): nucleation/catastrophe are
@@ -216,7 +222,8 @@ class EnsembleRunner:
                 f"{p.pair_evaluator!r}: the fast-summation plan is rebuilt "
                 "host-side per step and cannot live inside the closed "
                 "batched trace; use 'direct' (small-N members are below the "
-                "fast-evaluator crossover anyway)")
+                "fast-evaluator crossover anyway) or 'spectral' for "
+                "periodic scenes (its bucket-quantized plan is static data)")
         if p.pair_evaluator == "ring" and system.mesh is not None:
             raise ValueError(
                 "ensemble batching does not support the ring pair evaluator "
@@ -227,13 +234,19 @@ class EnsembleRunner:
         self.batch_impl = batch_impl
         self.di_enabled = p.dynamic_instability.n_nodes > 0
         self._di_sample_fn = di_sample_fn
+        # spectral pair spec + anchors, filled by make_ensemble; the pair
+        # is a static jit argument, so a plan-rung hop (new stripped plan)
+        # retraces instead of silently reusing the stale program
+        self._pair = None
+        self._pair_anchors = None
         # through the compile observer (obs.compile_log): with a tracer
         # active, the scheduler's timeline shows exactly when (and with
         # what member signature) the batched step compiled — the runtime
         # twin of the backfill-never-retraces test pin
         from ..obs.compile_log import observed_jit
 
-        self._step_jit = observed_jit(self.step_impl, name="ensemble_step")
+        self._step_jit = observed_jit(self.step_impl, name="ensemble_step",
+                                      static_argnames=("pair",))
 
     # ------------------------------------------------------------- assembly
 
@@ -248,6 +261,13 @@ class EnsembleRunner:
         # member shares the template's pytree structure — snapshot-decoded
         # states carry no ring (the wire never does)
         states = [self.system.ensure_flight(s) for s in states]
+        if self.system.params.pair_evaluator == "spectral":
+            # ONE plan for the whole ensemble, built from the template
+            # member: the stripped pair spec is rung-quantized static data
+            # and the anchors (box_lo/cell_lo) are fixed by the periodic
+            # box the members share, so they hold for every lane
+            self._pair, self._pair_anchors = self.system._pair_args(
+                states[0])
         stacked = stack_states(states)
         t_final = jnp.asarray(list(t_finals), dtype=jnp.float64)
         if t_final.shape != (stacked.time.shape[0],):
@@ -280,7 +300,8 @@ class EnsembleRunner:
 
     # ------------------------------------------------------------- the step
 
-    def _member_body(self, state: SimState, di_rng=None):
+    def _member_body(self, state: SimState, di_rng=None, *, pair=None,
+                     pair_anchors=None):
         """One member's trial: DI update (when enabled) + solve + (under the
         adaptive gate) collision. The DI flips ride ``new_state`` only — a
         rejected trial rolls back to the pre-DI state, exactly like the
@@ -293,19 +314,23 @@ class EnsembleRunner:
                                        sample_fn=self._di_sample_fn)
         else:
             di_info = None
-        new_state, solution, info = self.system.trial_step(state)
+        new_state, solution, info = self.system.trial_step(
+            state, pair=pair, pair_anchors=pair_anchors)
         if self.system.params.adaptive_timestep_flag:
             collided = self.system.collision(new_state)
         else:
             collided = jnp.asarray(False)
         return new_state, solution, info, collided, di_info
 
-    def step_impl(self, ens: EnsembleState):
+    def step_impl(self, ens: EnsembleState, pair=None, pair_anchors=None):
         """(EnsembleState, EnsembleStepInfo) after one masked batched trial.
 
-        Pure and jit-compiled once per (B, member structure); the scheduler
-        drives it. The accept/reject ladder mirrors `System._run_loop`
-        line for line, vectorized over members in float64.
+        Pure and jit-compiled once per (B, member structure, pair spec);
+        the scheduler drives it. The accept/reject ladder mirrors
+        `System._run_loop` line for line, vectorized over members in
+        float64. ``pair``/``pair_anchors`` (spectral only) are shared by
+        all lanes: the anchors enter as a traced operand and broadcast
+        over the member axis.
         """
         p = self.system.params
         states = ens.states
@@ -313,14 +338,20 @@ class EnsembleRunner:
 
         if self.batch_impl == "vmap":
             args = (states, ens.di_rng) if self.di_enabled else (states,)
+            # closing over pair_anchors here is safe: inside this trace it
+            # is a TRACER (an operand of step_impl), broadcast by vmap —
+            # not a baked-in host constant
+            body = (lambda *a: self._member_body(
+                *a, pair=pair, pair_anchors=pair_anchors))
             new_states, solutions, infos, collided, di_infos = jax.vmap(
-                self._member_body)(*args)
+                body)(*args)
         else:
             # one inlined copy of the member step per lane: bit-identical to
             # the unbatched program (see the module docstring)
             outs = [self._member_body(
                 lane_state(states, i),
-                ens.di_rng[i] if self.di_enabled else None)
+                ens.di_rng[i] if self.di_enabled else None,
+                pair=pair, pair_anchors=pair_anchors)
                 for i in range(states.time.shape[0])]
             (new_states, solutions, infos, collided,
              di_infos) = jax.tree_util.tree_map(
@@ -441,6 +472,9 @@ class EnsembleRunner:
 
     def step(self, ens: EnsembleState):
         """One compiled batched trial step (same signature as `step_impl`)."""
+        if self._pair is not None:
+            return self._step_jit(ens, pair=self._pair,
+                                  pair_anchors=self._pair_anchors)
         return self._step_jit(ens)
 
 

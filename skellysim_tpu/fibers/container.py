@@ -323,6 +323,8 @@ def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
         ewald_anchors)
     tree_plan = pair.plan if (pair is not None
                               and pair.evaluator == "tree") else None
+    spectral_plan = pair.plan if (pair is not None
+                                  and pair.evaluator == "spectral") else None
     pos = jnp.concatenate([node_positions(g) for g in buckets], axis=0)
     wf = jnp.concatenate([weighted_forces(g, f).reshape(-1, 3)
                           for g, f in zip(buckets, forces_list)], axis=0)
@@ -362,6 +364,21 @@ def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
         # the kernel scales as 1/eta and the plan baked plan.eta in; honor
         # this call's eta like the direct/ring branches do
         vel = vel * (ewald_plan.eta / eta)
+    elif evaluator == "spectral" and spectral_plan is not None:
+        from ..ops import spectral as spec
+
+        # same fill discipline as the ewald branch: the plan reserved
+        # occupancy room for inactive slots (`plan_spectral(n_fill=...)`)
+        fills = spec.fill_positions(spectral_plan, pair_anchors[1],
+                                    n_fib_nodes, pos.dtype)
+        pos = _spread_inactive(buckets, pos, fills)
+        n_self = n_fib_nodes if subtract_self else 0
+        if n_self:
+            r_trg = jnp.concatenate([pos, r_trg[n_self:]], axis=0)
+        vel = spec._stokeslet_spectral_impl(spectral_plan, pair_anchors, pos,
+                                            r_trg, wf, n_self)
+        # the kernel scales as 1/eta and the plan baked plan.eta in
+        vel = vel * (spectral_plan.eta / eta)
     elif evaluator == "tree" and tree_plan is not None:
         from ..ops import treecode as tcode
 

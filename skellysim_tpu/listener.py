@@ -80,8 +80,14 @@ def switch_evaluator(system, evaluator: str | None):
         from .parallel import make_mesh
 
         mesh = make_mesh()
-    return System(dataclasses.replace(system.params, pair_evaluator=ev),
-                  shell_shape=system.shell_shape, mesh=mesh), True
+    # NOTE a periodic config (params.periodic_box set) only serves
+    # "spectral" and vice versa — System.__init__ raises on a mismatched
+    # switch and the serve loop rejects that one request, like any other
+    # invalid evaluator name
+    new = System(dataclasses.replace(system.params, pair_evaluator=ev),
+                 shell_shape=system.shell_shape, mesh=mesh)
+    new.grid_ladder = system.grid_ladder
+    return new, True
 
 
 def _line_kwargs(req: dict) -> dict:
@@ -171,7 +177,7 @@ def process_request(system, template_state, reader: TrajectoryReader,
 
     seeds_sl = _seeds(sl_req)
     seeds_vl = _seeds(vl_req)
-    if (system.params.pair_evaluator in ("ewald", "tree")
+    if (system.params.pair_evaluator in ("ewald", "tree", "spectral")
             and (seeds_sl.size or seeds_vl.size)):
         # per-request extended-box plan: line integration goes through the
         # fast evaluator too, like the reference's whole-request switch
@@ -228,6 +234,7 @@ def serve(config_file: str = "skelly_config.toml",
 
     policy = bucket_mod.BucketPolicy.from_runtime(
         load_runtime_config(config_file))
+    system.grid_ladder = policy.grid_ladder
     template_state, _ = bucket_mod.bucketize(
         template_state, policy, pair_evaluator=system.params.pair_evaluator)
     reader = TrajectoryReader(traj)

@@ -37,9 +37,10 @@ import re
 ROUND_RE = re.compile(r"^([A-Za-z0-9]+(?:_[A-Za-z0-9]+)*)_r(\d+)\.json$")
 
 #: numeric-leaf key suffixes that gate (all higher-is-better ratios/rates)
-GATED_SUFFIXES = ("speedup_vs_1dev", "tree_vs_direct", "gpairs_per_s",
-                  "equiv_gpairs_per_s", "members_per_s", "steps_per_s",
-                  "warm_speedup", "hit_speedup", "armed_vs_off")
+GATED_SUFFIXES = ("speedup_vs_1dev", "tree_vs_direct", "spectral_vs_direct",
+                  "gpairs_per_s", "equiv_gpairs_per_s", "members_per_s",
+                  "steps_per_s", "warm_speedup", "hit_speedup",
+                  "armed_vs_off")
 
 #: per-group headline metrics for the trajectory table (dotted paths);
 #: groups not listed fall back to their first few gated metrics.
@@ -54,6 +55,7 @@ HEADLINES = {
                   "coupled_spmd.d8.speedup_vs_1dev",
                   "matvec.d8.speedup_vs_1dev"],
     "treecode": ["n65536.tree_vs_direct", "n16384.tree_vs_direct"],
+    "spectral": ["n65536.spectral_vs_direct", "n16384.spectral_vs_direct"],
     "scenarios": ["ladder.B1.members_per_s", "ladder.B2.members_per_s",
                   "ladder.B4.members_per_s", "ladder.B8.members_per_s",
                   "ladder.B32.members_per_s"],

@@ -55,6 +55,9 @@ PHASE_SCOPES = frozenset({
     "ring-step", "allgather-density", "psum-dots",
     # treecode traversal phases (ops/treecode.py)
     "upward", "near", "far",
+    # spectral-Ewald pipeline phases (ops/spectral.py; "near" is shared
+    # with the treecode vocabulary above)
+    "spread", "fft", "kspace", "interp",
     # in-trace auxiliaries: the device DI update (scenarios/di_device.py)
     # and the jitted collision gate (system/system.py)
     "dynamic-instability", "collision",

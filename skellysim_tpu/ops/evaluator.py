@@ -26,32 +26,37 @@ from dataclasses import dataclass
 
 #: runtime evaluator names, the single source for validation everywhere
 #: (System.__init__, the config schema, the listener's evaluator map)
-EVALUATORS = ("direct", "ring", "ewald", "tree")
+EVALUATORS = ("direct", "ring", "ewald", "tree", "spectral")
 
 #: accepted spellings -> runtime evaluator names, shared by the TOML schema
 #: (`config.schema`) and the listener protocol (`listener.cpp:117` semantics)
 #: so config files and runtime requests can never disagree about which names
 #: are valid: reference names ("CPU"/"GPU"/"TPU" = dense direct, "FMM" = the
-#: fast-evaluator slot -> spectral Ewald) plus our native names. Lookups are
+#: fast-evaluator slot -> free-space Ewald, "PVFMM" = the reference's
+#: periodic backend -> the
+#: spectral Ewald evaluator) plus our native names. Lookups are
 #: case-insensitive at both call sites. Read-only view: both importers bind
 #: the SAME object, so a mutation at one site would silently change what
 #: names the other accepts.
 EVALUATOR_ALIASES = types.MappingProxyType(
     {"cpu": "direct", "gpu": "direct", "tpu": "direct",
      "fmm": "ewald",
+     "pvfmm": "spectral",
      "direct": "direct", "ring": "ring", "ewald": "ewald",
-     "tree": "tree"})
+     "tree": "tree", "spectral": "spectral"})
 
 
 def plan_module(plan):
     """The ops module owning ``plan`` (lazy imports: the spec itself must
     stay importable without pulling both planners in)."""
-    from . import ewald, treecode
+    from . import ewald, spectral, treecode
 
     if isinstance(plan, ewald.EwaldPlan):
         return ewald
     if isinstance(plan, treecode.TreePlan):
         return treecode
+    if isinstance(plan, spectral.SpectralPlan):
+        return spectral
     raise TypeError(f"unknown pair-evaluator plan type {type(plan)!r}")
 
 
@@ -80,7 +85,8 @@ class PairEvaluator:
     @property
     def is_fast(self) -> bool:
         """True when this spec routes through a fast-summation plan."""
-        return self.plan is not None and self.evaluator in ("ewald", "tree")
+        return (self.plan is not None
+                and self.evaluator in ("ewald", "tree", "spectral"))
 
 
 def resolve(pair, pair_anchors, dtype, evaluator: str = "direct",

@@ -59,6 +59,7 @@ from jax import lax
 __all__ = ["EwaldPlan", "plan_ewald", "stokeslet_ewald",
            "stresslet_ewald", "strip_anchors",
            "plan_anchors", "fill_positions", "stokeslet_near_block",
+           "stokeslet_disp_block", "stresslet_disp_block_ewald",
            "g_far_pair", "bhat_far_trunc"]
 
 _SQRT_PI = math.sqrt(math.pi)
@@ -98,7 +99,13 @@ def stokeslet_near_block(trg, src, f_src, xi):
     (multiply by 1/(8 pi eta) outside). Coincident pairs drop, matching
     `kernels.stokeslet_block`.
     """
-    d = trg[:, None, :] - src[None, :, :]
+    return stokeslet_disp_block(trg[:, None, :] - src[None, :, :], f_src, xi)
+
+
+def stokeslet_disp_block(d, f_src, xi):
+    """`stokeslet_near_block` on a precomputed displacement tile ``d``
+    [t, s, 3] — the seam `ops.spectral`'s periodic near field uses to
+    minimum-image the displacements before the screened channel math."""
     r2 = jnp.sum(d * d, axis=-1)
     mask = r2 > 0.0
     r2s = jnp.where(mask, r2, 1.0)
@@ -133,8 +140,14 @@ def stresslet_near_block_ewald(trg, src, S, xi):
     (B_far is smooth and even), so there is no self term. Coincident pairs
     masked like `kernels.stresslet_block`.
     """
+    return stresslet_disp_block_ewald(trg[:, None, :] - src[None, :, :],
+                                      S, xi)
+
+
+def stresslet_disp_block_ewald(d, S, xi):
+    """`stresslet_near_block_ewald` on a precomputed displacement tile
+    ``d`` [t, s, 3] (the periodic evaluator min-images ``d`` first)."""
     g = 2.0 * xi / _SQRT_PI
-    d = trg[:, None, :] - src[None, :, :]
     r2 = jnp.sum(d * d, axis=-1)
     mask = r2 > 0.0
     r2s = jnp.where(mask, r2, 1.0)

@@ -1,0 +1,795 @@
+"""Periodic & slab-confined spectral Ewald Stokes evaluators (skelly-spectral).
+
+`ops.ewald` covers the FREE-SPACE fast path: its truncated-kernel trick
+buys an aperiodic answer from a periodic FFT. This module is the genuinely
+periodic twin — the workload class the reference serves through PVFMM's
+periodic wrappers (`kernels.hpp:56-134`) and ROADMAP item 3 names as the
+open gap: triply-periodic suspensions ("tp") and doubly-periodic
+slab-confined scenes ("dp": x/y periodic, z free), following the
+performance-portable spectral Ewald for Stokes (arXiv 2606.19059) and the
+linear-time doubly-periodic formulation's free-dimension treatment
+(arXiv 2210.01837).
+
+Mathematical structure (classic Hasimoto splitting over a lattice; every
+identity below is pinned by `tests/test_spectral.py` against dense lattice
+oracles):
+
+* Near field: the SAME screened real-space kernels as `ops.ewald`
+  (G_near ~ erfc(xi r)), summed over minimum images. The planner enforces
+  ``rc <= min(L_periodic)/2`` so the +-1 image shell is complete; the cell
+  list wraps per periodic axis and clips along the free axis.
+* Triply periodic far field: the k-space lattice multiplier is exactly the
+  textbook Hasimoto form
+      uhat(k) = (Phi(k) / (eta k^2)) (I - khat khat) fhat(k),
+      Phi(k)  = (1 + k^2/(4 xi^2)) e^{-k^2/(4 xi^2)},
+  carried in the same ``-Bhat (k^2 I - k k^T)/(8 pi eta)`` code shape as
+  `ewald._far_field` with ``Bhat_per(k) = -8 pi Phi(k)/k^4`` and the k = 0
+  mode dropped (zero-mean-flow convention, matched by the oracle). The FFT
+  box IS the physical box — no padding in periodic dims, and the window's
+  mod-M wrap is exact physics, not an approximation to control.
+* Doubly periodic far field: mixed lattice — x/y modes are discrete, z is
+  handled on a PADDED grid. For kperp != 0 the z-periodization error of
+  the padded box decays like e^{-|kperp| (Lz_grid - Dz)}, so the plan pads
+  ``Lz_grid >= Dz + (ln(1/tol) + 3) max(Lx, Ly)/(2 pi)``. The kperp = 0
+  column
+  (the xy-averaged flow, where the kernel grows ~|z|) gets the 1D
+  Vico-Greengard treatment: the exact column kernel
+      K1(z) = -(|z|/2) erf(xi |z|) - e^{-xi^2 z^2}/(4 xi sqrt(pi))
+  (the mollified |z| transform, constant pinned by K1 ~ -|z|/2 at large z)
+  is truncated at R_z > Dz and applied in k as
+      K1hat_R(kz) = -(T1(kz)/2) Phi(kz),
+      T1(k) = 2 (cos kR - 1)/k^2 + 2 R sin(kR)/k   (T1(0) = R^2),
+  exact for |z| < R_z - O(1/xi); it multiplies the x/y velocity channels
+  only ((I - khat khat)_zz = 0 on the column). The stresslet column is the
+  same story one derivative down: multiplier i Phi/(2 eta kz), kernel
+  K2(z) = -erf(xi z)/2 - (xi z/(2 sqrt(pi))) e^{-xi^2 z^2}, truncated
+  transform T_s(kz) = i (1 - cos kz R)/kz.
+* Spreading/interpolation: the separable truncated-Gaussian window of
+  `ops.ewald`, generalized to ANISOTROPIC grids — per-axis spacing h_i and
+  window variance tau_i = (P h_i)^2 / (16 ln(1/tol)), deconvolved by
+  dividing by the separable what(k)^2.
+
+The plan (`plan_spectral`) is bucket-quantized DATA, not a trace constant:
+grid dims snap onto the FFT-friendly ``GRID_RUNGS`` ladder (2^a 3^b,
+~x1.5 geometric — overridable through `BucketPolicy.grid_ladder`), extents
+and occupancy ride the same ladders as `plan_ewald`, and the two anchors
+(box_lo, cell_lo) enter traced so drifting scenes sharing a rung reuse one
+compiled program.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ewald import (_ladder, stokeslet_disp_block,
+                    stresslet_disp_block_ewald)
+
+__all__ = ["SpectralPlan", "plan_spectral", "stokeslet_spectral",
+           "stresslet_spectral", "strip_anchors", "plan_anchors",
+           "fill_positions", "GRID_RUNGS"]
+
+_SQRT_PI = math.sqrt(math.pi)
+
+#: FFT-friendly grid-dimension ladder (2^a 3^b, ~x1.5 geometric): the
+#: spectral analogue of the occupancy/node ladders — a drifting scene's
+#: grid requirement snaps UP onto a rung so the plan (the jit key) is
+#: stable until the requirement swings ~50%. Overridable per deployment
+#: through `system.buckets.BucketPolicy.grid_ladder`.
+GRID_RUNGS = (16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512)
+
+
+def _grid_rung(n, ladder):
+    """Smallest ladder rung >= n (the top rung caps oversized requests —
+    accuracy then degrades gracefully instead of compiling unbounded
+    grids)."""
+    for r in ladder:
+        if r >= n:
+            return int(r)
+    return int(ladder[-1])
+
+
+# ---------------------------------------------------------------------- plan
+
+@dataclass(frozen=True)
+class SpectralPlan:
+    """Static geometry/resolution of one periodic spectral-Ewald evaluation
+    (hashable; selects compiled programs). Anisotropic throughout: per-axis
+    grid dims, extents, window variances, and cell sizes — a slab's padded
+    free axis need not match its periodic axes.
+
+    ``box_lo``/``cell_lo`` are traced anchors exactly as in `EwaldPlan`:
+    strip them (`strip_anchors`) from the jit key and pass them as the
+    [2, 3] `plan_anchors` operand.
+    """
+
+    mode: str                 # "tp" (triply periodic) | "dp" (slab)
+    xi: float                 # splitting parameter
+    rc: float                 # near-field cutoff (<= min periodic L / 2)
+    Rz: float                 # dp kperp=0 column truncation radius (tp: 0)
+    box_lo: tuple             # FFT grid anchor (traced at run time)
+    box_L: tuple              # per-axis grid extents (periodic axes: the
+                              # physical box; dp z: padded free extent)
+    M3: tuple                 # per-axis grid points (GRID_RUNGS rungs)
+    P: int                    # window support (grid points per dim)
+    tau3: tuple               # per-axis Gaussian window variances
+    cell_lo: tuple            # near-field cell-lattice anchor (traced)
+    cells3: tuple             # per-axis cell counts (periodic axes tile
+                              # the box exactly: cells * cell_size = L)
+    cell_size3: tuple         # per-axis cell sizes (>= rc)
+    max_occ: int              # static per-cell capacity
+    eta: float
+
+    @property
+    def h3(self) -> tuple:
+        return tuple(L / m for L, m in zip(self.box_L, self.M3))
+
+    @property
+    def Lper(self) -> tuple:
+        """Periodic lengths with 0.0 marking the free axis."""
+        if self.mode == "tp":
+            return self.box_L
+        return (self.box_L[0], self.box_L[1], 0.0)
+
+
+def strip_anchors(plan: SpectralPlan) -> SpectralPlan:
+    """Zero the traced anchor fields — the hashable jit key for this plan."""
+    import dataclasses
+
+    return dataclasses.replace(plan, box_lo=(0.0, 0.0, 0.0),
+                               cell_lo=(0.0, 0.0, 0.0))
+
+
+def plan_anchors(plan: SpectralPlan, dtype=None):
+    """[2, 3] traced-operand anchors (box_lo, cell_lo)."""
+    return jnp.asarray([plan.box_lo, plan.cell_lo],
+                       dtype=dtype or jnp.float64)
+
+
+#: the R2 low-discrepancy lattice `ops.ewald` uses for padding placement
+_R2_ALPHAS = (0.8191725133961645, 0.6710436067037893, 0.5497004779019703)
+
+
+def fill_positions(plan: SpectralPlan, cell_lo, n, dtype):
+    """[n, 3] well-spread positions inside the near-field cell region for
+    zero-strength padding nodes (`ewald.fill_positions`, per-axis sizes)."""
+    t = (jnp.arange(n, dtype=dtype) + 0.5)[:, None]
+    alphas = jnp.asarray(_R2_ALPHAS, dtype=dtype)[None, :]
+    frac = (t * alphas) % 1.0
+    extent = ((jnp.asarray(plan.cells3, dtype=dtype) - 0.01)
+              * jnp.asarray(plan.cell_size3, dtype=dtype))
+    return jnp.asarray(cell_lo, dtype=dtype) + frac * extent
+
+
+def _fill_positions_np(plan_like, n):
+    """NumPy mirror of `fill_positions` for host-side occupancy counting."""
+    t = (np.arange(n, dtype=np.float64) + 0.5)[:, None]
+    frac = (t * np.asarray(_R2_ALPHAS)[None, :]) % 1.0
+    cell_lo, cells3, cell_size3 = plan_like
+    extent = (np.asarray(cells3, dtype=np.float64) - 0.01) \
+        * np.asarray(cell_size3, dtype=np.float64)
+    return np.asarray(cell_lo) + frac * extent
+
+
+def plan_spectral(points, box, eta, tol=1e-6, max_grid=512, target_occ=32.0,
+                  n_fill=0, grid_ladder=()):
+    """Choose (xi, rc, grid M3, window P, cell lattice) for a target
+    relative tolerance on a periodic box.
+
+    ``box`` is the periodic cell: 3 lengths -> triply periodic, 2 lengths
+    (Lx, Ly) -> doubly periodic slab with z free (extent measured from the
+    cloud, ladder-quantized). Host-side NumPy, once per step/geometry.
+
+    Parameter rules (shared calibration with `plan_ewald`, each pinned by
+    `tests/test_spectral.py`):
+      * rc from target cell occupancy, CAPPED at min(L_periodic)/2 so the
+        minimum-image +-1 cell shell is complete — the periodic analogue
+        of the free-space truncation-radius rule;
+      * xi = sqrt(ln(1/tol))/rc, k_max = 2 xi sqrt(ln(1/tol) + 4);
+      * per-axis M from k_max L_i / pi, snapped UP onto the `GRID_RUNGS`
+        (or ``grid_ladder``) FFT-friendly ladder; oversized requirements
+        relax xi through the same fixed-point loop as `plan_ewald` (rc
+        re-capped each round);
+      * dp only: R_z = D_z + (sqrt(ln 1/tol) + 3)/xi and
+        Lz_grid = D_z + max(R_z + 4/xi, (ln(1/tol) + 3) max(Lx,Ly)/(2 pi)) —
+        the truncated-column support plus the kperp != 0 z-periodization
+        margin, whichever is larger.
+
+    Every derived quantity is a function of ladder-quantized inputs, so
+    the plan — the jit compilation key — is stable while the geometry
+    drifts; the anchors hop on their own lattices and enter traced.
+    """
+    box = tuple(float(b) for b in box)
+    if len(box) not in (2, 3):
+        raise ValueError(
+            f"periodic box must have 2 (slab) or 3 (triply periodic) "
+            f"lengths, got {len(box)}")
+    if min(box) <= 0.0:
+        raise ValueError(f"periodic box lengths must be positive: {box}")
+    mode = "tp" if len(box) == 3 else "dp"
+    rungs = tuple(int(r) for r in (grid_ladder or GRID_RUNGS))
+    rungs_capped = tuple(r for r in rungs if r <= max_grid) or rungs[:1]
+
+    pts = np.asarray(points, dtype=np.float64).reshape(-1, 3)
+    lo = pts.min(axis=0) if len(pts) else np.zeros(3)
+    hi = pts.max(axis=0) if len(pts) else np.zeros(3)
+    logtol = math.log(1.0 / tol)
+    N = max(len(pts) + int(n_fill), 1)
+    N_q = max(1, 2 ** math.ceil(math.log2(N)))
+
+    if mode == "dp":
+        Dz = _ladder(max(float(hi[2] - lo[2]), 1e-3), 1e-3)
+        vol = box[0] * box[1] * Dz
+        min_Lper = min(box[0], box[1])
+    else:
+        Dz = 0.0
+        vol = box[0] * box[1] * box[2]
+        min_Lper = min(box)
+
+    rc = (target_occ * vol / N_q) ** (1.0 / 3.0)
+    rc = min(rc, min_Lper / 2.0)
+    xi = math.sqrt(max(logtol, 1.0)) / rc
+    P = max(6, min(26, int(math.ceil(logtol / 1.2)) + 2))
+
+    # fixed point for (xi, Rz, Lz_grid, M3) under the grid cap — the dp
+    # padded extent depends on xi, and a capped grid's k_max on the extent
+    k_rule = 2.0 * math.sqrt(logtol + 4.0)
+    Rz = 0.0
+    for _ in range(4):
+        if mode == "dp":
+            Rz = Dz + (math.sqrt(logtol) + 3.0) / xi
+            # +3 nats of headroom: at exactly logtol the smallest-kperp
+            # mode's image leakage e^{-kperp (Lz - Dz)} lands ON tol with
+            # a ~unit prefactor (measured 1.2e-6 at tol 1e-6 on a slab
+            # cloud); the extra margin drops it to ~5e-8.
+            pad_k = (logtol + 3.0) * max(box[0], box[1]) / (2.0 * math.pi)
+            Lz_grid = Dz + max(Rz + 4.0 / xi, pad_k)
+            L3 = (box[0], box[1], Lz_grid)
+        else:
+            L3 = box
+        M_req = [int(math.ceil(k_rule * xi * L / math.pi)) for L in L3]
+        if max(M_req) <= max_grid:
+            break
+        xi = (math.pi * max_grid / max(L3)) / k_rule
+        rc = min(math.sqrt(max(logtol, 1.0)) / xi, min_Lper / 2.0)
+        xi = math.sqrt(max(logtol, 1.0)) / rc
+    M3 = tuple(max(_grid_rung(m, rungs_capped),
+                   _grid_rung(2 * P, rungs_capped)) for m in M_req)
+    # window variance: measured on the periodic multiplier (tp cloud,
+    # tol 1e-6, P 14) — the /16 free-space balance leaves the truncation
+    # side dominant at 1.9e-6 rel err; /20 rebalances to 1.0e-7, and the
+    # aliasing side only reappears below /12
+    tau3 = tuple((P * L / M) ** 2 / (20.0 * logtol)
+                 for L, M in zip(L3, M3))
+
+    # near-field cell lattice: periodic axes tile the box EXACTLY
+    # (cells * cell_size = L, so the wrap-mod-C neighbor shell is the
+    # minimum-image shell); the free axis clips like `plan_ewald`
+    cell_size3 = []
+    cells3 = []
+    cell_lo = []
+    for ax in range(3):
+        if mode == "tp" or ax < 2:
+            L = box[ax]
+            C = max(int(L / rc), 1)
+            s = L / C
+            a = s * math.floor(float(lo[ax]) / s)
+        else:
+            s = max(rc, 1e-6)
+            ext_q = _ladder(max(float(hi[2] - lo[2]), 1e-3), 1e-3)
+            C = int(math.ceil(ext_q / s)) + 2
+            a = s * (math.floor(float(lo[2]) / s) - 1)
+        cell_size3.append(float(s))
+        cells3.append(int(C))
+        cell_lo.append(float(a))
+    cell_size3 = tuple(cell_size3)
+    cells3 = tuple(cells3)
+    cell_lo = tuple(cell_lo)
+
+    if mode == "dp":
+        center_z = float(lo[2] + hi[2]) / 2.0
+        anchor_z = cell_size3[2] * math.floor(center_z / cell_size3[2])
+        box_lo = (cell_lo[0], cell_lo[1], float(anchor_z - L3[2] / 2.0))
+    else:
+        box_lo = cell_lo
+
+    # host-side occupancy count (wrapped periodic coords + fill lattice)
+    def cell_index(p):
+        idx = np.empty((len(p), 3), dtype=np.int64)
+        for ax in range(3):
+            x = p[:, ax] - cell_lo[ax]
+            if mode == "tp" or ax < 2:
+                x = x - box[ax] * np.floor(x / box[ax])
+            i = np.floor(x / cell_size3[ax]).astype(np.int64)
+            idx[:, ax] = np.clip(i, 0, cells3[ax] - 1)
+        return idx
+
+    ci = cell_index(pts) if len(pts) else np.zeros((0, 3), np.int64)
+    if n_fill:
+        fp = _fill_positions_np((cell_lo, cells3, cell_size3), int(n_fill))
+        ci = np.vstack([ci, cell_index(fp)])
+    flat = (ci[:, 0] * cells3[1] + ci[:, 1]) * cells3[2] + ci[:, 2]
+    occ = int(np.bincount(flat, minlength=int(np.prod(cells3))).max()) \
+        if len(flat) else 1
+    # the same x1.5 / 8-aligned occupancy rungs as `plan_ewald`
+    need = occ * 1.15
+    rung = 8.0
+    while rung < need:
+        rung *= 1.5
+    occ = int(-8 * (-rung // 8))
+
+    return SpectralPlan(mode=mode, xi=float(xi), rc=float(rc), Rz=float(Rz),
+                        box_lo=box_lo, box_L=tuple(float(L) for L in L3),
+                        M3=M3, P=int(P), tau3=tau3, cell_lo=cell_lo,
+                        cells3=cells3, cell_size3=cell_size3, max_occ=occ,
+                        eta=float(eta))
+
+
+# ---------------------------------------------------------------- near field
+
+def _wrap_positions(plan: SpectralPlan, cell_lo, pts):
+    """Wrap periodic coordinates into [cell_lo, cell_lo + L); the free
+    axis passes through."""
+    L = jnp.asarray(plan.Lper, pts.dtype)
+    per = L > 0
+    Ls = jnp.where(per, L, 1.0)
+    return jnp.where(per, pts - Ls * jnp.floor((pts - cell_lo) / Ls), pts)
+
+
+def _min_image(d, Lper, dtype):
+    """Minimum-image displacement per periodic axis (free axes untouched)."""
+    L = jnp.asarray(Lper, dtype)
+    per = L > 0
+    Ls = jnp.where(per, L, 1.0)
+    return jnp.where(per, d - Ls * jnp.round(d / Ls), d)
+
+
+_NBR_OFFSETS = np.array([(i, j, k) for i in (-1, 0, 1)
+                         for j in (-1, 0, 1) for k in (-1, 0, 1)],
+                        dtype=np.int32)  # [27, 3]
+
+#: elements per near-field chunk tile (see `ewald._NEAR_TILE_BUDGET`)
+_NEAR_TILE_BUDGET = 3_000_000
+
+
+def _bucket_points_per(plan: SpectralPlan, cell_lo, pts, payload):
+    """Sort (wrapped) points into [prod(cells3), max_occ] padded buckets —
+    `ewald._bucket_points` with per-axis cell sizes."""
+    Cx, Cy, Cz = plan.cells3
+    C3 = Cx * Cy * Cz
+    cs = jnp.asarray(plan.cell_size3, pts.dtype)
+    ci = jnp.floor((pts - cell_lo) / cs).astype(jnp.int32)
+    ci = jnp.clip(ci, 0, jnp.asarray(plan.cells3, dtype=jnp.int32) - 1)
+    flat = (ci[:, 0] * Cy + ci[:, 1]) * Cz + ci[:, 2]
+    order = jnp.argsort(flat)
+    flat_s = flat[order]
+    pts_s = pts[order]
+    pay_s = payload[order]
+    counts = jnp.zeros(C3, dtype=jnp.int32).at[flat_s].add(1)
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                              jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    rank = jnp.arange(flat_s.shape[0], dtype=jnp.int32) - starts[flat_s]
+    rank = jnp.minimum(rank, plan.max_occ - 1)
+    slot = flat_s * plan.max_occ + rank
+    B = C3 * plan.max_occ
+    # far sentinel for empty slots: wrapped into the box by min-image but
+    # killed by zero payload (sources) / the occupancy mask (targets)
+    bpts = jnp.full((B, 3), 1e8, dtype=pts.dtype).at[slot].set(pts_s)
+    bpay = jnp.zeros((B,) + payload.shape[1:], dtype=payload.dtype
+                     ).at[slot].set(pay_s)
+    return (bpts.reshape(C3, plan.max_occ, 3),
+            bpay.reshape((C3, plan.max_occ) + payload.shape[1:]),
+            order, flat)
+
+
+def _neighbor_ids(plan: SpectralPlan):
+    """[C3, 27] neighbor cell ids (wrap on periodic axes, clip on the free
+    axis) + the first-occurrence dedup mask."""
+    Cx, Cy, Cz = plan.cells3
+    C3 = Cx * Cy * Cz
+    cid = jnp.arange(C3, dtype=jnp.int32)
+    cx, rem = cid // (Cy * Cz), cid % (Cy * Cz)
+    cy, cz = rem // Cz, rem % Cz
+    offs = jnp.asarray(_NBR_OFFSETS)
+
+    def move(c, off, C, periodic):
+        n = c[:, None] + off[None, :]
+        return n % C if periodic else jnp.clip(n, 0, C - 1)
+
+    nx = move(cx, offs[:, 0], Cx, True)
+    ny = move(cy, offs[:, 1], Cy, True)
+    nz = move(cz, offs[:, 2], Cz, plan.mode == "tp")
+    nid = (nx * Cy + ny) * Cz + nz
+    eq = nid[:, :, None] == nid[:, None, :]
+    tri = jnp.tril(jnp.ones((27, 27), dtype=bool), k=-1)
+    uniq = ~jnp.any(eq & tri[None], axis=2)
+    return nid, uniq
+
+
+def _near_field_per(plan: SpectralPlan, cell_lo, r_src, f_src, r_trg,
+                    near_fn):
+    """Periodic cell-list near field: dense screened tiles over the 27
+    wrap/clip neighbor cells with minimum-image displacements.
+
+    ``near_fn(d, payload, xi) -> [t, 3]`` is a displacement-tile kernel
+    (`ewald.stokeslet_disp_block` / `stresslet_disp_block_ewald`);
+    positions must already be wrapped (`_wrap_positions`).
+    """
+    Cx, Cy, Cz = plan.cells3
+    C3 = Cx * Cy * Cz
+    mo = plan.max_occ
+    Lper = plan.Lper
+    src_b, f_b, _, _ = _bucket_points_per(plan, cell_lo, r_src, f_src)
+    trg_b, idx_b, _, flat_t = _bucket_points_per(
+        plan, cell_lo, r_trg, jnp.arange(r_trg.shape[0], dtype=jnp.int32))
+    nid, uniq = _neighbor_ids(plan)
+
+    def per_cell(t_pts, n_ids, n_uniq):
+        s_pts = src_b[n_ids].reshape(-1, 3)          # [27 * mo, 3]
+        pay = f_b[n_ids]
+        mask = n_uniq.reshape((27,) + (1,) * (pay.ndim - 1))
+        s_f = jnp.where(mask, pay, 0.0).reshape((-1,) + f_b.shape[2:])
+        d = _min_image(t_pts[:, None, :] - s_pts[None, :, :], Lper,
+                       t_pts.dtype)
+        return near_fn(d, s_f, plan.xi)
+
+    chunk = max(1, min(C3, _NEAR_TILE_BUDGET // max(27 * mo * mo, 1)))
+    n_chunks = -(-C3 // chunk)
+    pad = n_chunks * chunk - C3
+
+    def padded(a, fill):
+        widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+        return jnp.pad(a, widths, constant_values=fill).reshape(
+            (n_chunks, chunk) + a.shape[1:])
+
+    u_b = lax.map(
+        lambda args: jax.vmap(per_cell)(*args),
+        (padded(trg_b, 1e8), padded(nid, 0), padded(uniq, False)))
+    u_b = u_b.reshape(n_chunks * chunk, mo, 3)[:C3]
+
+    counts_t = jnp.zeros(C3, dtype=jnp.int32).at[flat_t].add(1)
+    slot_rank = jnp.arange(C3 * mo, dtype=jnp.int32) % mo
+    valid = slot_rank < jnp.repeat(counts_t, mo)
+    out = jnp.zeros((r_trg.shape[0], 3), dtype=r_trg.dtype)
+    out = out.at[idx_b.reshape(-1)].add(
+        jnp.where(valid[:, None], u_b.reshape(-1, 3), 0.0))
+    return out / (8.0 * math.pi * plan.eta)
+
+
+# ----------------------------------------------------------------- far field
+
+def _window_1d_ax(x, h, tau, P, dtype):
+    """One-axis separable Gaussian window (per-axis h/tau — the grid is
+    anisotropic)."""
+    u = x / h
+    i0 = jnp.floor(u - (P - 1) / 2.0).astype(jnp.int32)
+    grid_pos = (i0[:, None]
+                + jnp.arange(P, dtype=jnp.int32)[None, :]).astype(dtype) * h
+    d = x[:, None] - grid_pos
+    return i0, jnp.exp(-d * d / (4.0 * tau))
+
+
+def _window_indices(plan: SpectralPlan, pts_local, dtype):
+    """Flat wrapped grid indices [N, P, P, P] + separable weight products.
+    The mod-M wrap is exact physics on periodic axes and the free-axis box
+    margin keeps wrapped kernel images outside every pair distance (the
+    `ewald._window_indices` argument)."""
+    Mx, My, Mz = plan.M3
+    hx, hy, hz = plan.h3
+    tx, ty, tz = plan.tau3
+    P = plan.P
+    ix, wx = _window_1d_ax(pts_local[:, 0], hx, tx, P, dtype)
+    iy, wy = _window_1d_ax(pts_local[:, 1], hy, ty, P, dtype)
+    iz, wz = _window_1d_ax(pts_local[:, 2], hz, tz, P, dtype)
+    p_idx = jnp.arange(P, dtype=jnp.int32)
+    gx = (ix[:, None] + p_idx[None, :]) % Mx
+    gy = (iy[:, None] + p_idx[None, :]) % My
+    gz = (iz[:, None] + p_idx[None, :]) % Mz
+    flat = ((gx[:, :, None, None] * My + gy[:, None, :, None]) * Mz
+            + gz[:, None, None, :])
+    w3 = (wx[:, :, None, None] * wy[:, None, :, None]
+          * wz[:, None, None, :])
+    return flat, w3
+
+
+#: elements per gridding chunk (see `ewald._GRID_CHUNK_BUDGET`)
+_GRID_CHUNK_BUDGET = 16_000_000
+
+
+def _point_chunks(plan: SpectralPlan, n):
+    P3 = plan.P ** 3
+    chunk = max(1, min(n, _GRID_CHUNK_BUDGET // P3))
+    return chunk, -(-n // chunk)
+
+
+def _spread(plan: SpectralPlan, pts_local, values, dtype):
+    """Type-1 gridding onto the [Mx, My, Mz, C] grid, point-chunked."""
+    Mx, My, Mz = plan.M3
+    n = pts_local.shape[0]
+    C = values.shape[-1]
+    chunk, n_chunks = _point_chunks(plan, n)
+    pad = n_chunks * chunk - n
+    pts_p = jnp.pad(pts_local, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 3)
+    val_p = jnp.pad(values, ((0, pad), (0, 0))).reshape(n_chunks, chunk, C)
+
+    def body(grid, args):
+        pts_c, val_c = args
+        flat, w3 = _window_indices(plan, pts_c, dtype)
+        contrib = w3[..., None] * val_c[:, None, None, None, :]
+        return grid.at[flat.reshape(-1)].add(contrib.reshape(-1, C)), None
+
+    grid, _ = lax.scan(body, jnp.zeros((Mx * My * Mz, C), dtype=dtype),
+                       (pts_p, val_p))
+    return grid.reshape(Mx, My, Mz, C)
+
+
+def _interp(plan: SpectralPlan, pts_local, grid, dtype):
+    """Type-2 interpolation of grid [Mx, My, Mz, C] at points, chunked."""
+    n = pts_local.shape[0]
+    C = grid.shape[-1]
+    chunk, n_chunks = _point_chunks(plan, n)
+    pad = n_chunks * chunk - n
+    pts_p = jnp.pad(pts_local, ((0, pad), (0, 0))).reshape(n_chunks, chunk, 3)
+    flat_grid = grid.reshape(-1, C)
+
+    def body(pts_c):
+        flat, w3 = _window_indices(plan, pts_c, dtype)
+        vals = flat_grid[flat.reshape(-1)].reshape(flat.shape + (C,))
+        return jnp.einsum("npqr,npqrk->nk", w3, vals)
+
+    out = lax.map(body, pts_p)
+    return out.reshape(n_chunks * chunk, C)[:n]
+
+
+def _kgrid_per(plan: SpectralPlan, dtype):
+    """Mixed-lattice spectral geometry: (kx, ky, kz, k2, scalar) where the
+    scalar folds the PERIODIC Hasimoto multiplier Bhat_per = -8 pi Phi/k^4
+    (k = 0 dropped), the anisotropic quadrature factor hx hy hz, the
+    separable window deconvolution, and 1/(8 pi eta)."""
+    Mx, My, Mz = plan.M3
+    hx, hy, hz = plan.h3
+    tx, ty, tz = plan.tau3
+    kx = (2.0 * math.pi * jnp.fft.fftfreq(Mx, d=hx)).astype(dtype)[
+        :, None, None]
+    ky = (2.0 * math.pi * jnp.fft.fftfreq(My, d=hy)).astype(dtype)[
+        None, :, None]
+    kz = (2.0 * math.pi * jnp.fft.rfftfreq(Mz, d=hz)).astype(dtype)[
+        None, None, :]
+    k2 = kx * kx + ky * ky + kz * kz
+    x = k2 / (4.0 * plan.xi * plan.xi)
+    ghat = (1.0 + x) * jnp.exp(-x)
+    k2s = jnp.where(k2 > 0, k2, 1.0)
+    Bhat = jnp.where(k2 > 0, -8.0 * math.pi * ghat / (k2s * k2s), 0.0)
+    what = (((4.0 * math.pi) ** 1.5) * math.sqrt(tx * ty * tz)
+            * jnp.exp(-(tx * kx * kx + ty * ky * ky + tz * kz * kz)))
+    scalar = Bhat * (hx * hy * hz) / (what * what) / (8.0 * math.pi
+                                                      * plan.eta)
+    return kx, ky, kz, k2, scalar
+
+
+def _t1_trunc(k, R):
+    """1D transform of ``|z| 1_{|z|<R}``: T1(k) = 2(cos kR - 1)/k^2
+    + 2 R sin(kR)/k, series R^2 (1 - (kR)^2/4 + (kR)^4/72) for small kR."""
+    kR = k * R
+    small = kR < 0.5
+    ks = jnp.where(small, 1.0, k)
+    T_exact = 2.0 * (jnp.cos(kR) - 1.0) / (ks * ks) \
+        + 2.0 * R * jnp.sin(kR) / ks
+    kR2 = kR * kR
+    T_series = R * R * (1.0 - kR2 / 4.0 + kR2 * kR2 / 72.0)
+    return jnp.where(small, T_series, T_exact)
+
+
+def _column_geometry(plan: SpectralPlan, dtype):
+    """Shared dp kperp = 0 column pieces: (kz [Mzh], Phi(kz), grid scale
+    hx hy hz / what(0, 0, kz)^2)."""
+    hx, hy, hz = plan.h3
+    tx, ty, tz = plan.tau3
+    Mz = plan.M3[2]
+    kz = (2.0 * math.pi * jnp.fft.rfftfreq(Mz, d=hz)).astype(dtype)
+    x = kz * kz / (4.0 * plan.xi * plan.xi)
+    ghat = (1.0 + x) * jnp.exp(-x)
+    what = (((4.0 * math.pi) ** 1.5) * math.sqrt(tx * ty * tz)
+            * jnp.exp(-tz * kz * kz))
+    return kz, ghat, (hx * hy * hz) / (what * what)
+
+
+def _column_stokeslet(plan: SpectralPlan, Hcol, dtype):
+    """dp kperp = 0 Stokeslet column: truncated 1D kernel
+    K1hat_R(kz) = -(T1(kz)/2) Phi(kz) on the x/y channels; the z channel
+    is zero ((I - khat khat)_zz = 0 on the column)."""
+    kz, ghat, scale = _column_geometry(plan, dtype)
+    s0 = (-0.5 * _t1_trunc(kz, plan.Rz)) * ghat * scale / plan.eta
+    return jnp.stack([s0 * Hcol[:, 0], s0 * Hcol[:, 1],
+                      jnp.zeros_like(Hcol[:, 2])], axis=-1)
+
+
+def _column_stresslet(plan: SpectralPlan, Hcol, dtype):
+    """dp kperp = 0 stresslet column: multiplier i Phi/(2 eta kz) with the
+    sign-kernel truncation T_s(kz) = i (1 - cos kz R)/kz; channel combos
+    u_x <- S_xz + S_zx, u_y <- S_yz + S_zy, u_z <- tr S (row-major 9)."""
+    kz, ghat, scale = _column_geometry(plan, dtype)
+    kzs = jnp.where(kz > 0, kz, 1.0)
+    Ts = jnp.where(kz > 0, (1.0 - jnp.cos(kz * plan.Rz)) / kzs, 0.0)
+    s0 = 1j * Ts * ghat * scale / (2.0 * plan.eta)
+    return jnp.stack([s0 * (Hcol[:, 2] + Hcol[:, 6]),
+                      s0 * (Hcol[:, 5] + Hcol[:, 7]),
+                      s0 * (Hcol[:, 0] + Hcol[:, 4] + Hcol[:, 8])], axis=-1)
+
+
+def _far_field(plan: SpectralPlan, lo, r_src, f_src, r_trg):
+    """Gridded periodic Stokeslet far field (tp: pure lattice multiplier;
+    dp: mixed lattice + truncated kperp = 0 column). Normalization is the
+    `ewald._far_field` bookkeeping made anisotropic: the grid multiplier is
+    Khat(k) hx hy hz / what(k)^2 and irfftn's 1/(Mx My Mz) supplies 1/V."""
+    dtype = r_src.dtype
+    Mx, My, Mz = plan.M3
+
+    with jax.named_scope("spread"):
+        H = _spread(plan, r_src - lo, f_src, dtype)
+    with jax.named_scope("fft"):
+        Hk = jnp.fft.rfftn(H, axes=(0, 1, 2))
+    with jax.named_scope("kspace"):
+        kx, ky, kz, k2, scalar = _kgrid_per(plan, dtype)
+        coeff = -scalar
+        kdotF = kx * Hk[..., 0] + ky * Hk[..., 1] + kz * Hk[..., 2]
+        Uk = jnp.stack([
+            coeff * (k2 * Hk[..., 0] - kx * kdotF),
+            coeff * (k2 * Hk[..., 1] - ky * kdotF),
+            coeff * (k2 * Hk[..., 2] - kz * kdotF),
+        ], axis=-1)
+        if plan.mode == "dp":
+            Uk = Uk.at[0, 0].set(_column_stokeslet(plan, Hk[0, 0], dtype))
+    with jax.named_scope("fft"):
+        U = jnp.fft.irfftn(Uk, s=(Mx, My, Mz), axes=(0, 1, 2))
+    with jax.named_scope("interp"):
+        return _interp(plan, r_trg - lo, U.astype(dtype), dtype)
+
+
+def _far_field_stresslet(plan: SpectralPlan, lo, r_dl, f_dl, r_trg):
+    """Gridded periodic stresslet far field: `ewald._far_field_stresslet`'s
+    9-channel multiplier on the periodic Bhat, plus the dp column."""
+    dtype = r_dl.dtype
+    Mx, My, Mz = plan.M3
+
+    with jax.named_scope("spread"):
+        H = _spread(plan, r_dl - lo, f_dl.reshape(-1, 9), dtype)
+    with jax.named_scope("fft"):
+        Hk = jnp.fft.rfftn(H, axes=(0, 1, 2))
+    with jax.named_scope("kspace"):
+        kx, ky, kz, k2, scalar = _kgrid_per(plan, dtype)
+        coeff = 1j * scalar
+        kv = (kx, ky, kz)
+        kSk = sum(kv[j] * kv[k] * Hk[..., 3 * j + k]
+                  for j in range(3) for k in range(3))
+        Uk = jnp.stack([
+            coeff * (kv[i] * kSk
+                     - 0.5 * k2 * (sum(kv[k] * (Hk[..., 3 * i + k]
+                                                + Hk[..., 3 * k + i])
+                                       for k in range(3))
+                                   + (Hk[..., 0] + Hk[..., 4] + Hk[..., 8])
+                                   * kv[i]))
+            for i in range(3)], axis=-1)
+        if plan.mode == "dp":
+            Uk = Uk.at[0, 0].set(_column_stresslet(plan, Hk[0, 0], dtype))
+    with jax.named_scope("fft"):
+        U = jnp.fft.irfftn(Uk, s=(Mx, My, Mz), axes=(0, 1, 2))
+    with jax.named_scope("interp"):
+        return _interp(plan, r_trg - lo, U.astype(dtype), dtype)
+
+
+# ------------------------------------------------------------ jitted entries
+
+@partial(jax.jit, static_argnames=("plan", "n_self"))
+def _stokeslet_spectral_impl(plan: SpectralPlan, anchors, r_src, r_trg,
+                             f_src, n_self: int):
+    """Jitted core; ``plan`` must be anchor-stripped and ``anchors`` is the
+    [2, 3] (box_lo, cell_lo) traced operand."""
+    lo_box = anchors[0].astype(r_src.dtype)
+    lo_cell = anchors[1].astype(r_src.dtype)
+    src_w = _wrap_positions(plan, lo_cell, r_src)
+    trg_w = _wrap_positions(plan, lo_cell, r_trg)
+    with jax.named_scope("near"):
+        u_near = _near_field_per(plan, lo_cell, src_w, f_src, trg_w,
+                                 near_fn=stokeslet_disp_block)
+    u_far = _far_field(plan, lo_box, src_w, f_src, trg_w)
+    if n_self:
+        # the wave-space sum at a coincident target includes only the
+        # p = 0 image's smooth G_far(0) — the free-space self coefficient;
+        # every p != 0 image term is a genuine periodic contribution
+        self_coeff = 4.0 * plan.xi / (_SQRT_PI * 8.0 * math.pi * plan.eta)
+        u_far = u_far.at[:n_self].add(-self_coeff * f_src[:n_self])
+    return u_near + u_far
+
+
+def stokeslet_spectral(plan: SpectralPlan, r_src, r_trg, f_src,
+                       n_self: int | None = None):
+    """Singular periodic Stokeslet sum via spectral Ewald.
+
+    Same calling convention as `ewald.stokeslet_ewald` (coincident self
+    pairs drop; ``n_self`` marks the leading targets coinciding with
+    ``r_src[:n_self]``, auto-detected by object identity), summed over the
+    periodic images of `plan`'s box with the zero-mean-flow (k = 0
+    dropped) convention. Positions may be unwrapped — both the cell list
+    and the spreading wrap them against the traced anchors.
+    """
+    if n_self is None:
+        n_self = r_src.shape[0] if r_trg is r_src else 0
+    return _stokeslet_spectral_impl(strip_anchors(plan),
+                                    plan_anchors(plan, r_src.dtype),
+                                    r_src, r_trg, f_src, int(n_self))
+
+
+@partial(jax.jit, static_argnames=("plan",))
+def _stresslet_spectral_impl(plan: SpectralPlan, anchors, r_dl, r_trg,
+                             f_dl):
+    lo_box = anchors[0].astype(r_dl.dtype)
+    lo_cell = anchors[1].astype(r_dl.dtype)
+    src_w = _wrap_positions(plan, lo_cell, r_dl)
+    trg_w = _wrap_positions(plan, lo_cell, r_trg)
+    with jax.named_scope("near"):
+        u_near = _near_field_per(plan, lo_cell, src_w, f_dl, trg_w,
+                                 near_fn=stresslet_disp_block_ewald)
+    u_far = _far_field_stresslet(plan, lo_box, src_w, f_dl, trg_w)
+    # no self term: every screened double-layer coefficient vanishes at
+    # r = 0 (`ewald.stresslet_near_block_ewald`)
+    return u_near + u_far
+
+
+def stresslet_spectral(plan: SpectralPlan, r_dl, r_trg, f_dl):
+    """Singular periodic stresslet (double-layer) sum via spectral Ewald
+    (``f_dl`` [n_src, 3, 3], same semantics as `ewald.stresslet_ewald`)."""
+    return _stresslet_spectral_impl(strip_anchors(plan),
+                                    plan_anchors(plan, r_dl.dtype),
+                                    r_dl, r_trg, f_dl)
+
+
+# ---------------------------------------------------------------- skelly-audit
+
+def auditable_programs():
+    """The periodic fast path's audit entry: the jitted spectral Stokeslet
+    on a triply-periodic cloud. Its contract pins that the evaluator is
+    collective-free single-chip, callback-free, carries the state dtype end
+    to end, owns a PINNED fft inventory (the first registered program with
+    fft primitives — the `fft-inventory` check exists for it), and compiles
+    once across a cell-lattice anchor hop with drifted positions."""
+    from ..audit.registry import AuditProgram, built_from
+
+    def make_scene():
+        rng = np.random.default_rng(17)
+        box = (4.0, 4.0, 4.0)
+        pts = rng.uniform(0.0, 4.0, (256, 3))
+        f = rng.standard_normal((256, 3))
+        plan = plan_spectral(pts, box, eta=1.0, tol=1e-4)
+        return plan, jnp.asarray(pts), jnp.asarray(f)
+
+    def build():
+        plan, pts, f = make_scene()
+        return built_from(_stokeslet_spectral_impl, strip_anchors(plan),
+                          plan_anchors(plan), pts, pts, f, pts.shape[0])
+
+    def retrace_probe():
+        from ..testing import trace_counting_jit
+
+        plan, pts, f = make_scene()
+        step = trace_counting_jit(_stokeslet_spectral_impl.__wrapped__,
+                                  static_argnames=("plan", "n_self"))
+        step(strip_anchors(plan), plan_anchors(plan), pts, pts, f,
+             pts.shape[0])
+        # anchor hop + drifted positions: same program, must not retrace
+        step(strip_anchors(plan), plan_anchors(plan) + plan.cell_size3[0],
+             pts + 0.01, pts + 0.01, f, pts.shape[0])
+        return step.trace_count
+
+    return [AuditProgram(
+        name="stokeslet_spectral", layer="ops",
+        summary="periodic spectral-Ewald Stokeslet evaluator (triply "
+                "periodic cloud, FFT far field + wrapped near tiles, f64)",
+        build=build, retrace_probe=retrace_probe)]

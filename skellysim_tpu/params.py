@@ -135,13 +135,30 @@ class Params:
     # barycentric Lagrange treecode (`ops.treecode` — the hierarchical
     # answer to the same FMM slot: fixed-depth octree, static interaction
     # lists, MXU-batched cluster matmuls), composing with both the
-    # single-chip solve and the SPMD step (docs/treecode.md)
+    # single-chip solve and the SPMD step (docs/treecode.md); "spectral" =
+    # the O(N log N) particle-mesh Ewald far field over a periodic or
+    # slab-confined box (`ops.spectral`, docs/spectral.md — requires
+    # `periodic_box`), the PVFMM slot for the reference's periodic scenes
     pair_evaluator: str = "direct"
     # target relative accuracy of the Ewald evaluator; in "mixed" solver
     # precision the Ewald path serves only the f32 Krylov interior (the f64
     # refinement residual stays on the dense double-float tile), so 1e-6
     # does not cap the converged residual
     ewald_tol: float = 1e-6
+    # periodic boundary of the simulation box for the "spectral" evaluator
+    # (the slot the reference serves through PVFMM's periodic kernels):
+    # () = free space (every other evaluator), a 3-tuple (Lx, Ly, Lz) =
+    # triply periodic, a 2-tuple (Lx, Ly) = doubly periodic slab (x/y
+    # periodic, z free — arXiv 2210.01837's confined formulation). Static
+    # config: it shapes the FFT grid, so it selects compiled programs like
+    # every other Params field
+    periodic_box: tuple = ()
+    # target relative accuracy of the spectral Ewald evaluator
+    # (`ops.spectral.plan_spectral` derives xi, the window width P, and the
+    # rung-snapped grid dims from it). Same f32-Krylov role gating as
+    # ewald_tol: in "mixed" precision the spectral path serves the f32
+    # interior only, so it does not cap the converged residual
+    spectral_tol: float = 1e-6
     # target relative accuracy of the treecode evaluator
     # (`ops.treecode.plan_tree` picks interpolation order p from it via the
     # measured ~5x-per-order contraction rule, and octree depth from the

@@ -284,8 +284,10 @@ def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct
     pad the *target* rows (see `System._ring_pad_targets`).
 
     ``evaluator="ewald"`` (with a plan covering shell nodes + targets) sums
-    the double layer in O(N log N) via the spectral-Ewald stresslet, and
-    ``evaluator="tree"`` via the barycentric-treecode stresslet — the
+    the double layer in O(N log N) via the free-space Ewald stresslet,
+    ``evaluator="tree"`` via the barycentric-treecode stresslet, and
+    ``evaluator="spectral"`` via the periodic particle-mesh stresslet
+    (`ops.spectral.stresslet_spectral`) — the
     reference's one-evaluator-serves-all design (`periphery.cpp:337-352`
     routes the shell's stresslet through the FMM). The shell's
     SELF-interaction is not computed here in any mode: `System._apply_matvec`
@@ -317,6 +319,13 @@ def flow(shell: PeripheryState, r_trg, density, eta, *, evaluator: str = "direct
                                        shell.nodes, r_trg, f_dl)
         # the screened kernels scale as 1/eta and the plan baked plan.eta in
         return vel * (ewald_plan.eta / eta)
+    if (pair is not None and evaluator == "spectral"
+            and pair.plan is not None):
+        from ..ops import spectral as spec
+
+        vel = spec._stresslet_spectral_impl(pair.plan, pair_anchors,
+                                            shell.nodes, r_trg, f_dl)
+        return vel * (pair.plan.eta / eta)
     if evaluator == "ring" and mesh is not None:
         src = shell.nodes
         pad = (-src.shape[0]) % mesh.size

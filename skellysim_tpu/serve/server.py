@@ -117,6 +117,9 @@ class SimulationServer:
         # ([runtime] ladders of the server's config); [serve]
         # bucket_capacities remains the manual single-resolution override
         self.policy = bucket_mod.BucketPolicy.from_runtime(runtime_cfg)
+        # spectral grid rungs are plan data, not state shapes — they ride
+        # the System (cli.py does the same for single runs)
+        system.grid_ladder = self.policy.grid_ladder
         base_n = self._fiber_count(base_state)
         single = isinstance(base_state.fibers, fc.FiberGroup)
         caps = sorted(set(serve_cfg.bucket_capacities))

@@ -100,9 +100,15 @@ class BucketPolicy:
     fiber_ladder: tuple = ()
     node_ladder: tuple = VALID_NODE_COUNTS
     shell_ladder: tuple = ()
+    #: spectral-evaluator FFT grid-dimension ladder; () = the built-in
+    #: 2^a 3^b rungs (`ops.spectral.GRID_RUNGS`). Unlike the capacity
+    #: ladders this quantizes PLAN data (grid dims), not state shapes —
+    #: `System.make_spectral_plan` threads it into `plan_spectral`.
+    grid_ladder: tuple = ()
 
     def __post_init__(self):
-        for name in ("fiber_ladder", "node_ladder", "shell_ladder"):
+        for name in ("fiber_ladder", "node_ladder", "shell_ladder",
+                     "grid_ladder"):
             lad = tuple(int(v) for v in getattr(self, name))
             if list(lad) != sorted(set(lad)) or any(v < 1 for v in lad):
                 raise ValueError(
@@ -130,7 +136,8 @@ class BucketPolicy:
         return cls(
             fiber_ladder=fib,
             node_ladder=tuple(runtime.node_ladder) or VALID_NODE_COUNTS,
-            shell_ladder=tuple(runtime.shell_ladder))
+            shell_ladder=tuple(runtime.shell_ladder),
+            grid_ladder=tuple(getattr(runtime, "grid_ladder", ())))
 
     # ------------------------------------------------------------- rungs
 
@@ -220,13 +227,19 @@ def bucketize(state, policy: BucketPolicy = None, *, node_multiple: int = 1,
             int(state.shell.node_mask.sum()) if state.shell.node_mask
             is not None else state.shell.n_nodes)
         if cap is not None:
-            if pair_evaluator in ("ewald", "tree"):
+            if pair_evaluator in ("ewald", "tree", "spectral"):
+                live = (int(state.shell.node_mask.sum())
+                        if state.shell.node_mask is not None
+                        else state.shell.n_nodes)
                 raise ValueError(
                     "shell_ladder padding is incompatible with the fast "
-                    f"summation evaluators (pair_evaluator={pair_evaluator!r}"
-                    "): padded quadrature rows replicate node 0 and would "
-                    "overflow the planner's static cell/leaf buckets; use "
-                    "'direct' or 'ring', or drop [runtime] shell_ladder")
+                    "summation evaluators ('ewald'/'tree'/'spectral'; this "
+                    f"config selects {pair_evaluator!r} and the shell would "
+                    f"pad {live} -> {cap} quadrature rows): padded rows "
+                    "replicate node 0 and would overflow the planner's "
+                    "static cell/leaf/occupancy buckets (see periphery."
+                    "grow_capacity); use 'direct' or 'ring', or drop "
+                    "[runtime] shell_ladder")
             from ..periphery import periphery as peri
 
             if cap != state.shell.n_nodes or state.shell.node_mask is None:

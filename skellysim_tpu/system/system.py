@@ -203,11 +203,29 @@ class System:
             raise ValueError(
                 f"unknown kernel_impl {params.kernel_impl!r}; "
                 "use 'exact', 'mxu', 'df', 'pallas', or 'pallas_df'")
+        if params.pair_evaluator == "spectral":
+            if len(params.periodic_box) not in (2, 3) or any(
+                    L <= 0 for L in params.periodic_box):
+                raise ValueError(
+                    "pair_evaluator='spectral' needs params.periodic_box — "
+                    "(Lx, Ly, Lz) for a triply periodic box or (Lx, Ly) for "
+                    f"a doubly periodic slab; got {params.periodic_box!r}. "
+                    "For free space use 'ewald' or 'tree'.")
+        elif params.periodic_box:
+            raise ValueError(
+                f"params.periodic_box is set but pair_evaluator "
+                f"{params.pair_evaluator!r} sums free-space kernels and "
+                "would ignore the periodic images; use "
+                "pair_evaluator='spectral'")
         self.params = params
         self.shell_shape = shell_shape
         # device mesh for the ring pair evaluator (params.pair_evaluator="ring");
         # GSPMD sharding via parallel.shard_state needs no mesh here
         self.mesh = mesh
+        # spectral-evaluator FFT grid ladder (`make_spectral_plan`); the
+        # CLIs/listener set it from `BucketPolicy.grid_ladder` after
+        # construction, () = the built-in `ops.spectral.GRID_RUNGS`
+        self.grid_ladder: tuple = ()
         if params.refine_pair_impl not in REFINE_PAIR_IMPLS:
             raise ValueError(
                 f"unknown refine_pair_impl {params.refine_pair_impl!r}; "
@@ -1244,6 +1262,23 @@ class System:
         pts, n_fill, _ = self._plan_points(state, extra_targets)
         return plan_tree(pts, tol=self.params.tree_tol, n_fill=n_fill)
 
+    def make_spectral_plan(self, state: SimState, extra_targets=None):
+        """Host-side spectral Ewald plan over the `_plan_points` cloud
+        (`ops.spectral.plan_spectral`) for `params.periodic_box` — the
+        periodic analogue of `make_ewald_plan`. Grid dims snap onto the
+        `grid_ladder` rungs (skelly-bucket's `[runtime] grid_ladder`, or
+        the built-in 2^a 3^b ladder), so the plan — the jit key — is
+        stable under drift: in a triply-periodic box it depends only on
+        the box, tolerances, and occupancy rungs; in a slab only the
+        ladder-quantized z extent can move it."""
+        from ..ops.spectral import plan_spectral
+
+        pts, n_fill, _ = self._plan_points(state, extra_targets)
+        return plan_spectral(pts, self.params.periodic_box,
+                             eta=self.params.eta,
+                             tol=self.params.spectral_tol, n_fill=n_fill,
+                             grid_ladder=self.grid_ladder)
+
     def _pair_args(self, state: SimState, extra_targets=None):
         """(`PairEvaluator` spec, traced anchors) for the configured fast
         evaluator, or (None, None) for the dense/ring paths. The ONE place
@@ -1252,13 +1287,13 @@ class System:
         treecode PR: adding a fourth evaluator must not grow every
         signature again)."""
         ev = self.params.pair_evaluator
-        if ev not in ("ewald", "tree"):
+        if ev not in ("ewald", "tree", "spectral"):
             return None, None
         from ..ops.evaluator import make_pair
 
-        plan = (self.make_ewald_plan(state, extra_targets=extra_targets)
-                if ev == "ewald"
-                else self.make_tree_plan(state, extra_targets=extra_targets))
+        maker = {"ewald": self.make_ewald_plan, "tree": self.make_tree_plan,
+                 "spectral": self.make_spectral_plan}[ev]
+        plan = maker(state, extra_targets=extra_targets)
         return make_pair(ev, self.params.kernel_impl, plan)
 
     def step(self, state: SimState):
@@ -1328,17 +1363,19 @@ class System:
             self._spmd_steps[key] = fn
         return fn(state, anchors) if pair is not None else fn(state)
 
-    def trial_step(self, state: SimState):
+    def trial_step(self, state: SimState, pair=None, pair_anchors=None):
         """The pure, un-jitted trial step: (new_state, solution, info) with a
         per-member `StepInfo`. This is the batch-steppable seam the ensemble
         subsystem (`skellysim_tpu.ensemble`) maps over a stacked member axis
         — `jax.vmap(system.trial_step)` batches the whole prep/GMRES/advance
         pipeline, because GMRES already keeps its control flow in `lax`
-        primitives (solver/gmres.py "batching" note). Dense evaluators only:
-        the ewald/tree plans are built host-side per step and cannot live
-        inside a closed batched trace (the ensemble runner rejects them up
-        front)."""
-        return self._solve_impl(state)
+        primitives (solver/gmres.py "batching" note). Host-REBUILT plans
+        (ewald/tree) cannot live inside a closed batched trace, so the
+        ensemble runner rejects those evaluators up front; the spectral
+        plan is bucket-quantized data that never rebuilds under drift, so
+        the runner builds the ``pair`` spec once and threads it (with its
+        traced ``pair_anchors``) through every batched call."""
+        return self._solve_impl(state, pair=pair, pair_anchors=pair_anchors)
 
     def collision(self, state: SimState):
         """Pure collision gate (traced bool) — the adaptive loop's reject

@@ -306,8 +306,14 @@ def test_fmm_evaluator_name_maps_to_ewald(tmp_path):
     assert rt2.pair_evaluator == "ewald"
     rt3 = schema.to_runtime_params(schema.Params(pair_evaluator="CPU"))
     assert rt3.pair_evaluator == "direct"
+    # "spectral" graduated from unknown to the fifth evaluator (PR 17);
+    # "PVFMM" — the reference's periodic engine — aliases onto it
+    rt4 = schema.to_runtime_params(schema.Params(pair_evaluator="spectral"))
+    assert rt4.pair_evaluator == "spectral"
+    rt5 = schema.to_runtime_params(schema.Params(pair_evaluator="PVFMM"))
+    assert rt5.pair_evaluator == "spectral"
     with pytest.raises(ValueError, match="unknown pair_evaluator"):
-        schema.to_runtime_params(schema.Params(pair_evaluator="spectral"))
+        schema.to_runtime_params(schema.Params(pair_evaluator="octopus"))
 
 
 def test_deformable_body_rejected_at_schema_validation(tmp_path):
