@@ -38,6 +38,20 @@ echo "== audit: skelly-fence Pallas DMA-race/VMEM verifier (docs/audit.md) =="
 # against the fast tier's 780 s budget guard.
 JAX_PLATFORMS=cpu python -m skellysim_tpu.audit --check dma
 
+echo "== audit: skelly-maskflow padded-lane non-interference (docs/audit.md) =="
+# taint analysis over BOTH matrices (programs and Pallas kernels), in
+# EVERY tier: every padded capacity axis declared in [[mask.axes]] is
+# statically proven unable to contaminate live physics — no pad-escape,
+# no 0*inf multiplicative masking, no unmasked reductions or
+# unsentineled argreduces — and every output's pad class (pad-exact-zero
+# / pad-passthrough / live-only) matches its [mask.outputs] pin. Zero
+# suppressions except di_device's two documented config_rank
+# rank-ledger reads. The full audit below re-covers this; the explicit
+# gate keeps the masking exit code visible on its own. Measured ~25 s
+# for the 16-entry matrix (<2 s per program; dominated by tracing, not
+# analysis) — noise against the fast tier's 780 s budget guard.
+python -m skellysim_tpu.audit --check mask
+
 echo "== audit: skelly-audit lowered-program contracts (docs/audit.md) =="
 # the compiled-program twin of the lint gate, in EVERY tier: every
 # registered entry point (single-chip step, step_spmd on 2/4/8-device
@@ -49,7 +63,8 @@ echo "== audit: skelly-audit lowered-program contracts (docs/audit.md) =="
 # discipline"): the d2/d4/d8 mesh programs must statically PROVE they
 # cannot deadlock (no varying while/cond predicates, no collectives under
 # divergence, replicated outputs verified) with zero suppressions, plus
-# the skelly-fence `dma` check over the Pallas kernel registry. Fails
+# the skelly-fence `dma` check over the Pallas kernel registry and the
+# skelly-maskflow `mask` check gated above. Fails
 # on any unsuppressed finding or unused suppression. (Bootstraps its own
 # 8-device CPU + x64 backend.)
 python -m skellysim_tpu.audit

@@ -141,6 +141,121 @@ def fft_inventory(closed_jaxpr):
 DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
 
 
+_PAD_CLASSES = ("pad-exact-zero", "pad-passthrough", "live-only")
+
+
+def mask_axes_from_contract(spec, name):
+    """([MaskAxis], [Finding]) from a contract's `[mask]` section: each
+    `[[mask.axes]]` entry needs a `name` and a `mask` input path;
+    `scope`/`dim`/`inputs` refine which input leaves it guards."""
+    from . import maskflow
+
+    axes, out = [], []
+    seen = set()
+    for i, e in enumerate(spec.get("axes", [])):
+        ax_name, mask = e.get("name"), e.get("mask")
+        if not ax_name or not mask:
+            out.append(Finding(name, "mask", (
+                f"[[mask.axes]] entry #{i + 1} needs both `name` and "
+                "`mask` (the boolean live-mask input path)")))
+            continue
+        if ax_name in seen:
+            out.append(Finding(name, "mask", (
+                f"duplicate mask axis name {ax_name!r} — each capacity "
+                "axis declares exactly once")))
+            continue
+        seen.add(ax_name)
+        inputs = tuple(sorted((e.get("inputs") or {}).items()))
+        axes.append(maskflow.MaskAxis(
+            name=ax_name, mask=mask, scope=e.get("scope"),
+            dim=int(e.get("dim", 0)), inputs=inputs))
+    return axes, out
+
+
+def mask_summary(built, axes):
+    """(report, observed) — the maskflow analysis plus the contract-shaped
+    `[mask]` dict ``--dump-contract`` emits (outputs table only when
+    capacity axes are declared: with none, every output is trivially
+    live-only and pins would be noise)."""
+    from . import maskflow
+
+    kernel_jaxpr = getattr(built, "kernel_jaxpr", None)
+    if kernel_jaxpr is not None:
+        report = maskflow.analyze(kernel_jaxpr, axes=())
+        return report, {"axes": []}
+    report = maskflow.analyze(built.closed_jaxpr, axes,
+                              built.in_paths, built.out_paths)
+    observed = {"axes": []}
+    if axes:
+        observed["outputs"] = dict(report.observed)
+    return report, observed
+
+
+def check_mask(name, built, contract, probe):
+    """skelly-maskflow (`audit.maskflow`, docs/audit.md "Masking
+    discipline"): taint/non-interference analysis proving padded lanes,
+    nodes, and leaves cannot contaminate live physics. Runs over BOTH
+    matrices: programs declare their capacity masks (pytree input paths)
+    in `[[mask.axes]]` and pin every output's pad class in
+    `[mask.outputs]`; Pallas kernels (no pytree inputs) get the
+    declaration-free detectors only (`0 * inf` multiplicative masking)."""
+    out = []
+    cid = "mask"
+    spec = contract.get("mask")
+    if spec is None:
+        out.append(Finding(name, cid, (
+            "no [mask] section — declare the program's padded-capacity "
+            "axes (`axes = []` when nothing is padded) so the masking "
+            "discipline is pinned, not assumed (run --dump-contract "
+            "for the observed surface)")))
+        return out
+    is_kernel = getattr(built, "kernel_jaxpr", None) is not None
+    axes, ax_findings = mask_axes_from_contract(spec, name)
+    out.extend(ax_findings)
+    if is_kernel and (axes or spec.get("outputs")):
+        out.append(Finding(name, cid, (
+            "kernel contracts cannot declare mask axes or output pins — "
+            "Pallas kernel refs have no pytree paths; only the "
+            "declaration-free detectors apply")))
+        axes = []
+    report, _ = mask_summary(built, axes)
+    for f in report.findings:
+        out.append(Finding(name, cid, f.message))
+    if is_kernel:
+        return out
+    pins = dict(spec.get("outputs", {}))
+    if not axes:
+        if pins:
+            out.append(Finding(name, cid, (
+                "stale [mask.outputs] table: no capacity axes are "
+                "declared, so every output is trivially live-only — "
+                "drop the pins or declare the axes")))
+        return out
+    observed = report.observed
+    for path in observed:
+        pin = pins.pop(path, None)
+        if pin is None:
+            out.append(Finding(name, cid, (
+                f"output '{path}' has no [mask.outputs] pin — every "
+                f"output of a padded program must pin its pad class "
+                f"(observed: {observed[path]})")))
+        elif pin not in _PAD_CLASSES:
+            out.append(Finding(name, cid, (
+                f"output '{path}' pins unknown pad class {pin!r} "
+                f"(known: {', '.join(_PAD_CLASSES)})")))
+        elif pin != observed[path]:
+            out.append(Finding(name, cid, (
+                f"output '{path}' pad class drifted: contract pins "
+                f"{pin!r}, the analyzer proves {observed[path]!r} — "
+                "an output moved across the padded/live boundary; "
+                "re-derive the pin deliberately")))
+    for path, pin in sorted(pins.items()):
+        out.append(Finding(name, cid, (
+            f"stale pin: [mask.outputs] pins '{path}' = {pin!r} but the "
+            "traced program has no such output path")))
+    return out
+
+
 def replication_summary(closed_jaxpr):
     """(report, observed) — the repflow analysis plus its contract-shaped
     summary dict (what ``--dump-contract`` emits as ``[replication]``)."""
@@ -418,9 +533,12 @@ class Check:
     run: object  # callable(name, built, contract, probe) -> [Finding]
     #: needs the (possibly expensive) retrace probe instead of artifacts
     wants_probe: bool = False
-    #: runs over the Pallas kernel registry (`kernels.all_kernels`), not
-    #: the program matrix — ``built`` is a `registry.BuiltKernel`
+    #: runs over the Pallas kernel registry (`kernels.all_kernels`) —
+    #: ``built`` is a `registry.BuiltKernel` there
     over_kernels: bool = False
+    #: runs over the program matrix (`programs.all_programs`); a check
+    #: may cover both matrices (mask) or exactly one (dma: kernels only)
+    over_programs: bool = True
 
 
 CHECKS = (
@@ -458,5 +576,12 @@ CHECKS = (
           "registry: read-before-arrival, overwrite-in-flight (barrier "
           "protocol model-checked), semaphore credit balance, VMEM "
           "footprint vs the shared budget",
-          check_dma, over_kernels=True),
+          check_dma, over_kernels=True, over_programs=False),
+    Check("mask",
+          "skelly-maskflow taint analysis over programs AND kernels: "
+          "padded capacity slots provably cannot contaminate live "
+          "physics (pad-escape, 0*inf multiplicative masking, unmasked "
+          "reductions, unsentineled argreduces; per-output pad-class "
+          "pins)",
+          check_mask, over_kernels=True),
 )

@@ -107,12 +107,12 @@ def main(argv=None) -> int:
         progs = [p for p in progs if p.name in set(args.program)]
         kerns = [k for k in kerns if k.name in set(args.program)]
 
-    # --check filters route each matrix to its own checks: program checks
-    # over the program matrix, kernel-scoped checks (dma) over the Pallas
-    # kernel registry — a `--check dma` run never pays a program lowering
+    # --check filters route each matrix to the checks that cover it: a
+    # `--check dma` run never pays a program lowering, and `--check mask`
+    # covers BOTH matrices (programs and Pallas kernels)
     if args.check is not None:
         selected = set(args.check)
-        if not selected & {c.id for c in CHECKS if not c.over_kernels}:
+        if not selected & {c.id for c in CHECKS if c.over_programs}:
             progs = []
         if not selected & {c.id for c in CHECKS if c.over_kernels}:
             kerns = []

@@ -28,7 +28,7 @@ CONTRACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: typo'd section would otherwise silently stop gating)
 _KNOWN_SECTIONS = ("program", "collectives", "dtype", "host_sync",
                    "donation", "retrace", "fft", "replication", "dma",
-                   "suppress")
+                   "mask", "suppress")
 
 
 @dataclass(frozen=True)
@@ -126,8 +126,8 @@ def run_program_audit(prog, contract=None, checks=None):
             return findings
     else:
         findings = []
-    # kernel-scoped checks (dma) belong to `run_kernel_audit`'s matrix
-    program_checks = tuple(c for c in CHECKS if not c.over_kernels)
+    # kernel-only checks (dma) belong to `run_kernel_audit`'s matrix
+    program_checks = tuple(c for c in CHECKS if c.over_programs)
     active_ids = (None if checks is None
                   else {c.id for c in program_checks if c.id in set(checks)})
     try:
@@ -177,8 +177,26 @@ def dump_kernel_contract(kern) -> str:
     from . import dmaflow
 
     report = dmaflow.analyze(kern.build())
-    data = {"program": {"name": kern.name}, "dma": dict(report.observed)}
+    data = {"program": {"name": kern.name}, "dma": dict(report.observed),
+            "mask": {"axes": []}}
     return toml_io.dumps(data)
+
+
+def _mask_section(name, built):
+    """The observed `[mask]` dict for ``--dump-contract``: axes come from
+    the EXISTING contract (the declaration is a human decision, not an
+    observation), the per-output pad classes from the analyzer."""
+    from .checks import mask_axes_from_contract, mask_summary
+
+    existing = {}
+    path = contract_path(name)
+    if os.path.exists(path):
+        existing = toml_io.load(path).get("mask", {})
+    axes, _ = mask_axes_from_contract(existing, name)
+    _, observed = mask_summary(built, axes)
+    if existing.get("axes"):
+        observed["axes"] = existing["axes"]
+    return observed
 
 
 def dump_contract(prog) -> str:
@@ -217,6 +235,7 @@ def dump_contract(prog) -> str:
     _, replication = replication_summary(built.closed_jaxpr)
     if replication is not None:
         data["replication"] = replication
+    data["mask"] = _mask_section(prog.name, built)
     text = toml_io.dumps(data)
     if weak:
         text += ("\n# NOTE: weak-typed promotions observed (always findings;"

@@ -1,0 +1,1371 @@
+"""Mask-flow analysis: statically prove padded capacity cannot leak.
+
+Every scaling lever in this tree rides the same trick: fix the shapes, pad
+the scene, mask the garbage — capacity buckets (`system.buckets`), DI
+nucleation as in-trace mask flips (`scenarios.di_device`), quarantined
+ensemble lanes (`ensemble.runner`), the treecode's power-of-two leaf
+buckets, the spectral evaluator's rung ladders. The soundness of those
+~176 mask sites used to rest on bitwise runtime tests and comment prose.
+This module is the machine check (docs/audit.md "Masking discipline"): a
+taint / non-interference abstract interpreter over closed jaxprs. The
+contract declares which boolean inputs are capacity masks (pytree paths
+like ``0.fibers.active``) and which input leaves they guard; the analyzer
+tracks, per value and per (mask axis, array dimension), what the padded
+slots hold, and reports four finding kinds:
+
+* ``pad-escape`` — padded-slot garbage arithmetically mixed into live
+  entries reaches a program output: the contamination itself.
+* ``nan-unsafe-neutralization`` — multiplicative masking (``x * mask``)
+  of a possibly-nonfinite float: ``0 * inf = NaN``, so the "masked"
+  value poisons everything downstream. Flagged unless the operand is
+  proven finite; ``jnp.where(mask, x, 0)`` is exact for every x.
+* ``unmasked-reduction`` — a sum/max/min/prod (or dot_general
+  contraction, prefix scan, sort) over a padded dimension whose padded
+  slots still hold garbage, or hold values that are not the reduction's
+  neutral element (zeros are neutral for sum, NOT for max/min/prod).
+* ``unsentineled-argreduce`` — argmax/argmin over a padded dimension
+  without the matching ∓inf sentinel (``where(mask, x, -inf)`` for
+  argmax): provenance ids — the flight recorder's anomaly attribution —
+  could name a padded lane.
+
+The lattice
+-----------
+
+Per value, per declared mask axis ``A`` and array dimension ``d``, the
+padded slots are in one of five classes::
+
+    DIRTY   input-pad garbage (grow_capacity replicates stale rows)
+    ZERO    exactly zero       (neutral for sum; safe to contract away)
+    SNEG    exactly -inf       (the argmax sentinel)
+    SPOS    exactly +inf       (the argmin sentinel)
+    CLEAN   live-derived values (no region recorded): no garbage, but
+            nothing provable about the padded slots either
+
+``jnp.where(mask, x, fill)`` with a declared mask is the class-setting
+discipline: padded slots take the fill branch, so a literal ``0`` fill
+proves ZERO, ``-inf`` proves SNEG, and any clean fill proves CLEAN.
+DIRTY regions contaminate on mixing (reductions, contractions, prefix
+scans, sorts over the padded dim); ZERO regions are transparent to
+additive mixing only. Contamination is tracked per value (``escaped``)
+and sticks — once garbage reaches live entries no later select can
+un-mix it.
+
+Each program output is classified (the contract's ``[mask.outputs]``
+pins)::
+
+    pad-passthrough   padded slots still carry DIRTY/sentinel data
+    pad-exact-zero    padded slots provably zero (bitwise; the
+                      skelly-bucket "masked rows solve to exact zeros"
+                      claim, checked instead of trusted)
+    live-only         no padded structure survives to this output
+
+Soundness is directional, like repflow: "analyzes clean" is a proof
+modulo the modeled primitive set (unknown primitives degrade DIRTY
+regions to escaped, never to clean), while a finding on a deliberate
+site is suppressed in the contract with a reason. Two documented
+precision choices: program *inputs* are assumed finite (live physics
+data; runtime nonfiniteness is the flight recorder's job), and a select
+under an arbitrary comparison guard launders nonfiniteness (the
+``where(r > 0, 1/r, 0)`` self-interaction guard is treated as guarding —
+the nan-unsafe finding targets UNguarded multiplicative masking).
+
+Import-light by design (no jax import), reusing repflow's recursion
+machinery: while/scan fixed points, pjit/cond/custom_* recursion, and
+the integer constant folder for index provenance.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+
+from .repflow import _fold, _is_literal, _shape, _sub_jaxpr
+
+#: finding kinds (messages lead with the kind so suppressions can match)
+PAD_ESCAPE = "pad-escape"
+NAN_UNSAFE = "nan-unsafe-neutralization"
+UNMASKED_REDUCTION = "unmasked-reduction"
+UNSENTINELED_ARGREDUCE = "unsentineled-argreduce"
+
+#: output pad classes (the `[mask.outputs]` contract vocabulary)
+PAD_PASSTHROUGH = "pad-passthrough"
+PAD_EXACT_ZERO = "pad-exact-zero"
+LIVE_ONLY = "live-only"
+
+#: region classes, worst first
+DIRTY = "dirty"
+SNEG = "sneg"
+SPOS = "spos"
+ZERO = "zero"
+_RANK = {DIRTY: 3, SNEG: 2, SPOS: 2, ZERO: 1}
+
+_DEBUG = os.environ.get("SKELLY_MASKFLOW_DEBUG", "") not in ("", "0")
+
+
+# --------------------------------------------------------------- the lattice
+
+@dataclass(frozen=True)
+class MState:
+    """Abstract state of one value (see module docstring).
+
+    ``regions``: frozenset of ``(axis, dim, cls)`` — what the padded
+    slots of mask axis ``axis`` hold along array dimension ``dim``.
+    ``escaped``: mask axes whose pad garbage has mixed into LIVE entries
+    of this value (sticky). ``mask``: ``(axis, dims, live_polarity)``
+    when the value IS a declared capacity mask (or its negation).
+    ``boolish``: value is boolean / a 0-1 cast of one (the multiplicative
+    -masking detector's trigger). ``nonfinite``: may hold inf/NaN even
+    with finite program inputs. ``const``: known uniform scalar value.
+    """
+
+    regions: frozenset = frozenset()
+    escaped: frozenset = frozenset()
+    mask: tuple | None = None
+    boolish: bool = False
+    nonfinite: bool = False
+    const: float | None = None
+
+    def cls(self, axis, dim):
+        for a, d, c in self.regions:
+            if a == axis and d == dim:
+                return c
+        return None
+
+    def region_dims(self):
+        return {(a, d) for a, d, _ in self.regions}
+
+    def __repr__(self):  # compact for debug logs
+        bits = []
+        if self.regions:
+            bits.append("regions=" + ",".join(
+                f"{a}@{d}:{c}" for a, d, c in sorted(self.regions)))
+        if self.escaped:
+            bits.append(f"escaped={sorted(self.escaped)}")
+        if self.mask:
+            bits.append(f"mask={self.mask}")
+        if self.boolish:
+            bits.append("boolish")
+        if self.nonfinite:
+            bits.append("nonfinite")
+        if self.const is not None:
+            bits.append(f"const={self.const}")
+        return "M(" + " ".join(bits) + ")" if bits else "M(clean)"
+
+
+CLEAN_STATE = MState()
+
+
+def _worst(*classes):
+    """Worst region class among ``classes`` (None = clean loses to all);
+    mismatched sentinels are garbage to each other (-inf vs +inf)."""
+    present = [c for c in classes if c is not None]
+    if not present:
+        return None
+    if len(set(present)) > 1 and {SNEG, SPOS} <= set(present):
+        return DIRTY
+    return max(present, key=lambda c: _RANK[c])
+
+
+def join(a: MState, b: MState) -> MState:
+    """Control-flow join (cond branches, loop fixed points): pad classes
+    must agree to survive — a slot that is zero on one path and clean on
+    the other is provably neither."""
+    if a == b:
+        return a
+    regions = set()
+    for axis, dim in a.region_dims() | b.region_dims():
+        ca, cb = a.cls(axis, dim), b.cls(axis, dim)
+        if ca == cb:
+            c = ca
+        elif DIRTY in (ca, cb):
+            c = DIRTY          # maybe-garbage joins to garbage
+        else:
+            c = None           # differing exact claims join to unprovable
+        if c is not None:
+            regions.add((axis, dim, c))
+    return MState(
+        regions=frozenset(regions),
+        escaped=a.escaped | b.escaped,
+        mask=a.mask if a.mask == b.mask else None,
+        boolish=a.boolish and b.boolish,
+        nonfinite=a.nonfinite or b.nonfinite,
+        const=a.const if a.const == b.const else None)
+
+
+def join_all(states):
+    out = CLEAN_STATE
+    for s in states:
+        out = join(out, s)
+    return out
+
+
+def _escape(states, extra=frozenset()):
+    """Conservative fallback: any DIRTY/sentinel region whose alignment
+    an unmodeled primitive would lose is escalated to escaped (never
+    silently laundered to clean)."""
+    esc = set(extra)
+    for s in states:
+        esc |= s.escaped
+        for a, _, c in s.regions:
+            if c != ZERO:
+                esc.add(a)
+    return MState(escaped=frozenset(esc),
+                  nonfinite=any(s.nonfinite for s in states))
+
+
+# ------------------------------------------------------------------ findings
+
+@dataclass(frozen=True)
+class MaskFinding:
+    kind: str
+    message: str
+
+
+@dataclass
+class MaskReport:
+    findings: list      # [MaskFinding], program order, deduped
+    classes: list       # [(output path, pad class)], flat-output order
+
+    @property
+    def observed(self):
+        return {path: cls for path, cls in self.classes}
+
+
+@dataclass(frozen=True)
+class MaskAxis:
+    """One declared capacity axis (a `[[mask.axes]]` contract entry).
+
+    ``mask`` is the flat-input path of the boolean live mask (True =
+    live). ``scope``+``dim``: every input leaf under the ``scope`` path
+    prefix whose shape at dims ``dim..dim+mask_ndim-1`` matches the
+    mask's shape is padded there. ``inputs`` maps explicit paths to
+    their pad dim for leaves outside the scope.
+    """
+
+    name: str
+    mask: str
+    scope: str | None = None
+    dim: int = 0
+    inputs: tuple = ()          # ((path, dim), ...)
+
+
+# ------------------------------------------------------------------ helpers
+
+_ZERO_PRESERVING = frozenset((
+    "add", "sub", "mul", "neg", "abs", "max", "min", "square", "sqrt",
+    "sign", "floor", "ceil", "round", "real", "imag", "copy",
+    "stop_gradient", "convert_element_type", "reduce_precision",
+    "device_put", "transpose"))
+
+#: ops that can mint inf/NaN from finite operands (the nan-unsafe set;
+#: exp-family overflow-to-inf is deliberately below the abstraction)
+_NONFINITE_SOURCES = frozenset((
+    "div", "rsqrt", "log", "log1p", "pow", "tan", "atanh", "acosh",
+    "digamma", "lgamma", "rem", "erf_inv"))
+
+_CMP = frozenset(("eq", "ne", "lt", "le", "gt", "ge", "is_finite"))
+
+_ELEMENTWISE = frozenset("""
+add sub mul div rem max min pow integer_pow exp exp2 log log1p expm1 sqrt
+rsqrt cbrt sign neg abs floor ceil round is_finite eq ne lt le gt ge and or
+xor not convert_element_type stop_gradient copy real imag conj erf erfc
+erf_inv tanh sin cos tan asin acos atan atan2 sinh cosh asinh acosh atanh
+logistic clamp nextafter square reduce_precision shift_left
+shift_right_logical shift_right_arithmetic population_count clz device_put
+copy_p logistic digamma lgamma
+""".split())
+
+_PASSTHROUGH = frozenset((
+    "convert_element_type", "copy", "stop_gradient", "reduce_precision",
+    "device_put", "copy_p", "real"))
+
+
+def _is_float(atom) -> bool:
+    dt = str(getattr(atom.aval, "dtype", ""))
+    return dt.startswith("float") or dt.startswith("bfloat") or (
+        dt.startswith("complex"))
+
+
+def _is_bool(atom) -> bool:
+    return str(getattr(atom.aval, "dtype", "")) == "bool"
+
+
+def _scalar_const(val):
+    """(const, nonfinite, boolish) of a literal / uniform ndarray."""
+    try:
+        import numpy as np
+
+        arr = np.asarray(val)
+        if arr.dtype == bool:
+            if arr.size == 1:
+                return float(bool(arr.reshape(-1)[0])), False, True
+            return None, False, True
+        if arr.size == 1 and arr.dtype.kind in "iuf":
+            v = float(arr.reshape(-1)[0])
+            return v, not math.isfinite(v), False
+    except Exception:
+        pass
+    return None, False, False
+
+
+def _dim_map_reshape(in_shape, out_shape, dim):
+    """Output dim(s) carrying input dim ``dim`` across a row-major reshape:
+    an int, a tuple of consecutive dims (``dim`` was SPLIT, e.g. the
+    ``[N, 3] -> [blocks, block, 3]`` chunking before a scan — pad slots
+    then scatter over every split dim), or None when alignment is lost.
+
+    Exact for squeeze/unsqueeze of size-1 dims, for splits of ``dim``, and
+    for the row-major flatten family (``[nf, n, ...] -> [nf*n, ...]``)
+    when ``dim`` is the MAJOR merged dim — the pad structure stays a
+    contiguous block per padded slot, so region/mask alignment survives
+    (node_active_flat's ``repeat`` + flatten discipline)."""
+    in_real = [(i, d) for i, d in enumerate(in_shape) if d != 1]
+    out_real = [(i, d) for i, d in enumerate(out_shape) if d != 1]
+    if [d for _, d in in_real] == [d for _, d in out_real]:
+        if in_shape[dim] == 1:
+            return None
+        pos = [i for i, _ in in_real].index(dim)
+        return out_real[pos][0]
+    if 0 in in_shape or 0 in out_shape:
+        return None
+    ii = oi = 0
+    while ii < len(in_shape) and oi < len(out_shape):
+        # grow an m:n group [ii, ij) <-> [oi, oj) of equal extent
+        ip, op, ij, oj = in_shape[ii], out_shape[oi], ii + 1, oi + 1
+        while ip != op:
+            if ip < op:
+                if ij >= len(in_shape):
+                    return None
+                ip *= in_shape[ij]
+                ij += 1
+            else:
+                if oj >= len(out_shape):
+                    return None
+                op *= out_shape[oj]
+                oj += 1
+        if ii <= dim < ij:
+            if ij - ii == 1 and oj - oi == 1:
+                return oi
+            if ij - ii == 1:
+                # pure split: a pad slot lands at mixed coordinates, the
+                # claim spreads over EVERY split dim (realign may narrow)
+                return tuple(range(oi, oj))
+            if dim != ii:
+                return None     # minor merged dim: alignment lost
+            # dim is the group's MAJOR in dim: each pad slot is one
+            # contiguous block of prod(in minors) elements, which covers
+            # whole out-major slots iff it is a multiple of the out minor
+            # extent ([nf, 3n] -> [nf*n, 3]: blocks of 3n = n rows of 3)
+            in_minor = math.prod(in_shape[ii + 1:ij])
+            out_minor = math.prod(out_shape[oi + 1:oj])
+            return oi if in_minor % out_minor == 0 else None
+        ii, oi = ij, oj
+    return None
+
+
+def _src(eqn):
+    """Best-effort user ``file:line`` for an equation, '' when unknown —
+    findings without a source frame are still findings, just harder to
+    triage."""
+    if eqn is None:
+        return ""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is None:
+            return ""
+        return f"{os.path.basename(frame.file_name)}:{frame.start_line}"
+    except Exception:  # pragma: no cover - jax-internal API drift
+        return ""
+
+
+# --------------------------------------------------------------- interpreter
+
+class _Analyzer:
+    def __init__(self):
+        self._findings = {}          # message -> MaskFinding (ordered dedupe)
+        self._cache = {}             # (id(jaxpr), states) -> out states
+
+    def _finding(self, kind, message, eqn=None):
+        src = _src(eqn)
+        msg = f"{kind}: {message}" + (f" [{src}]" if src else "")
+        if msg not in self._findings:
+            self._findings[msg] = MaskFinding(kind, msg)
+
+    # -- reads -------------------------------------------------------------
+    @staticmethod
+    def _read(env, atom):
+        if _is_literal(atom):
+            const, nonfin, boolish = _scalar_const(atom.val)
+            return MState(const=const, nonfinite=nonfin, boolish=boolish)
+        return env.get(atom, CLEAN_STATE)
+
+    @staticmethod
+    def _read_val(vals, atom):
+        if _is_literal(atom):
+            try:
+                import numpy as np
+
+                arr = np.asarray(atom.val)
+                if arr.ndim == 0 and arr.dtype.kind in "iub":
+                    return int(arr)
+            except Exception:
+                return None
+            return None
+        return vals.get(atom)
+
+    # -- drivers -----------------------------------------------------------
+    def run_jaxpr(self, jaxpr, in_states, path, record, consts=None):
+        if not record:
+            key = (id(jaxpr), tuple(in_states))
+            hit = self._cache.get(key)
+            if hit is not None:
+                return hit
+        env = {}
+        vals = {}
+        for i, v in enumerate(tuple(getattr(jaxpr, "constvars", ()))):
+            st = CLEAN_STATE
+            if consts is not None and i < len(consts):
+                const, nonfin, boolish = _scalar_const(consts[i])
+                st = MState(const=const, nonfinite=nonfin, boolish=boolish)
+            env[v] = st
+        for v, s in zip(jaxpr.invars, in_states):
+            env[v] = s
+        for eqn in jaxpr.eqns:
+            ins = [self._read(env, a) for a in eqn.invars]
+            in_vals = [self._read_val(vals, a) for a in eqn.invars]
+            outs = self._eqn(eqn, ins, in_vals, path, record)
+            for var, s in zip(eqn.outvars, outs):
+                env[var] = self._dtype_clamp(var, s)
+            for var, v in zip(eqn.outvars, _fold(eqn, in_vals)):
+                if v is not None:
+                    vals[var] = v
+        res = [self._read(env, a) for a in jaxpr.outvars]
+        if not record:
+            self._cache[key] = res
+        return res
+
+    def run_closed(self, closed, in_states, path, record):
+        return self.run_jaxpr(_sub_jaxpr(closed), in_states, path, record,
+                              consts=getattr(closed, "consts", None))
+
+    @staticmethod
+    def _dtype_clamp(var, s):
+        """Non-float outputs cannot hold inf/NaN; bool outputs are
+        boolish by construction."""
+        dt = str(getattr(var.aval, "dtype", ""))
+        if dt == "bool" and not s.boolish:
+            s = MState(s.regions, s.escaped, s.mask, True, False, s.const)
+        elif s.nonfinite and not (dt.startswith("float")
+                                  or dt.startswith("bfloat")
+                                  or dt.startswith("complex")):
+            s = MState(s.regions, s.escaped, s.mask, s.boolish, False,
+                       s.const)
+        return s
+
+    # -- equation dispatch -------------------------------------------------
+    def _eqn(self, eqn, ins, in_vals, path, record):
+        name = eqn.primitive.name
+        n_out = len(eqn.outvars)
+
+        if name == "select_n":
+            return [self._select(eqn, ins, path, record)]
+        if name == "while":
+            return self._while(eqn, ins, path, record)
+        if name == "cond":
+            return self._cond(eqn, ins, path, record)
+        if name == "scan":
+            return self._scan(eqn, ins, path, record)
+        if name == "pjit":
+            label = eqn.params.get("name", "")
+            return self.run_closed(eqn.params["jaxpr"], ins,
+                                   f"{path}/jit:{label}", record)
+        if name == "shard_map":
+            return self.run_jaxpr(_sub_jaxpr(eqn.params["jaxpr"]), ins,
+                                  f"{path}/shard_map", record)
+
+        if name == "optimization_barrier":
+            return list(ins)       # multi-value identity
+        if name in _ELEMENTWISE:
+            return [self._elementwise(name, eqn, ins, path, record)] * n_out
+        h = _SHAPED.get(name)
+        if h is not None:
+            out = h(self, eqn, ins, in_vals, path, record)
+            return out if isinstance(out, list) else [out] * n_out
+
+        # generic call-like primitive: one sub-jaxpr whose invars match
+        for key in ("call_jaxpr", "jaxpr", "fun_jaxpr"):
+            obj = eqn.params.get(key)
+            sub = _sub_jaxpr(obj) if obj is not None else None
+            if sub is not None and len(sub.invars) == len(ins):
+                return self.run_jaxpr(sub, ins, f"{path}/{name}", record)
+
+        if _DEBUG and any(
+                c != ZERO for s in ins for _, _, c in s.regions):
+            print(f"maskflow: escalate via unmodeled `{name}` at {path}")
+        return [_escape(ins)] * n_out
+
+    # -- elementwise -------------------------------------------------------
+    def _elementwise(self, name, eqn, ins, path, record):
+        escaped = frozenset().union(*[s.escaped for s in ins]) if ins \
+            else frozenset()
+        nonfinite = any(s.nonfinite for s in ins)
+        if name in _NONFINITE_SOURCES and _is_float(eqn.outvars[0]):
+            if not (name == "div" and ins[1].const not in (None, 0.0)):
+                nonfinite = True
+        boolish = False
+        mask = None
+        const = None
+
+        if name in _CMP:
+            boolish, nonfinite = True, False
+        elif name in ("and", "or", "xor", "not"):
+            boolish = all(s.boolish for s in ins)
+            if name == "not" and ins[0].mask is not None:
+                a, dims, pol = ins[0].mask
+                mask = (a, dims, not pol)
+            elif name == "and":
+                # False-at-pads survives an AND with anything
+                for s in ins:
+                    if s.mask is not None and s.mask[2]:
+                        mask = s.mask
+            elif name == "or":
+                for s in ins:
+                    if s.mask is not None and not s.mask[2]:
+                        mask = s.mask
+        elif name in _PASSTHROUGH and len(ins) == 1:
+            s = ins[0]
+            return MState(s.regions, s.escaped, s.mask, s.boolish,
+                          s.nonfinite and _is_float(eqn.outvars[0]),
+                          s.const)
+
+        if name == "mul" and len(ins) == 2 and (
+                ins[0].boolish != ins[1].boolish):
+            m_side = ins[0] if ins[0].boolish else ins[1]
+            if _is_float(eqn.outvars[0]):
+                other = ins[1] if m_side is ins[0] else ins[0]
+                if other.nonfinite and record:
+                    self._finding(NAN_UNSAFE, (
+                        f"multiplicative masking at {path or '<top>'}: a "
+                        "0/1 mask multiplies a possibly-nonfinite float "
+                        "(0 * inf = NaN poisons the masked slot) — use "
+                        "jnp.where(mask, x, 0.0), which is exact for "
+                        "every x"), eqn)
+                if (m_side.mask is not None and m_side.mask[2]
+                        and not other.nonfinite):
+                    a, dims, _ = m_side.mask
+                    regions = {(a, d, ZERO) for d in dims}
+                    regions |= {(ax, d, c) for ax, d, c in other.regions
+                                if (ax, d) not in {(a, d) for d in dims}}
+                    return MState(frozenset(regions), escaped, None, False,
+                                  False, None)
+
+        # per-(axis, dim) class combination
+        regions = set()
+        all_dims = frozenset().union(*[s.region_dims() for s in ins])
+        for axis, dim in all_dims:
+            classes = [s.cls(axis, dim) for s in ins]
+            if name == "and" and any(c == ZERO for c in classes):
+                # False/0 pads absorb anything bitwise — garbage included
+                # (`active & (binding_body >= 0)` stays False at pads)
+                c = ZERO
+            elif any(c == DIRTY for c in classes):
+                c = DIRTY
+            elif any(c in (SNEG, SPOS) for c in classes):
+                # sentinel arithmetic is nonfinite garbage outside its
+                # one sanctioned consumer (argmax/argmin)
+                c = DIRTY if len(ins) > 1 else _worst(*classes)
+            elif name == "mul" and any(
+                    c == ZERO and not any(
+                        s.nonfinite for s in ins) for c in classes):
+                c = ZERO
+            elif all(c == ZERO for c in classes) and (
+                    name in _ZERO_PRESERVING):
+                c = ZERO
+            elif (len(ins) == 1 and classes[0] == ZERO
+                    and name in _ZERO_PRESERVING):
+                c = ZERO
+            else:
+                c = None
+            if c is not None:
+                regions.add((axis, dim, c))
+
+        if all(s.const is not None for s in ins) and len(ins) <= 2:
+            try:
+                if name == "add":
+                    const = ins[0].const + ins[1].const
+                elif name == "sub":
+                    const = ins[0].const - ins[1].const
+                elif name == "mul":
+                    const = ins[0].const * ins[1].const
+                elif name == "neg":
+                    const = -ins[0].const
+            except (OverflowError, IndexError):
+                const = None
+        return MState(frozenset(regions), escaped, mask, boolish,
+                      nonfinite, const)
+
+    # -- select ------------------------------------------------------------
+    def _select(self, eqn, ins, path, record):
+        pred, cases = ins[0], ins[1:]
+        escaped = pred.escaped
+        if pred.mask is not None and len(cases) == 2:
+            axis, dims, pol = pred.mask
+            pad_branch = cases[0] if pol else cases[1]
+            live_branch = cases[1] if pol else cases[0]
+            regions = set()
+            mask_dims = set(dims)
+            for d in dims:
+                if pad_branch.const == 0.0:
+                    regions.add((axis, d, ZERO))
+                elif pad_branch.const == float("-inf"):
+                    regions.add((axis, d, SNEG))
+                elif pad_branch.const == float("inf"):
+                    regions.add((axis, d, SPOS))
+                else:
+                    c = pad_branch.cls(axis, d)
+                    if c is not None:
+                        regions.add((axis, d, c))
+            for s in cases:
+                for ax, d, c in s.regions:
+                    if ax == axis and d in mask_dims:
+                        continue          # overridden by the mask select
+                    cj = _worst(*[x.cls(ax, d) for x in cases])
+                    if cj is not None:
+                        regions.add((ax, d, cj))
+            out_boolish = all(s.boolish for s in cases)
+            return MState(frozenset(regions),
+                          escaped | live_branch.escaped,
+                          live_branch.mask if out_boolish else None,
+                          out_boolish, live_branch.nonfinite, None)
+        # arbitrary-guard select: branches join; a DIRTY pred region means
+        # the pads choose by garbage; nonfinite is laundered (the
+        # where(r > 0, 1/r, 0) guard pattern — see module docstring)
+        regions = set()
+        all_dims = frozenset().union(*[s.region_dims() for s in ins])
+        for axis, dim in all_dims:
+            classes = [s.cls(axis, dim) for s in cases]
+            if pred.cls(axis, dim) == DIRTY:
+                c = DIRTY
+            elif any(c == DIRTY for c in classes):
+                c = DIRTY
+            elif all(c == classes[0] for c in classes):
+                c = classes[0]
+            else:
+                c = _worst(*classes) if all(
+                    c is not None for c in classes) else None
+            if c is not None:
+                regions.add((axis, dim, c))
+        return MState(
+            frozenset(regions),
+            escaped | frozenset().union(*[s.escaped for s in cases]),
+            None, all(s.boolish for s in cases),
+            all(s.nonfinite for s in cases),
+            cases[0].const if all(
+                s.const == cases[0].const for s in cases) else None)
+
+    # -- structured control flow ------------------------------------------
+    def _while(self, eqn, ins, path, record):
+        p = eqn.params
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        bconsts = ins[cn:cn + bn]
+        carry = list(ins[cn + bn:])
+        for _ in range(64):            # lattice height bounds this far lower
+            outs = self.run_closed(p["body_jaxpr"], bconsts + carry, path,
+                                   False)
+            new = [join(c, o) for c, o in zip(carry, outs)]
+            if new == carry:
+                break
+            carry = new
+        if record:
+            self.run_closed(p["cond_jaxpr"], ins[:cn] + carry,
+                            f"{path}/while.cond", True)
+            self.run_closed(p["body_jaxpr"], bconsts + carry,
+                            f"{path}/while.body", True)
+        return carry
+
+    def _cond(self, eqn, ins, path, record):
+        pred, ops = ins[0], ins[1:]
+        outs = None
+        for i, b in enumerate(eqn.params["branches"]):
+            b_outs = self.run_closed(b, ops, f"{path}/cond.br{i}", record)
+            outs = (b_outs if outs is None
+                    else [join(a, c) for a, c in zip(outs, b_outs)])
+        if pred.escaped or any(c == DIRTY for _, _, c in pred.regions):
+            extra = pred.escaped | frozenset(
+                a for a, _, c in pred.regions if c == DIRTY)
+            outs = [MState(o.regions, o.escaped | extra, o.mask, o.boolish,
+                           o.nonfinite, o.const) for o in outs]
+        return outs
+
+    def _scan(self, eqn, ins, path, record):
+        p = eqn.params
+        nc, ncar = p["num_consts"], p["num_carry"]
+        consts, carry = ins[:nc], list(ins[nc:nc + ncar])
+        xs = [_shift_regions(s, -1) for s in ins[nc + ncar:]]
+        for _ in range(64):
+            outs = self.run_closed(p["jaxpr"], consts + carry + xs, path,
+                                   False)
+            new = [join(c, o) for c, o in zip(carry, outs[:ncar])]
+            if new == carry:
+                break
+            carry = new
+        outs = self.run_closed(p["jaxpr"], consts + carry + xs,
+                               f"{path}/scan", record)
+        ys = [_shift_regions(s, +1) for s in outs[ncar:]]
+        return carry + ys
+
+
+def _shift_regions(s, delta):
+    """Scan unstacks xs along dim 0 (regions shift down) and restacks ys
+    (regions shift up); a region ON the scanned dim itself degrades —
+    the scan mixes its slices into the carry."""
+    if not s.regions and s.mask is None:
+        return s
+    regions = set()
+    dropped = set()
+    for a, d, c in s.regions:
+        nd = d + delta
+        if nd < 0:
+            if c != ZERO:
+                dropped.add(a)
+            continue
+        regions.add((a, nd, c))
+    # a claim lost on the scanned dim only escapes when NO sibling claim
+    # for the axis survives: after a chunk-split ([N] -> [nb, block]) the
+    # within-chunk region still covers every padded slot of the axis, so
+    # the per-iteration slice stays attributable
+    escaped = set(s.escaped) | {
+        a for a in dropped if not any(ra == a for ra, _, _ in regions)}
+    mask = s.mask
+    if mask is not None:
+        a, dims, pol = mask
+        nd = tuple(d + delta for d in dims)
+        mask = (a, nd, pol) if all(d >= 0 for d in nd) else None
+    return MState(frozenset(regions), frozenset(escaped), mask, s.boolish,
+                  s.nonfinite, s.const)
+
+
+# ----------------------------------------------------- shape-aware transfers
+
+def _remap(s, dim_map, escaped_extra=frozenset(), realign=None):
+    """Rebuild a state's regions/mask through a dim mapping (None = dim
+    dropped: DIRTY/sentinel escalates to escaped, ZERO is laundered; a
+    tuple = the dim was split). On a split the claim lands on every split
+    dim — a reduction over ANY of them mixes pad slots into live rows —
+    unless ``realign(axis, dims)`` names the one dim that re-aligns with
+    the axis's mask (the ``[nf*n, 3] -> [nf, n, 3]`` unflatten)."""
+    regions = set()
+    escaped = set(s.escaped) | set(escaped_extra)
+    for a, d, c in s.regions:
+        nd = dim_map(d)
+        if nd is None:
+            if c != ZERO:
+                escaped.add(a)
+        elif isinstance(nd, tuple):
+            one = realign(a, nd) if realign is not None else None
+            if one is not None:
+                regions.add((a, one, c))
+            else:
+                regions.update((a, x, c) for x in nd)
+        else:
+            regions.add((a, nd, c))
+    mask = s.mask
+    if mask is not None:
+        a, dims, pol = mask
+        flat = []
+        for d in dims:
+            nd = dim_map(d)
+            if nd is None:
+                mask = None
+                break
+            if isinstance(nd, tuple):
+                one = realign(a, nd) if realign is not None else None
+                flat.extend((one,) if one is not None else nd)
+            else:
+                flat.append(nd)
+        else:
+            mask = (a, tuple(flat), pol)
+    return MState(frozenset(regions), frozenset(escaped), mask, s.boolish,
+                  s.nonfinite, s.const)
+
+
+def _t_broadcast_in_dim(an, eqn, ins, vals, path, record):
+    s = ins[0]
+    bdims = eqn.params["broadcast_dimensions"]
+    in_shape = _shape(eqn.invars[0])
+    out_shape = eqn.params["shape"]
+
+    def dim_map(d):
+        nd = bdims[d]
+        return nd if in_shape[d] == out_shape[nd] else None
+
+    return _remap(s, dim_map)
+
+
+def _t_reshape(an, eqn, ins, vals, path, record):
+    s = ins[0]
+    in_shape = _shape(eqn.invars[0])
+    out_shape = tuple(eqn.params.get("new_sizes", _shape(eqn.outvars[0])))
+    sizes = getattr(an, "axis_sizes", {})
+
+    def realign(a, nd):
+        # the major split dim re-acquires the mask's own indexing when its
+        # size IS the mask length ([nf*n, 3] -> [nf, n, 3]): pad slots are
+        # whole major blocks, the minor dims carry no pad structure
+        m = sizes.get(a)
+        return nd[0] if m is not None and out_shape[nd[0]] == m else None
+
+    return _remap(s, lambda d: _dim_map_reshape(in_shape, out_shape, d),
+                  realign=realign)
+
+
+def _t_squeeze(an, eqn, ins, vals, path, record):
+    dims = sorted(eqn.params["dimensions"])
+
+    def dim_map(d):
+        if d in dims:
+            return None
+        return d - sum(1 for x in dims if x < d)
+
+    return _remap(ins[0], dim_map)
+
+
+def _t_expand_dims(an, eqn, ins, vals, path, record):
+    dims = sorted(eqn.params["dimensions"])
+
+    def dim_map(d):
+        nd = d
+        for x in dims:
+            if x <= nd:
+                nd += 1
+        return nd
+
+    return _remap(ins[0], dim_map)
+
+
+def _t_transpose(an, eqn, ins, vals, path, record):
+    perm = tuple(eqn.params["permutation"])
+    return _remap(ins[0], lambda d: perm.index(d))
+
+
+def _t_slice_like(an, eqn, ins, vals, path, record):
+    # a window keeps its dims; surviving pad slots keep their class
+    # (DIRTY stays sound, surviving ZERO slots are still zero), and a
+    # sliced mask still carries False exactly at its surviving pads
+    s = ins[0]
+    escaped = frozenset().union(*[x.escaped for x in ins])
+    return MState(s.regions, escaped, s.mask, s.boolish, s.nonfinite,
+                  s.const)
+
+
+def _t_dynamic_update_slice(an, eqn, ins, vals, path, record):
+    op, upd = ins[0], ins[1]
+    escaped = frozenset().union(*[x.escaped for x in ins])
+    regions = set()
+    for axis, dim in op.region_dims() | upd.region_dims():
+        ca, cb = op.cls(axis, dim), upd.cls(axis, dim)
+        if DIRTY in (ca, cb):
+            c = DIRTY
+        elif ca == cb and ca is not None:
+            c = ca
+        else:
+            c = None
+        if c is not None:
+            regions.add((axis, dim, c))
+    return MState(frozenset(regions), escaped, None, False,
+                  op.nonfinite or upd.nonfinite, None)
+
+
+def _t_concatenate(an, eqn, ins, vals, path, record):
+    escaped = frozenset().union(*[s.escaped for s in ins])
+    regions = set()
+    for axis, dim in frozenset().union(*[s.region_dims() for s in ins]):
+        classes = [s.cls(axis, dim) for s in ins]
+        if any(c == DIRTY for c in classes):
+            c = DIRTY
+        elif any(c in (SNEG, SPOS) for c in classes):
+            c = _worst(*classes)
+        elif all(c == ZERO for c in classes):
+            c = ZERO
+        else:
+            c = None
+        if c is not None:
+            regions.add((axis, dim, c))
+    return MState(frozenset(regions), escaped, None,
+                  all(s.boolish for s in ins),
+                  any(s.nonfinite for s in ins), None)
+
+
+def _t_pad(an, eqn, ins, vals, path, record):
+    s, fill = ins[0], ins[1]
+    regions = set()
+    for a, d, c in s.regions:
+        if c == ZERO and fill.const not in (0.0, None):
+            continue       # nonzero fill interleaves with the zero slots
+        regions.add((a, d, c))
+    return MState(frozenset(regions), s.escaped | fill.escaped, None,
+                  s.boolish and fill.boolish,
+                  s.nonfinite or fill.nonfinite, None)
+
+
+_REDUCE_NEUTRAL = {
+    "reduce_sum": (ZERO,),
+    "reduce_or": (ZERO,),
+    "reduce_max": (ZERO, SNEG),   # bool masks reduce via max on some paths
+    "reduce_min": (SPOS,),
+    "reduce_prod": (),
+    "reduce_and": (),
+    "reduce_xor": (),
+}
+
+
+def _t_reduce(an, eqn, ins, vals, path, record):
+    name = eqn.primitive.name
+    s = ins[0]
+    axes = tuple(eqn.params.get("axes", ()))
+    neutral = _REDUCE_NEUTRAL.get(name, ())
+    if name == "reduce_max":
+        # zero is neutral for max only over booleans (False pads)
+        neutral = (ZERO, SNEG) if _is_bool(eqn.invars[0]) else (SNEG,)
+    escaped = set(s.escaped)
+    for a, d, c in s.regions:
+        if d in axes and c not in neutral:
+            if record:
+                what = ("input-pad garbage" if c == DIRTY else
+                        f"pad slots holding "
+                        f"{'zeros' if c == ZERO else 'a ∓inf sentinel'}")
+                an._finding(UNMASKED_REDUCTION, (
+                    f"{name} at {path or '<top>'} reduces over padded dim "
+                    f"{d} of mask axis '{a}' with {what}, which is not the "
+                    "reduction's neutral element — mask to the neutral "
+                    "value (jnp.where) before reducing, or the result "
+                    "mixes padded slots into live physics"), eqn)
+            escaped.add(a)
+
+    def dim_map(d):
+        if d in axes:
+            return None
+        return d - sum(1 for x in axes if x < d)
+
+    kept = {(a, dim_map(d), c) for a, d, c in s.regions
+            if d not in axes and dim_map(d) is not None}
+    return MState(frozenset(kept), frozenset(escaped), None, False,
+                  s.nonfinite, None)
+
+
+def _t_argreduce(an, eqn, ins, vals, path, record):
+    name = eqn.primitive.name
+    s = ins[0]
+    axes = tuple(eqn.params.get("axes", ()))
+    # False IS the -inf of booleans: argmax over `flags & mask` cannot
+    # name a padded slot, no explicit sentinel needed
+    want = (SNEG, ZERO) if name == "argmax" and s.boolish else \
+        (SNEG,) if name == "argmax" else (SPOS,)
+    escaped = set(s.escaped)
+    for a, d, c in s.regions:
+        if d in axes and c not in want:
+            if record:
+                sentinel = "-inf" if name == "argmax" else "+inf"
+                an._finding(UNSENTINELED_ARGREDUCE, (
+                    f"{name} at {path or '<top>'} scans padded dim {d} of "
+                    f"mask axis '{a}' without the {sentinel} sentinel "
+                    f"(pad slots hold "
+                    f"{'garbage' if c == DIRTY else 'zeros' if c == ZERO else 'the WRONG-SIGN sentinel'}): "
+                    "the winning index can name a padded slot — "
+                    f"jnp.where(mask, x, {sentinel}) first, so live "
+                    "entries always win"), eqn)
+            escaped.add(a)
+
+    def dim_map(d):
+        if d in axes:
+            return None
+        return d - sum(1 for x in axes if x < d)
+
+    kept = {(a, dim_map(d), c) for a, d, c in s.regions
+            if d not in axes and dim_map(d) is not None}
+    return MState(frozenset(kept), frozenset(escaped), None, False, False,
+                  None)
+
+
+def _t_cumulative(an, eqn, ins, vals, path, record):
+    name = eqn.primitive.name
+    s = ins[0]
+    axis = eqn.params.get("axis")
+    escaped = set(s.escaped)
+    regions = set()
+    for a, d, c in s.regions:
+        if d != axis:
+            regions.add((a, d, c))
+            continue
+        if c == ZERO and name == "cumsum":
+            continue       # zeros are transparent to a running sum
+        if record:
+            an._finding(UNMASKED_REDUCTION, (
+                f"{name} at {path or '<top>'} prefix-scans padded dim {d} "
+                f"of mask axis '{a}' whose pad slots are not the scan's "
+                "neutral element: every position after a padded slot "
+                "absorbs it"), eqn)
+        escaped.add(a)
+    return MState(frozenset(regions), frozenset(escaped), None, False,
+                  s.nonfinite, None)
+
+
+def _t_sort(an, eqn, ins, vals, path, record):
+    dim = eqn.params.get("dimension", len(_shape(eqn.invars[0])) - 1)
+    out = []
+    escaped = set(frozenset().union(*[s.escaped for s in ins]))
+    for s in ins:
+        for a, d, c in s.regions:
+            if d == dim and c != ZERO:
+                if record:
+                    an._finding(UNMASKED_REDUCTION, (
+                        f"sort at {path or '<top>'} orders padded dim {d} "
+                        f"of mask axis '{a}' with non-zero pad slots: "
+                        "padded entries interleave with live ones"), eqn)
+                escaped.add(a)
+    for s in ins:
+        regions = {(a, d, c) for a, d, c in s.regions if d != dim}
+        out.append(MState(frozenset(regions), frozenset(escaped), None,
+                          s.boolish, s.nonfinite, None))
+    return out
+
+
+def _t_dot_general(an, eqn, ins, vals, path, record):
+    lhs, rhs = ins[0], ins[1]
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs_shape, rhs_shape = _shape(eqn.invars[0]), _shape(eqn.invars[1])
+    escaped = set(lhs.escaped | rhs.escaped)
+    axes_here = {a for a, _, _ in lhs.regions | rhs.regions}
+    for axis in axes_here:
+        for dl, dr in zip(lc, rc):
+            cl, cr = lhs.cls(axis, dl), rhs.cls(axis, dr)
+            if cl is None and cr is None:
+                continue
+            if cl == ZERO and cr == ZERO:
+                continue   # 0 * 0 pads contribute exact zeros
+            if ZERO in (cl, cr):
+                other_side = lhs if cr == ZERO else rhs
+                if other_side.nonfinite:
+                    if record:
+                        an._finding(NAN_UNSAFE, (
+                            f"dot_general at {path or '<top>'} contracts "
+                            f"padded dim of mask axis '{axis}' against a "
+                            "zero-padded partner whose other side may be "
+                            "nonfinite: 0 * inf = NaN re-poisons the "
+                            "contraction"), eqn)
+                    escaped.add(axis)
+                continue   # zero pads contribute exact zeros
+            # both sides carry live-or-dirty pad slots on the contraction
+            if DIRTY in (cl, cr) or SNEG in (cl, cr) or SPOS in (cl, cr):
+                if record:
+                    an._finding(UNMASKED_REDUCTION, (
+                        f"dot_general at {path or '<top>'} contracts over "
+                        f"padded dim of mask axis '{axis}' with "
+                        "non-zeroed pad slots on the "
+                        f"{'lhs' if cl else 'rhs'}: padded garbage enters "
+                        "every live row of the product — zero the padded "
+                        "slots (jnp.where) on one side first"), eqn)
+                escaped.add(axis)
+    # out dims: batch..., lhs free..., rhs free...
+    lhs_free = [d for d in range(len(lhs_shape))
+                if d not in lc and d not in lb]
+    rhs_free = [d for d in range(len(rhs_shape))
+                if d not in rc and d not in rb]
+    regions = set()
+    for a, d, c in lhs.regions:
+        if d in lb:
+            cb = _worst(c, rhs.cls(a, rb[lb.index(d)]))
+            regions.add((a, lb.index(d), cb))
+        elif d in lhs_free:
+            regions.add((a, len(lb) + lhs_free.index(d), c))
+    for a, d, c in rhs.regions:
+        if d in rb:
+            if not any(x == a and dd == rb.index(d)
+                       for x, dd, _ in regions):
+                regions.add((a, rb.index(d), _worst(c, lhs.cls(
+                    a, lb[rb.index(d)]))))
+        elif d in rhs_free:
+            regions.add((a, len(lb) + len(lhs_free) + rhs_free.index(d), c))
+    return MState(frozenset(regions), frozenset(escaped), None, False,
+                  lhs.nonfinite or rhs.nonfinite, None)
+
+
+def _t_gather(an, eqn, ins, vals, path, record):
+    op, idx = ins[0], ins[1]
+    dn = eqn.params["dimension_numbers"]
+    sizes = eqn.params["slice_sizes"]
+    op_shape = _shape(eqn.invars[0])
+    idx_shape = _shape(eqn.invars[1])
+    out_rank = len(_shape(eqn.outvars[0]))
+    collapsed = tuple(dn.collapsed_slice_dims)
+    offset_dims = tuple(dn.offset_dims)
+    ob = tuple(getattr(dn, "operand_batching_dims", ()) or ())
+    ib = tuple(getattr(dn, "start_indices_batching_dims", ()) or ())
+    # vmapped gather: operand batch dim i pairs with indices batch dim
+    # ib[i]; output non-offset dims correspond, in order, to the indices'
+    # non-index-vector dims (jax keeps the index vector trailing)
+    batch_out = [d for d in range(out_rank) if d not in offset_dims]
+    idx_dims = list(range(max(len(idx_shape) - 1, 0)))
+    bmap = {}
+    for obd, ibd in zip(ob, ib):
+        if ibd in idx_dims and idx_dims.index(ibd) < len(batch_out):
+            bmap[obd] = batch_out[idx_dims.index(ibd)]
+    kept = [d for d in range(len(op_shape))
+            if d not in collapsed and d not in ob]
+    escaped = set(op.escaped | idx.escaped)
+    regions = set()
+    for a, d, c in op.regions:
+        if d in bmap:
+            # batch slices map 1:1 — the claim rides to the output batch dim
+            regions.add((a, bmap[d], c))
+        elif (d not in collapsed and d not in ob
+                and sizes[d] == op_shape[d]
+                and kept.index(d) < len(offset_dims)):
+            regions.add((a, offset_dims[kept.index(d)], c))
+        elif c != ZERO:
+            # gathered window may or may not include pad slots: garbage
+            # at unknown positions is an escape, zeros launder silently
+            escaped.add(a)
+    return MState(frozenset(regions), frozenset(escaped), None,
+                  op.boolish, op.nonfinite, None)
+
+
+def _t_scatter(an, eqn, ins, vals, path, record):
+    op, idx, upd = ins[0], ins[1], ins[2]
+    escaped = set(op.escaped | idx.escaped | upd.escaped)
+    dn = eqn.params["dimension_numbers"]
+    op_shape, upd_shape = _shape(eqn.invars[0]), _shape(eqn.invars[2])
+    skipped = set(dn.inserted_window_dims) | set(
+        getattr(dn, "operand_batching_dims", ()) or ())
+    owindow = [d for d in range(len(op_shape)) if d not in skipped]
+    # update window dim -> operand dim, when the window spans the FULL
+    # operand dim: positions along it are then known 1:1 (the vmapped
+    # `res.at[j].add(col)` case — updates [nf], operand [nf, m]) and the
+    # update's claim lands on the operand dim instead of escaping
+    full = {}
+    for uw, od in zip(sorted(dn.update_window_dims), owindow):
+        if uw < len(upd_shape) and od < len(op_shape) \
+                and upd_shape[uw] == op_shape[od]:
+            full[uw] = od
+    simple = eqn.primitive.name in ("scatter", "scatter-add", "scatter_add")
+    regions = {(a, d, c) for a, d, c in op.regions if c == DIRTY}
+    for a, du, cu in upd.regions:
+        od = full.get(du)
+        if od is None:
+            if cu != ZERO:
+                escaped.add(a)     # garbage lands at unknown positions
+            continue
+        co = op.cls(a, od)
+        if cu == DIRTY or co == DIRTY or {co, cu} == {SNEG, SPOS}:
+            regions.add((a, od, DIRTY))
+        elif cu == ZERO:
+            if co == ZERO:
+                regions.add((a, od, ZERO))
+            # else: zero update into live-derived slots — claim drops
+        elif simple:
+            # replace/add of a sentinel: -inf + finite = -inf, claim holds
+            regions.add((a, od, cu))
+        else:
+            regions.add((a, od, DIRTY))
+    return MState(frozenset(regions), frozenset(escaped), None, False,
+                  op.nonfinite or upd.nonfinite, None)
+
+
+def _t_iota(an, eqn, ins, vals, path, record):
+    return CLEAN_STATE
+
+
+def _t_rev(an, eqn, ins, vals, path, record):
+    # reversal permutes within each dim: pad positions move, classes hold
+    s = ins[0]
+    return MState(s.regions, s.escaped, s.mask, s.boolish, s.nonfinite,
+                  s.const)
+
+
+def _t_batched_solve(an, eqn, ins, vals, path, record):
+    """lu / triangular_solve / cholesky / custom_linear_solve family:
+    batch dims stay independent (pad batch entries are garbage-in
+    garbage-out, live entries never read them), but nothing about the
+    padded VALUES survives — a DIRTY batch slot stays DIRTY, everything
+    else degrades to clean (a zero RHS only solves to zero when the
+    operator is provably nonsingular, which this abstraction cannot
+    see)."""
+    mats = [s for s in ins]
+    escaped = frozenset().union(*[s.escaped for s in ins])
+    ndim = len(_shape(eqn.outvars[0]))
+    solve_dims = {ndim - 1, ndim - 2}
+    regions = set()
+    for s in mats:
+        for a, d, c in s.regions:
+            if d in solve_dims:
+                if c not in (ZERO,):
+                    escaped = escaped | {a}
+            elif c == DIRTY:
+                regions.add((a, d, DIRTY))
+    return MState(frozenset(regions), escaped, None, False, True, None)
+
+
+_SHAPED = {
+    "broadcast_in_dim": _t_broadcast_in_dim,
+    "reshape": _t_reshape,
+    "squeeze": _t_squeeze,
+    "expand_dims": _t_expand_dims,
+    "transpose": _t_transpose,
+    "slice": _t_slice_like,
+    "dynamic_slice": _t_slice_like,
+    "dynamic_update_slice": _t_dynamic_update_slice,
+    "concatenate": _t_concatenate,
+    "pad": _t_pad,
+    "reduce_sum": _t_reduce,
+    "reduce_max": _t_reduce,
+    "reduce_min": _t_reduce,
+    "reduce_prod": _t_reduce,
+    "reduce_and": _t_reduce,
+    "reduce_or": _t_reduce,
+    "reduce_xor": _t_reduce,
+    "argmax": _t_argreduce,
+    "argmin": _t_argreduce,
+    "cumsum": _t_cumulative,
+    "cumprod": _t_cumulative,
+    "cummax": _t_cumulative,
+    "cummin": _t_cumulative,
+    "cumlogsumexp": _t_cumulative,
+    "sort": _t_sort,
+    "dot_general": _t_dot_general,
+    "gather": _t_gather,
+    "scatter": _t_scatter,
+    "scatter-add": _t_scatter,
+    "scatter_add": _t_scatter,
+    "scatter-mul": _t_scatter,
+    "scatter-min": _t_scatter,
+    "scatter-max": _t_scatter,
+    "rev": _t_rev,
+    "iota": _t_iota,
+    "lu": _t_batched_solve,
+    "triangular_solve": _t_batched_solve,
+    "cholesky": _t_batched_solve,
+    "custom_linear_solve": _t_batched_solve,
+    "lu_solve": _t_batched_solve,
+}
+
+
+# ----------------------------------------------------------------- entry API
+
+def _seed_inputs(jaxpr, axes, in_paths):
+    """[MState] per flat invar from the declared mask axes, plus any
+    configuration findings (a declaration that names no input is itself
+    drift)."""
+    findings = []
+    n = len(jaxpr.invars)
+    paths = list(in_paths) if in_paths is not None else [str(i)
+                                                         for i in range(n)]
+    if len(paths) != n:
+        findings.append(MaskFinding("mask-config", (
+            "mask-config: input path table does not match the traced "
+            f"program ({len(paths)} paths, {n} jaxpr inputs) — re-lower "
+            "the program")))
+        paths = [str(i) for i in range(n)]
+    by_path = {p: i for i, p in enumerate(paths)}
+    states = [CLEAN_STATE] * n
+    axis_sizes = {}
+    for ax in axes:
+        mi = by_path.get(ax.mask)
+        if mi is None:
+            findings.append(MaskFinding("mask-config", (
+                f"mask-config: axis '{ax.name}' declares mask input "
+                f"'{ax.mask}' but the traced program has no such input "
+                "path (check --dump-contract for the real paths)")))
+            continue
+        mvar = jaxpr.invars[mi]
+        if not _is_bool(mvar):
+            findings.append(MaskFinding("mask-config", (
+                f"mask-config: axis '{ax.name}' mask input '{ax.mask}' "
+                f"has dtype {getattr(mvar.aval, 'dtype', '?')} — a "
+                "capacity mask must be boolean (True = live)")))
+        mshape = _shape(mvar)
+        k = len(mshape)
+        if mshape:
+            axis_sizes[ax.name] = mshape[0]
+        states[mi] = MState(
+            regions=frozenset((ax.name, d, ZERO) for d in range(k)),
+            mask=(ax.name, tuple(range(k)), True), boolish=True)
+        guarded = dict(ax.inputs)
+        if ax.scope is not None:
+            prefix = ax.scope + "."
+            for p, i in by_path.items():
+                if (p.startswith(prefix) or p == ax.scope) and p != ax.mask:
+                    guarded.setdefault(p, ax.dim)
+        matched = 0
+        for p, dim in sorted(guarded.items()):
+            i = by_path.get(p)
+            if i is None:
+                findings.append(MaskFinding("mask-config", (
+                    f"mask-config: axis '{ax.name}' guards input '{p}' "
+                    "but the traced program has no such input path")))
+                continue
+            shape = _shape(jaxpr.invars[i])
+            if tuple(shape[dim:dim + k]) != tuple(mshape):
+                if p in dict(ax.inputs):
+                    findings.append(MaskFinding("mask-config", (
+                        f"mask-config: axis '{ax.name}' guards input "
+                        f"'{p}' at dim {dim}, but its shape {shape} does "
+                        f"not carry the mask's shape {mshape} there")))
+                continue       # scope prefix matches non-padded leaves too
+            matched += 1
+            prev = states[i]
+            states[i] = MState(
+                regions=prev.regions | frozenset(
+                    (ax.name, dim + j, DIRTY) for j in range(k)),
+                escaped=prev.escaped, mask=prev.mask, boolish=prev.boolish,
+                nonfinite=prev.nonfinite, const=prev.const)
+        if not matched and (ax.scope is not None or ax.inputs):
+            findings.append(MaskFinding("mask-config", (
+                f"mask-config: axis '{ax.name}' guards no input leaf "
+                "(scope/inputs matched nothing with the mask's shape) — "
+                "the declaration is dead")))
+    return states, findings, axis_sizes
+
+
+def classify(state: MState) -> str:
+    """The output pad class of one flat output value."""
+    if any(c in (DIRTY, SNEG, SPOS) for _, _, c in state.regions):
+        return PAD_PASSTHROUGH
+    if state.regions or state.mask is not None:
+        return PAD_EXACT_ZERO
+    return LIVE_ONLY
+
+
+def analyze(closed_jaxpr, axes=(), in_paths=None, out_paths=None
+            ) -> MaskReport:
+    """Run the mask-flow analysis over one traced program.
+
+    ``axes`` is the contract's `[[mask.axes]]` declaration (possibly
+    empty: the program then has no padded capacity inputs, and only the
+    declaration-free detectors — multiplicative masking of nonfinite
+    values — can fire). ``in_paths``/``out_paths`` are the flat pytree
+    path names from `registry.BuiltProgram` (positional fallback when
+    absent, e.g. for Pallas kernel jaxprs).
+    """
+    a = _Analyzer()
+    jaxpr = _sub_jaxpr(closed_jaxpr)
+    in_states, findings, a.axis_sizes = _seed_inputs(jaxpr, axes, in_paths)
+    for f in findings:
+        a._findings[f.message] = f
+    outs = a.run_jaxpr(jaxpr, in_states, "", True,
+                       consts=getattr(closed_jaxpr, "consts", None))
+    n = len(outs)
+    paths = list(out_paths) if out_paths is not None and \
+        len(out_paths) == n else [str(i) for i in range(n)]
+    classes = []
+    for p, s in zip(paths, outs):
+        if s.escaped:
+            a._finding(PAD_ESCAPE, (
+                f"output '{p}' carries live entries contaminated by "
+                f"padded slots of mask axis(es) "
+                f"{sorted(s.escaped)} — garbage crossed into live "
+                "physics with no interposed select-on-mask"))
+        classes.append((p, classify(s)))
+    return MaskReport(findings=list(a._findings.values()), classes=classes)

@@ -15,12 +15,14 @@ def all_programs():
     from ..ops.spectral import auditable_programs as spectral_programs
     from ..ops.treecode import auditable_programs as ops_programs
     from ..parallel.spmd import auditable_programs as parallel_programs
+    from ..scenarios.di_device import auditable_programs as scenario_programs
     from ..solver.gmres import auditable_programs as solver_programs
     from ..system.system import auditable_programs as system_programs
 
     progs = []
     for layer in (system_programs, solver_programs, ops_programs,
-                  spectral_programs, parallel_programs, ensemble_programs):
+                  spectral_programs, parallel_programs, ensemble_programs,
+                  scenario_programs):
         progs.extend(layer())
     names = [p.name for p in progs]
     dupes = {n for n in names if names.count(n) > 1}

@@ -29,11 +29,20 @@ class BuiltProgram:
     .Lowered` handle the text came from — skelly-scope's cost gate
     (`obs.cost`) compiles it for XLA's cost/memory analyses; audit checks
     never touch it (tests construct BuiltProgram without one).
+
+    ``in_paths``/``out_paths`` name the flat jaxpr inputs/outputs with
+    their pytree paths (``"0.fibers.active"`` = first positional arg,
+    attr ``fibers``, attr ``active``), in invar/outvar order — the
+    vocabulary the ``mask`` contracts declare capacity masks and output
+    pad-class pins in. None when a test builds the artifact by hand (the
+    mask check then falls back to flat indices).
     """
 
     closed_jaxpr: object
     lowered_text: str
     lowered: object = None
+    in_paths: tuple | None = None
+    out_paths: tuple | None = None
 
 
 @dataclass
@@ -90,11 +99,53 @@ class AuditKernel:
     build: Callable[[], BuiltKernel]
 
 
+def _keystr(path) -> str:
+    """One flat pytree path as a dotted name: SequenceKey indices and
+    GetAttr/Dict keys joined with '.' (``0.fibers.active``)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "key"):
+            parts.append(str(k.key))
+        else:  # pragma: no cover - future key kinds
+            parts.append(str(k))
+    return ".".join(parts) or "result"   # a whole-output leaf has no keys
+
+
+def _flat_paths(info_tree, strip_leading=False):
+    """Dotted path per flat leaf of a Traced.args_info/out_info pytree.
+    ``strip_leading`` drops the (args, kwargs) wrapper index args_info
+    nests under, so declared paths read ``0.fibers.active`` rather than
+    ``0.0.fibers.active``."""
+    from jax import tree_util as jtu
+
+    leaves, _ = jtu.tree_flatten_with_path(info_tree)
+    out = []
+    for path, _ in leaves:
+        if strip_leading:
+            path = path[1:]
+        out.append(_keystr(path))
+    return tuple(out)
+
+
 def built_from(jitted, *args, **kwargs) -> BuiltProgram:
     """Trace + lower a `jax.jit`-wrapped callable once, capturing every
     artifact from the same trace (no double tracing/lowering)."""
     traced = jitted.trace(*args, **kwargs)
     lowered = traced.lower()
+    in_paths = out_paths = None
+    try:
+        in_paths = _flat_paths(traced.args_info, strip_leading=True)
+        out_paths = _flat_paths(traced.out_info)
+        if len(in_paths) != len(traced.jaxpr.jaxpr.invars):
+            in_paths = None        # static/donated args shift the mapping
+    except Exception:  # pragma: no cover - older tracing APIs
+        in_paths = out_paths = None
     return BuiltProgram(closed_jaxpr=traced.jaxpr,
                         lowered_text=lowered.as_text(),
-                        lowered=lowered)
+                        lowered=lowered,
+                        in_paths=in_paths,
+                        out_paths=out_paths)

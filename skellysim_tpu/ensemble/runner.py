@@ -9,7 +9,9 @@ host adaptive loop of `System._run_loop` becomes device-side masked
 selection: each member carries its own clock, rejected members roll back via
 `jnp.where` against the backup pytree (the step's input — backup/restore is
 free on immutable pytrees), and members past their ``t_final`` are inert
-masked lanes whose leaves pass through unchanged.
+masked lanes whose leaves pass through unchanged (lane neutralization
+follows docs/audit.md "Masking discipline"; the `mask` audit check proves
+it on the lowered `ensemble_step` program).
 
 Two execution plans for the same batched program (`EnsembleRunner(...,
 batch_impl=...)`):

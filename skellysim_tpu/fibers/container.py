@@ -11,7 +11,10 @@ decomposition, `fiber_container_finite_difference.cpp:98-121`).
 
 An `active` mask supports dynamic instability (nucleation/catastrophe changes
 the live fiber count without reshaping the arrays): inactive slots contribute
-zero flow/force/error and solve an identity system.
+zero flow/force/error and solve an identity system. How dead slots are
+neutralized (select-not-multiply, sentinels, origin-pinned positions) is
+docs/audit.md "Masking discipline" — proven per program by the `mask`
+audit check, not restated here.
 """
 
 from __future__ import annotations
@@ -248,8 +251,11 @@ def weighted_forces(group: FiberGroup, forces) -> jnp.ndarray:
     """
     w0 = jnp.asarray(group.mats.weights0, dtype=group.x.dtype)
     w = 0.5 * group.length[:, None] * w0[None, :]
-    w = jnp.where(group.active[:, None], w, 0.0)
-    return w[:, :, None] * forces
+    # select AFTER the product: zeroing only the weight would leave
+    # 0 * inf = NaN if an inactive slot's force bits were nonfinite
+    # (docs/audit.md "Masking discipline")
+    return jnp.where(group.active[:, None, None], w[:, :, None] * forces,
+                     0.0)
 
 
 def flow(group: FiberGroup, caches: FiberCaches, r_trg, forces, eta,
@@ -328,7 +334,17 @@ def flow_multi(buckets, caches_list, r_trg, forces_list, eta,
     pos = jnp.concatenate([node_positions(g) for g in buckets], axis=0)
     wf = jnp.concatenate([weighted_forces(g, f).reshape(-1, 3)
                           for g, f in zip(buckets, forces_list)], axis=0)
+    # dead slots' weighted forces are exact zeros, so their positions are
+    # occupancy-only: pin them to the origin so no garbage coordinate ever
+    # enters a pair kernel (a nonfinite stale position would turn the
+    # zero-force product into NaN — docs/audit.md "Masking discipline").
+    # The fast planners re-fill them with spread anchors (`_spread_inactive`)
+    act = jnp.concatenate([node_active_flat(g) for g in buckets])
+    pos = jnp.where(act[:, None], pos, 0.0)
     n_fib_nodes = pos.shape[0]
+    if subtract_self:
+        # keep the leading self targets consistent with the pinned sources
+        r_trg = jnp.concatenate([pos, r_trg[n_fib_nodes:]], axis=0)
     if evaluator == "ring" and mesh is not None:
         if impl in ("df", "pallas_df"):
             # the DF ring entry point serves both spellings: "df" runs the
@@ -546,7 +562,12 @@ def fiber_errors(group: FiberGroup) -> jnp.ndarray:
 
 def fiber_error(group: FiberGroup) -> jnp.ndarray:
     """Max inextensibility violation over active fibers (`fiber_error_local`)."""
-    return jnp.max(fiber_errors(group))
+    # -inf sentinel so inactive slots can never win the max; the outer
+    # maximum(0, ·) keeps the all-inactive value finite and is otherwise
+    # a no-op (errors are nonnegative) — docs/audit.md "Masking discipline"
+    errs = jax.vmap(lambda x, L: fd_fiber.fiber_error(x, L, group.mats))(
+        group.x, group.length)
+    return jnp.maximum(0.0, jnp.max(jnp.where(group.active, errs, -jnp.inf)))
 
 
 def solution_size(group: FiberGroup) -> int:

@@ -79,10 +79,12 @@ def build_A(xs, xss, xsss, dt, eta, sc: FiberScalars, mats):
     c0, c1 = sbt_constants(sc.radius, sc.length, eta)
     s = 2.0 / sc.length
     D1, D2, D3, D4 = s * mats.D1, s**2 * mats.D2, s**3 * mats.D3, s**4 * mats.D4
-    eye = jnp.eye(n, dtype=xs.dtype)
+    diag = jnp.eye(n, dtype=bool)
 
     def XX(i):
-        return (sc.beta_tstep / dt) * eye \
+        # select, not `scalar * eye`: 0 * inf = NaN would leak the scalar
+        # into off-diagonal slots (docs/audit.md "Masking discipline")
+        return jnp.where(diag, sc.beta_tstep / dt, 0.0) \
             + E * c0 * ((1.0 + xs[:, i] ** 2)[:, None] * D4) \
             + E * c1 * ((1.0 - xs[:, i] ** 2)[:, None] * D4)
 

@@ -499,6 +499,70 @@ def check_raw_dma(mod: ModuleInfo, ctx: RepoContext):
     return out
 
 
+# -------------------------------------------------------- rule: mul-mask
+
+#: terminal identifier names that read as boolean live-masks in this tree
+#: (fibers.active, node_mask, keep, valid) — conservative on purpose: a
+#: float *weight* array named `w` multiplying a field is legitimate math
+MASK_NAMES = {"mask", "keep", "active", "valid", "alive", "live"}
+
+
+def _mask_like(node) -> bool:
+    """Expressions that read as a boolean live-mask: names/attributes with
+    a mask-ish terminal identifier, an inline comparison, `~mask`, a mask
+    broadcast (`active[:, None]`), or a mask cast (`mask.astype(...)`)."""
+    if isinstance(node, ast.Compare):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Invert):
+        return _mask_like(node.operand)
+    if isinstance(node, ast.Subscript):
+        return _mask_like(node.value)
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"):
+        return _mask_like(node.func.value)
+    name = (node.id if isinstance(node, ast.Name)
+            else node.attr if isinstance(node, ast.Attribute) else None)
+    if name is None:
+        return False
+    low = name.lower()
+    return (low in MASK_NAMES or low.endswith("mask")
+            or low.endswith("_active") or low.startswith("active"))
+
+
+def check_mul_mask(mod: ModuleInfo, ctx: RepoContext):
+    """Multiplicative masking (`field * mask` / `mask * field`) in
+    jit-reachable code.
+
+    `x * mask` neutralizes padded slots only while `x` is finite: the IEEE
+    products `0 * inf` and `0 * nan` are NaN, so one overflowed lane turns
+    its zero mask into poison that every downstream reduction absorbs.
+    `jnp.where(mask, x, 0)` is bitwise identical for finite `x` and exact
+    for nonfinite `x` — it is the discipline the `mask` audit check
+    (`audit.maskflow`, docs/audit.md "Masking discipline") proves on the
+    lowered program; this rule catches the source-level pattern before it
+    lowers. Flags single-sided mask products only (mask * mask is integer
+    occupancy math, not field neutralization).
+    """
+    out = []
+    rid = "mul-mask"
+    for qual, fi in mod.functions.items():
+        if not ctx.is_reachable(mod, qual):
+            continue
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Mult)):
+                continue
+            if _mask_like(node.left) == _mask_like(node.right):
+                continue
+            out.append(Finding(
+                mod.path, node.lineno, node.col_offset, rid,
+                "multiplicative masking: `x * mask` maps a nonfinite x to "
+                "NaN (0 * inf) instead of zero — use jnp.where(mask, x, 0) "
+                "(bitwise identical for finite x; docs/audit.md \"Masking "
+                "discipline\")"))
+    return out
+
+
 RULES = (
     Rule("dtype-discipline",
          "array creation without explicit dtype / hardcoded f64-f32 casts "
@@ -526,6 +590,11 @@ RULES = (
          "modules registered via auditable_kernels() (the dma audit "
          "check's verified boundary)",
          check_raw_dma),
+    Rule("mul-mask",
+         "multiplicative masking (`field * mask`) of float fields in "
+         "jit-reachable code: 0 * inf = NaN — use jnp.where(mask, x, 0) "
+         "(the source-level twin of the mask audit check)",
+         check_mul_mask),
     Rule("lint-pragma",
          "malformed, unknown-rule, reason-less, or unused suppression "
          "pragmas (engine-enforced; keeps every pragma load-bearing)",

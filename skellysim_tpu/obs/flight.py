@@ -244,14 +244,17 @@ def record_step(entry_state, new_state, solution, *, residual_true, health,
     goff = 0
     for g in old_buckets:
         per = g.n_nodes * 3
-        bad = (~jnp.isfinite(g.x)).reshape(-1)
+        # & active: a dead slot's garbage bits must never win the argmax,
+        # or provenance names a padded lane (docs/audit.md "Masking
+        # discipline"); False pads can't beat a live True
+        bad = ((~jnp.isfinite(g.x)) & g.active[:, None, None]).reshape(-1)
         idx = jnp.argmax(bad).astype(i32)
         fib = goff + idx // per + (shard * g.n_fibers if spmd else 0)
         cands.append((1, bad.any(), fib, (idx % per) // 3))
         goff += g.n_fibers * (axis_size if spmd else 1)
     goff = 0
     for g in old_buckets:
-        bad = (~jnp.isfinite(g.tension)).reshape(-1)
+        bad = ((~jnp.isfinite(g.tension)) & g.active[:, None]).reshape(-1)
         idx = jnp.argmax(bad).astype(i32)
         fib = goff + idx // g.n_nodes + (shard * g.n_fibers if spmd else 0)
         cands.append((2, bad.any(), fib, idx % g.n_nodes))

@@ -17,7 +17,9 @@ shape used here is fully static:
 
 * a FIXED-DEPTH uniform octree over a cubic box; leaves are padded,
   power-of-two-laddered buckets (`max_occ`) with masked empty lanes —
-  the ensemble masked-lane trick applied to space instead of batch;
+  the ensemble masked-lane trick applied to space instead of batch
+  (neutralization per docs/audit.md "Masking discipline", proven on the
+  lowered `stokeslet_tree` program by the `mask` audit check);
 * the multipole acceptance criterion is INDEX-based (the standard FMM
   well-separatedness: cells at one level interact iff their parents are
   neighbors but they are not), so every interaction list is a host-side

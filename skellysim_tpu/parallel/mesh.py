@@ -36,6 +36,21 @@ def make_member_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devs), (MEMBER_AXIS,))
 
 
+def make_2d_mesh(n_member: int, n_fiber: int) -> Mesh:
+    """(member, fiber) 2-D sub-mesh — ROADMAP item 1's shape: the ensemble
+    member axis outermost, each member's fibers sharded over its own
+    ``n_fiber``-device group. Collectives over ``FIBER_AXIS`` then stay
+    inside a member's group; ``MEMBER_AXIS`` collectives cross groups."""
+    need = n_member * n_fiber
+    devs = jax.devices()
+    if len(devs) < need:
+        raise ValueError(
+            f"2-D mesh {n_member}x{n_fiber} needs {need} devices, "
+            f"have {len(devs)}")
+    return Mesh(np.array(devs[:need]).reshape(n_member, n_fiber),
+                (MEMBER_AXIS, FIBER_AXIS))
+
+
 def shard_ensemble(ens, mesh: Mesh):
     """Shard an `ensemble.EnsembleState`'s member axis across the mesh.
 

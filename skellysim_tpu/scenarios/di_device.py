@@ -21,7 +21,10 @@ stochastic per-step dynamics kept inside one jit'd program):
   bitmap (uniform selection without replacement: free sites ranked by an
   independent uniform priority). New fibers point radially out of their
   body, minus-clamped at ``min_length`` — all field writes are
-  ``jnp.where`` selects at fixed shapes.
+  ``jnp.where`` selects at fixed shapes (docs/audit.md "Masking
+  discipline"; this module is the registered `di_device` audit program,
+  so the `mask` check proves the flip engine's non-interference — the
+  one program whose *inputs* carry real stale-garbage padding).
 
 **RNG discipline**: all draws come from the member's `SimRNG.member(i)`
 ``distributed`` stream, threaded through the trace as DATA — a ``[3]``
@@ -327,3 +330,62 @@ def _di_update_impl(state, params, di_rng, *, sample_fn=None):
                                 jnp.sum(survive | fill)).astype(jnp.int32),
         needs_growth=needs_growth)
     return state._replace(fibers=out), info
+
+
+def auditable_programs():
+    """The scenarios layer's audit entry: one device DI update over a
+    fixture with REAL capacity padding (8 slots, 3 live fibers, one bound
+    to a nucleation site). The only registered program whose `[mask]`
+    contract declares a capacity axis: its pins prove the update never
+    reads a dead slot into live physics — dead slots are pad-passthrough
+    (stale until nucleation overwrites them), never summed or argsorted
+    without a sentinel."""
+    import numpy as np
+
+    from ..audit.registry import AuditProgram, built_from
+
+    def _fixture():
+        import jax.numpy as jnp
+
+        from ..params import DynamicInstability, Params
+        from ..periphery.precompute import precompute_body
+        from ..system import System
+
+        params = Params(
+            eta=1.0, dt_initial=0.02, dt_write=0.02, t_final=0.08,
+            gmres_tol=1e-10, adaptive_timestep_flag=False,
+            dynamic_instability=DynamicInstability(
+                n_nodes=8, v_growth=0.2, f_catastrophe=0.5,
+                nucleation_rate=60.0, min_length=0.4, radius=0.0125,
+                bending_rigidity=0.01))
+        pre = precompute_body("sphere", 40, radius=0.5)
+        rng = np.random.default_rng(11)
+        sites = rng.standard_normal((6, 3))
+        sites = 0.5 * sites / np.linalg.norm(sites, axis=1, keepdims=True)
+        bodies = bd.make_group(pre["node_positions_ref"],
+                               pre["node_normals_ref"], pre["node_weights"],
+                               nucleation_sites_ref=sites[None], radius=0.5)
+        x = np.tile(np.linspace(0.0, 1.0, 8)[None, :, None], (3, 1, 3))
+        x += (1.5 + np.arange(3))[:, None, None]
+        g = fc.make_group(x, lengths=1.0, bending_rigidity=0.01,
+                          radius=0.0125)
+        g = fc.grow_capacity(g, 8)
+        bb = np.asarray(g.binding_body).copy()
+        bs = np.asarray(g.binding_site).copy()
+        bb[0], bs[0] = 0, 0          # occupied site: exercises the bitmap
+        g = g._replace(binding_body=bb, binding_site=bs)
+        state = System(params).make_state(fibers=g, bodies=bodies)
+        return state, params, jnp.asarray([0, 3, 0], jnp.int32)
+
+    def build():
+        import jax
+
+        state, params, rng = _fixture()
+        step = jax.jit(lambda s, r: di_update(s, params, r))
+        return built_from(step, state, rng)
+
+    return [AuditProgram(
+        name="di_device", layer="scenarios",
+        summary="device DI update (nucleation/catastrophe mask flips over "
+                "an 8-slot capacity batch, 3 live fibers)",
+        build=build)]

@@ -90,10 +90,13 @@ def _icgs(V, w, k, n_restart, rdot):
     ``rdot(V, w)`` computes the batch of basis dot products — under the SPMD
     solver this is the one collective (a `psum`) per orthogonalization pass.
     """
-    mask = (jnp.arange(n_restart + 1, dtype=jnp.int32) <= k).astype(w.dtype)
+    keep = jnp.arange(n_restart + 1, dtype=jnp.int32) <= k
     h = jnp.zeros(n_restart + 1, dtype=w.dtype)
     for _ in range(2):
-        proj = mask * rdot(V, w)         # [m+1] masked dots  <v_i, w>
+        # select, not multiply: 0 * inf = NaN would poison the masked
+        # rows if a dot overflowed (docs/audit.md "Masking discipline");
+        # bitwise identical to the product for finite dots
+        proj = jnp.where(keep, rdot(V, w), 0.0)   # [m+1] masked <v_i, w>
         w = w - proj @ V
         h = h + proj
     return w, h
@@ -138,7 +141,9 @@ def _chol_ridge(S, scale):
     s = S.shape[0]
     eps = jnp.asarray(jnp.finfo(S.dtype).eps, dtype=S.dtype)
     ridge = eps * jnp.maximum(scale, jnp.asarray(1.0, dtype=S.dtype))
-    return jnp.linalg.cholesky(S + ridge * jnp.eye(s, dtype=S.dtype))
+    # select, not `ridge * eye` (0 * inf = NaN; see _icgs)
+    diag = jnp.eye(s, dtype=bool)
+    return jnp.linalg.cholesky(S + jnp.where(diag, ridge, 0.0))
 
 
 @partial(jax.jit, static_argnames=("matvec", "precond", "restart", "maxiter",
@@ -328,9 +333,9 @@ def gmres(matvec: Callable, b: jnp.ndarray, *, precond: Callable | None = None,
 
             with jax.named_scope("gram"):
                 # ---- BCGS + Cholesky-QR: first batched Gram (collective 1)
-                mask = (jnp.arange(m + 1,
-                                   dtype=jnp.int32) <= k).astype(dtype)
-                Vm = V * mask[:, None]
+                keep = jnp.arange(m + 1, dtype=jnp.int32) <= k
+                # select, not multiply (0 * inf = NaN; see _icgs)
+                Vm = jnp.where(keep[:, None], V, 0.0)
                 G = rdot(jnp.concatenate([Vm, P], axis=0), P.T)
                 C1, S1 = G[:m + 1], G[m + 1:]
                 scale1 = rows * jnp.max(jnp.diagonal(S1))

@@ -347,6 +347,69 @@ def test_replication_flags_ring_order_accumulation():
                   _rep_contract(1, 0), checks=["replication"]) == []
 
 
+def _two_axis_prog(full_reduce: bool):
+    """(member, fiber) two-axis shard_map — ROADMAP item 1 readiness.
+
+    Three outputs span the varying-over(axes) lattice: varying over BOTH
+    axes, varying over member only (fiber axis psum'd away), and fully
+    reduced. With ``full_reduce=False`` the third output psums only the
+    fiber axis while declaring P(): the residue varying over {member}
+    must flag — a single-axis analyzer would call it replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from skellysim_tpu.parallel.compat import shard_map
+    from skellysim_tpu.parallel.mesh import (FIBER_AXIS, MEMBER_AXIS,
+                                             make_2d_mesh)
+
+    mesh = make_2d_mesh(2, 4)
+
+    def inner(s):
+        both = s * 2.0
+        mem = jax.lax.psum(jnp.sum(s, axis=1), FIBER_AXIS)
+        tot = jnp.sum(s)
+        tot = jax.lax.psum(
+            tot, (MEMBER_AXIS, FIBER_AXIS) if full_reduce else FIBER_AXIS)
+        return both, mem, tot
+
+    def fn(x):
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(MEMBER_AXIS, FIBER_AXIS),),
+            out_specs=(P(MEMBER_AXIS, FIBER_AXIS), P(MEMBER_AXIS), P()),
+            check_vma=False)(x)
+
+    return _prog(fn, jnp.zeros((4, 16), jnp.float64), name="synthetic2d")
+
+
+def _two_axis_contract():
+    return {"replication": {"mesh_axes": ["fib", "member"],
+                            "replicated_outputs": 1, "varying_outputs": 2}}
+
+
+def test_replication_two_axis_lattice_round_trip():
+    """The disciplined (member, fiber) program is clean under a two-axis
+    [replication] pin, and --dump-contract emits both mesh axes."""
+    prog = _two_axis_prog(full_reduce=True)
+    assert _audit(prog, _two_axis_contract(), checks=["replication"]) == []
+
+    base = AuditProgram(name="dumprep2d", layer="test", summary="synthetic",
+                        build=prog.build)
+    data = toml_io.loads(engine.dump_contract(base))
+    assert data["replication"] == {"mesh_axes": ["fib", "member"],
+                                   "replicated_outputs": 1,
+                                   "varying_outputs": 2}
+
+
+def test_replication_two_axis_partial_reduction_flags():
+    """psum over the fiber axis alone does NOT make a value replicated on
+    a 2-D mesh: the member-axis residue must be tracked per axis."""
+    f = _audit(_two_axis_prog(full_reduce=False), _two_axis_contract(),
+               checks=["replication"])
+    assert len(f) == 1, [x.message for x in f]
+    assert "unreduced-replicated-output" in f[0].message
+    assert "member" in f[0].message and "fib" not in f[0].message
+
+
 def test_replication_contract_surface_drift_and_staleness():
     prog = _unreduced_output_prog(reduced=True)
     # a sharded program must carry the section
@@ -411,6 +474,222 @@ def test_replication_dump_contract_roundtrips():
                                    "varying_outputs": 0}
 
 
+# --------------------------------------------------------------------- mask
+
+def _mask_args():
+    """One dict arg: a padded (8, 3) field with rows 5..7 dead."""
+    return ({"x": jnp.ones((8, 3), jnp.float64),
+             "active": jnp.arange(8, dtype=jnp.int32) < 5},)
+
+
+def _mask_prog(fn, name="synthetic"):
+    return _prog(fn, *_mask_args(), name=name)
+
+
+def _mask_contract(outputs):
+    """A `[mask]` section declaring the fiber capacity axis over the whole
+    first arg, plus the given `[mask.outputs]` pin table."""
+    return {"mask": {
+        "axes": [{"name": "fiber", "mask": "0.active", "scope": "0",
+                  "dim": 0}],
+        "outputs": outputs}}
+
+
+#: each finding kind as a tiny violation program next to its disciplined
+#: twin — these pin the analyzer's SEMANTICS independently of the real
+#: registered programs (same pattern as the replication fixtures above)
+def _escape_prog():
+    # x[0] + x[3]: the padded dim is indexed away, so pad garbage lands in
+    # live entries with nothing left to attribute it to
+    return _mask_prog(lambda d: d["x"][0] + d["x"][3])
+
+
+def _nan_unsafe_prog(disciplined: bool):
+    # 1/x can be inf; `* mask` then mints 0 * inf = NaN at dead slots —
+    # where-selection is the bitwise-identical-for-finite fix
+    if disciplined:
+        return _mask_prog(
+            lambda d: jnp.where(d["active"][:, None], 1.0 / d["x"], 0.0))
+    return _mask_prog(lambda d: (1.0 / d["x"]) * d["active"][:, None])
+
+
+def _reduction_prog(disciplined: bool):
+    if disciplined:
+        return _mask_prog(lambda d: jnp.sum(
+            jnp.where(d["active"][:, None], d["x"], 0.0), axis=0))
+    return _mask_prog(lambda d: jnp.sum(d["x"], axis=0))
+
+
+def _argreduce_prog(disciplined: bool):
+    if disciplined:
+        return _mask_prog(lambda d: jnp.argmax(
+            jnp.where(d["active"], jnp.sum(d["x"], axis=1), -jnp.inf)))
+    return _mask_prog(lambda d: jnp.argmax(jnp.sum(d["x"], axis=1)))
+
+
+def _mask_kinds(findings):
+    return sorted({m.split(":")[0] for m in (f.message for f in findings)})
+
+
+def test_mask_flags_pad_escape():
+    f = _audit(_escape_prog(), _mask_contract({"result": "live-only"}),
+               checks=["mask"])
+    assert _mask_kinds(f) == ["pad-escape"], [x.message for x in f]
+
+
+def test_mask_flags_nan_unsafe_neutralization():
+    f = _audit(_nan_unsafe_prog(False),
+               _mask_contract({"result": "pad-passthrough"}),
+               checks=["mask"])
+    assert _mask_kinds(f) == ["nan-unsafe-neutralization"]
+    assert _audit(_nan_unsafe_prog(True),
+                  _mask_contract({"result": "pad-exact-zero"}),
+                  checks=["mask"]) == []
+
+
+def test_mask_flags_unmasked_reduction():
+    f = _audit(_reduction_prog(False),
+               _mask_contract({"result": "live-only"}), checks=["mask"])
+    assert _mask_kinds(f) == ["pad-escape", "unmasked-reduction"]
+    assert _audit(_reduction_prog(True),
+                  _mask_contract({"result": "live-only"}),
+                  checks=["mask"]) == []
+
+
+def test_mask_flags_unsentineled_argreduce():
+    f = _audit(_argreduce_prog(False),
+               _mask_contract({"result": "live-only"}), checks=["mask"])
+    assert _mask_kinds(f) == ["pad-escape", "unsentineled-argreduce"]
+    assert _audit(_argreduce_prog(True),
+                  _mask_contract({"result": "live-only"}),
+                  checks=["mask"]) == []
+
+
+def test_mask_contract_surface_paths():
+    clean = _mask_prog(
+        lambda d: jnp.where(d["active"][:, None], d["x"], 0.0))
+
+    f = _audit(clean, {}, checks=["mask"])
+    assert len(f) == 1 and "no [mask] section" in f[0].message
+
+    f = _audit(clean, _mask_contract({}), checks=["mask"])
+    assert len(f) == 1 and "no [mask.outputs] pin" in f[0].message
+
+    f = _audit(clean, _mask_contract({"result": "pad-zeroish"}),
+               checks=["mask"])
+    assert len(f) == 1 and "unknown pad class" in f[0].message
+
+    f = _audit(clean, _mask_contract({"result": "live-only"}),
+               checks=["mask"])
+    assert len(f) == 1 and "pad class drifted" in f[0].message
+
+    f = _audit(clean, _mask_contract({"result": "pad-exact-zero",
+                                      "ghost": "live-only"}),
+               checks=["mask"])
+    assert len(f) == 1 and "stale pin" in f[0].message
+
+    f = _audit(clean, {"mask": {"axes": [],
+                                "outputs": {"result": "live-only"}}},
+               checks=["mask"])
+    assert len(f) == 1 and "stale [mask.outputs] table" in f[0].message
+
+    f = _audit(clean, {"mask": {"axes": [{"name": "fiber"}]}},
+               checks=["mask"])
+    assert len(f) == 1 and "needs both `name` and `mask`" in f[0].message
+
+
+def test_mask_suppression_used_and_unused():
+    sup = [{"check": "mask", "match": "nan-unsafe-neutralization",
+            "reason": "fixture: deliberate multiplicative mask under test"}]
+    contract = dict(_mask_contract({"result": "pad-passthrough"}),
+                    suppress=sup)
+    assert _audit(_nan_unsafe_prog(False), contract, checks=["mask"]) == []
+
+    stale = dict(_mask_contract({"result": "pad-exact-zero"}), suppress=sup)
+    f = _audit(_nan_unsafe_prog(True), stale, checks=["mask"])
+    assert len(f) == 1 and "unused suppression" in f[0].message
+
+
+def test_mask_violations_gate_the_cli_exit_code(tmp_path, monkeypatch):
+    """The acceptance pin: every seeded violation flips `--check mask` to
+    exit 1; the disciplined twins exit 0."""
+    import skellysim_tpu.audit.kernels as kernels_mod
+    import skellysim_tpu.audit.programs as programs_mod
+
+    def rc(prog, contract):
+        monkeypatch.setattr(programs_mod, "all_programs", lambda: [prog])
+        monkeypatch.setattr(kernels_mod, "all_kernels", lambda: [])
+        monkeypatch.setattr(engine, "CONTRACT_DIR", str(tmp_path))
+        path = tmp_path / f"{prog.name}.toml"
+        path.write_text(toml_io.dumps(dict({"program": {"name": prog.name}},
+                                           **contract)))
+        return audit_main(["--check", "mask"])
+
+    live = _mask_contract({"result": "live-only"})
+    assert rc(_escape_prog(), live) == 1
+    assert rc(_nan_unsafe_prog(False),
+              _mask_contract({"result": "pad-passthrough"})) == 1
+    assert rc(_reduction_prog(False), live) == 1
+    assert rc(_argreduce_prog(False), live) == 1
+    assert rc(_nan_unsafe_prog(True),
+              _mask_contract({"result": "pad-exact-zero"})) == 0
+    assert rc(_reduction_prog(True), live) == 0
+    assert rc(_argreduce_prog(True), live) == 0
+
+
+def test_mask_dump_contract_emits_observed_pins(tmp_path, monkeypatch):
+    """--dump-contract re-reads the EXISTING axes declaration (declaring a
+    capacity axis is a human decision) and emits the analyzer-proven class
+    for every output under it."""
+    monkeypatch.setattr(engine, "CONTRACT_DIR", str(tmp_path))
+    prog = _mask_prog(
+        lambda d: jnp.where(d["active"][:, None], d["x"], 0.0),
+        name="dumpmask")
+    (tmp_path / "dumpmask.toml").write_text(toml_io.dumps(
+        dict({"program": {"name": "dumpmask"}}, **_mask_contract({}))))
+    data = toml_io.loads(engine.dump_contract(prog))
+    assert data["mask"]["outputs"]["result"] == "pad-exact-zero"
+
+
+def test_mask_pad_exact_zero_pin_matches_runtime_bitwise():
+    """The runtime cross-check: the class the analyzer proves for the
+    where-select twin is exactly what executing the program shows — dead
+    rows come out bitwise +0.0 even when their inputs hold inf/NaN
+    garbage (the property test_buckets pins for the real step programs)."""
+    fn = lambda d: jnp.where(d["active"][:, None], 1.0 / d["x"], 0.0)
+    bp = built_from(jax.jit(fn), *_mask_args())
+    report = ck.mask_summary(
+        bp, ck.mask_axes_from_contract(
+            _mask_contract({})["mask"], "x")[0])[0]
+    assert dict(report.classes)["result"] == "pad-exact-zero"
+
+    (arg,) = _mask_args()
+    x = arg["x"].at[5].set(jnp.inf).at[6].set(jnp.nan).at[7].set(0.0)
+    out = jax.jit(fn)({"x": x, "active": arg["active"]})
+    dead = np.asarray(out)[5:]
+    assert (np.signbit(dead) == False).all()  # noqa: E712 — bitwise +0.0
+    assert (np.asarray(dead) == 0.0).all()
+
+
+def test_mask_real_step_pins_match_bitwise_padding_tests():
+    """The shipped contracts' pad-class pins encode the same invariants
+    the runtime padding-parity tests assert (test_buckets): padded state
+    rows ride through bitwise-unchanged, the refreshed active mask is
+    exact zeros at dead slots, and the solution vector is live-only."""
+    for name in ("step_single", "step_flight", "step_mixed"):
+        contract, findings = engine.load_contract(name)
+        assert findings == [], name
+        pins = contract["mask"]["outputs"]
+        assert pins["0.fibers.x"] == "pad-passthrough", name
+        assert pins["0.fibers.tension"] == "pad-passthrough", name
+        assert pins["0.fibers.active"] == "pad-exact-zero", name
+        assert pins["1"] == "live-only", name
+    contract, findings = engine.load_contract("ensemble_step")
+    assert findings == []
+    assert contract["mask"]["outputs"]["0.states.fibers.x"] == \
+        "pad-passthrough"
+
+
 # ----------------------------------------------- contract file / suppression
 
 def test_contract_validation_findings(tmp_path, monkeypatch):
@@ -443,8 +722,9 @@ def test_empty_suppress_match_never_suppresses():
 
 def test_unused_suppression_is_a_finding():
     prog = _prog(lambda x: x + 1.0, jnp.zeros(2, jnp.float64))
-    contract = {"suppress": [{"check": "dtype-flow", "match": "never-hits",
-                             "reason": "stale"}]}
+    contract = {"mask": {"axes": []},
+                "suppress": [{"check": "dtype-flow", "match": "never-hits",
+                              "reason": "stale"}]}
     f = _audit(prog, contract)
     assert len(f) == 1 and "unused suppression" in f[0].message
     # a check-filtered run must not flag suppressions for skipped checks

@@ -457,6 +457,67 @@ def test_raw_dma_suppressed_with_pragma(tmp_path):
     assert lint_paths([p]) == []
 
 
+# ----------------------------------------------------------- mul-mask rule
+
+def test_mul_mask_flags_field_times_mask(tmp_path):
+    """`x * mask` neutralization: one overflowed lane makes 0 * inf = NaN.
+    Both operand orders and the broadcast/cast spellings must flag."""
+    p = _write(tmp_path, "ops/mod.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x, g):\n"
+        "    a = x * g.active\n"
+        "    b = g.node_mask[:, None] * x\n"
+        "    c = x * g.active.astype(x.dtype)\n"
+        "    d = (jnp.arange(8, dtype=jnp.int32) <= 3) * x\n"
+        "    return a + b + c + d\n"))
+    assert _rules(lint_paths([p])) == ["mul-mask"] * 4
+
+
+def test_mul_mask_passes_where_select_and_occupancy_math(tmp_path):
+    """The disciplined twin: jnp.where selection never flags, and
+    mask-times-mask occupancy counting is integer math, not field
+    neutralization."""
+    p = _write(tmp_path, "ops/mod.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x, w, g):\n"
+        "    a = jnp.where(g.active, x, 0.0)\n"
+        "    n = g.active * g.node_mask\n"
+        "    b = w * x\n"
+        "    return a, n, b\n"))
+    assert lint_paths([p]) == []
+
+
+def test_mul_mask_ignores_unreachable_code(tmp_path):
+    p = _write(tmp_path, "ops/mod.py", (
+        "def host_helper(x, mask):\n"
+        "    return x * mask\n"))
+    assert lint_paths([p]) == []
+
+
+def test_mul_mask_suppressed_with_pragma(tmp_path):
+    """A pragma with a finiteness argument is the licensed escape hatch;
+    an unused one is itself a finding (the pragma stays load-bearing)."""
+    p = _write(tmp_path, "ops/mod.py", (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, g):\n"
+        "    # skelly-lint: ignore[mul-mask] — x is a bounded quadrature "
+        "weight, provably finite\n"
+        "    return x * g.active\n"))
+    assert lint_paths([p]) == []
+    stale = _write(tmp_path, "ops/stale.py", (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, g):\n"
+        "    # skelly-lint: ignore[mul-mask] — nothing here needs it\n"
+        "    return x + g.active\n"))
+    assert _rules(lint_paths([stale])) == ["lint-pragma"]
+
+
 def test_repo_tree_is_lint_clean():
     """The acceptance gate: the shipped tree has zero unsuppressed findings
     (CI runs the CLI equivalent in every tier)."""
