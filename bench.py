@@ -48,6 +48,19 @@ Headline: mixed-precision coupled solve at the walkthrough scale when it ran
 *stricter* achieved tolerance); falls back to the f32 coupled solve, then to
 kernel throughput vs the NumPy oracle.
 
+Campaign mode (skelly-roofline): ``python bench.py --campaign`` runs every
+group in one command, captures a device-trace ``profile_session`` per
+headline group (ROOFLINE_PROGRAMS) and folds the per-phase roofline
+verdicts (`obs roofline`) into ONE manifest,
+``benchmarks/CAMPAIGN_rNN.json`` — groups run/skipped, auto-bumped archive
+rounds (BENCH_ROUND_<GROUP>, appended, never overwritten), the armed
+`obs perf --compare` gate verdict, full provenance, and the explicit
+``downscaled`` bool every bench artifact now carries (PROVENANCE_KEYS).
+``--campaign-groups a,b`` restricts to a subset (the CI smoke);
+``--render-headlines [--check]`` regenerates (or freshness-checks) the
+docs/performance.md headline table from the archived rounds.
+`obs campaign benchmarks/CAMPAIGN_rNN.json` validates/renders a manifest.
+
 Bench-only shortcut: shell quadrature weights are uniform (area/N on the
 generated nodes) instead of the Reeger-Fornberg RBF weights, and the dense
 shell operator + its inverse are assembled/inverted on-device — the host here
@@ -61,6 +74,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -85,9 +99,12 @@ _T_START = time.monotonic()
 
 #: real-stdout fd saved by _steal_stdout; the one JSON line goes here
 _REAL_STDOUT_FD = None
-#: partial/final results mirrored here after every section
-BENCH_JSON_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH.json")
+#: partial/final results mirrored here after every section;
+#: BENCH_JSON_PATH redirects (the campaign CI smoke must not clobber the
+#: real mirror with a one-group run)
+BENCH_JSON_PATH = os.environ.get(
+    "BENCH_JSON_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH.json"))
 
 #: skelly-scope artifact-format stamp on every bench artifact (BENCH.json,
 #: the headline line, MULTICHIP_*.json). Deliberately a LITERAL, not an
@@ -1172,22 +1189,21 @@ def _group_scenarios(extra, ck, on_acc):
 #: current multichip measurement round; bumping this IS the re-measurement
 #: protocol — the new round lands at the repo root, every round (old and
 #: new) is archived under benchmarks/, stale root rounds are pruned
-#: (artifact hygiene, ISSUE 8: r01..r05 no longer accumulate at the root)
-MULTICHIP_ROUND = "r07"
+#: (artifact hygiene, ISSUE 8: r01..r05 no longer accumulate at the root).
+#: r08 (skelly-roofline): the d4/d8 coupled ladder re-pinned at the
+#: post-spectral/maskflow tree via the first `--campaign` run.
+MULTICHIP_ROUND = "r08"
 
-#: repo-root artifact the multichip group writes (ISSUE 3: the measured
-#: strong-scaling ladder replacing the projected 8-chip numbers).
-#: BENCH_MULTICHIP_PATH redirects it (the bench contract test points it at
-#: a tmp file so a budget-starved smoke run never clobbers the real ladder)
-MULTICHIP_JSON_PATH = os.environ.get(
-    "BENCH_MULTICHIP_PATH",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                 f"MULTICHIP_{MULTICHIP_ROUND}.json"))
-
+#: current treecode measurement round (root TREECODE_<round>.json + the
+#: benchmarks/ mirror, same hygiene as the multichip ladder)
+TREECODE_ROUND = "r06"
 
 #: current measurement round per benchmarks/-only archived group
 #: (<GROUP>_rNN.json naming, the `obs perf --compare` convention);
-#: bumping a constant IS that group's re-measurement protocol
+#: bumping a constant IS that group's re-measurement protocol — except
+#: under `--campaign`, which auto-bumps every archived group to the next
+#: free round number (BENCH_ROUND_<GROUP>, set by the parent) so a
+#: campaign NEVER silently rewrites checked-in history
 SCENARIOS_ROUND = "r01"
 COMPILE_ROUND = "r01"
 FLIGHT_ROUND = "r01"
@@ -1199,6 +1215,53 @@ BENCH_ARCHIVE_DIR = os.environ.get(
     "BENCH_ARCHIVE_DIR",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
 
+#: uniform provenance stamp on every bench round artifact — pinned by
+#: tests/test_bench_contract.py across ALL groups (skelly-roofline):
+#: `downscaled` is an EXPLICIT bool (false on real-backend rounds, not
+#: merely absent), so the perf gate's arming condition is readable off
+#: any artifact without knowing which bench wrote it
+PROVENANCE_KEYS = ("backend", "jax_version", "device_kind", "downscaled",
+                   "telemetry_version")
+
+
+def _round_id(group: str, default: str) -> str:
+    """The round a group archives under: the checked-in constant for
+    manual `--group` runs, the parent's auto-bumped BENCH_ROUND_<GROUP>
+    under `--campaign`."""
+    return os.environ.get(f"BENCH_ROUND_{group.upper()}", default)
+
+
+def _next_round_id(group: str) -> str:
+    """First free rNN for a group across the archive dir AND the repo
+    root (the treecode history starts root-only) — campaign runs append
+    rounds, never overwrite them."""
+    pat = re.compile(rf"^{group.upper()}_r(\d+)\.json$")
+    best = 0
+    here = os.path.dirname(os.path.abspath(__file__))
+    for d in (BENCH_ARCHIVE_DIR, here):
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        for fname in names:
+            m = pat.match(fname)
+            if m:
+                best = max(best, int(m.group(1)))
+    return f"r{best + 1:02d}"
+
+
+def _stamp_provenance(payload: dict, extra: dict, generated_by: str) -> dict:
+    """The ONE stamping path every bench artifact writer goes through
+    (PROVENANCE_KEYS, skelly-roofline): backend/jax_version/device_kind
+    from the child's `obs.tracer.provenance()` values in ``extra``, the
+    downscale flag coerced to an explicit bool, the telemetry version."""
+    payload["generated_by"] = generated_by
+    for key in ("backend", "jax_version", "device_kind"):
+        payload[key] = extra.get(key)
+    payload["downscaled"] = bool(payload.get("downscaled"))
+    payload["telemetry_version"] = TELEMETRY_VERSION
+    return payload
+
 
 def _archive_round(group: str, round_id: str, doc: dict, extra: dict):
     """Mirror one group's finished section under benchmarks/ as
@@ -1207,11 +1270,10 @@ def _archive_round(group: str, round_id: str, doc: dict, extra: dict):
     scenarios/compile/flight answer to the multichip/treecode history
     (skelly-pulse; docs/performance.md). Provenance-stamped like every
     artifact; hygiene must never cost a measurement."""
-    payload = dict(doc)
-    payload["generated_by"] = f"bench.py --group {group.lower()}"
-    for key in ("backend", "jax_version", "device_kind"):
-        payload[key] = extra.get(key)
-    payload["telemetry_version"] = TELEMETRY_VERSION
+    round_id = _round_id(group, round_id)
+    payload = _stamp_provenance(dict(doc), extra,
+                                f"bench.py --group {group.lower()}")
+    payload["round"] = round_id
     try:
         os.makedirs(BENCH_ARCHIVE_DIR, exist_ok=True)
         path = os.path.join(BENCH_ARCHIVE_DIR,
@@ -1223,28 +1285,50 @@ def _archive_round(group: str, round_id: str, doc: dict, extra: dict):
         pass
 
 
-def _archive_multichip_round(doc: dict):
-    """Mirror the round under benchmarks/ and prune stale root rounds so
-    only the LATEST round lives at the repo root (docs/performance.md
-    cites `benchmarks/MULTICHIP_r*.json` for history). Redirected runs
-    (BENCH_MULTICHIP_PATH set — the contract smoke) archive nothing."""
-    if os.environ.get("BENCH_MULTICHIP_PATH"):
+def _archive_root_round(group: str, doc: dict):
+    """Mirror a root-artifact round (MULTICHIP/TREECODE) under the
+    archive dir and prune stale root rounds so only the LATEST round
+    lives at the repo root (docs/performance.md cites
+    `benchmarks/<GROUP>_r*.json` for history). Redirected runs
+    (BENCH_<GROUP>_PATH set — the contract smoke) archive nothing."""
+    if os.environ.get(f"BENCH_{group.upper()}_PATH"):
         return
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
-    current = f"MULTICHIP_{MULTICHIP_ROUND}.json"
+    current = f"{group.upper()}_{doc.get('round')}.json"
     try:
-        arch = os.path.join(here, "benchmarks")
-        os.makedirs(arch, exist_ok=True)
-        with open(os.path.join(arch, current), "w") as fh:
+        os.makedirs(BENCH_ARCHIVE_DIR, exist_ok=True)
+        with open(os.path.join(BENCH_ARCHIVE_DIR, current), "w") as fh:
             json.dump(doc, fh, indent=1)
             fh.write("\n")
-        for p in glob.glob(os.path.join(here, "MULTICHIP_r*.json")):
+        for p in glob.glob(os.path.join(here,
+                                        f"{group.upper()}_r*.json")):
             if os.path.basename(p) != current:
                 os.remove(p)
     except Exception:
         pass  # hygiene must never cost a measurement
+
+
+def _multichip_json_path(round_id: str) -> str:
+    """Repo-root artifact the multichip group writes (ISSUE 3: the
+    measured strong-scaling ladder). BENCH_MULTICHIP_PATH redirects it
+    (the bench contract test points it at a tmp file so a budget-starved
+    smoke run never clobbers the real ladder)."""
+    return os.environ.get(
+        "BENCH_MULTICHIP_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     f"MULTICHIP_{round_id}.json"))
+
+
+def _treecode_json_path(round_id: str) -> str:
+    """Repo-root artifact the treecode group writes (ISSUE 6: the
+    measured O(N^2) -> O(N log N) crossover). BENCH_TREECODE_PATH
+    redirects it, same contract as the multichip path."""
+    return os.environ.get(
+        "BENCH_TREECODE_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     f"TREECODE_{round_id}.json"))
 
 
 def _bench_multichip_matvec(n_dev, r, f, mesh_cache):
@@ -1311,22 +1395,18 @@ def _group_multichip(extra, ck, on_acc):
     ck()
 
     def publish():
-        doc = dict(out)
-        doc["generated_by"] = "bench.py --group multichip"
-        doc["round"] = MULTICHIP_ROUND
-        doc["backend"] = extra.get("backend")
         # provenance (skelly-pulse): the round artifact self-describes the
         # runtime + hardware it measured (obs.tracer.provenance, stamped
-        # into `extra` by _child_main); `downscaled` is already on `out`
-        doc["jax_version"] = extra.get("jax_version")
-        doc["device_kind"] = extra.get("device_kind")
-        doc["telemetry_version"] = TELEMETRY_VERSION
+        # into `extra` by _child_main)
+        doc = _stamp_provenance(dict(out), extra,
+                                "bench.py --group multichip")
+        doc["round"] = _round_id("multichip", MULTICHIP_ROUND)
         try:
-            with open(MULTICHIP_JSON_PATH, "w") as fh:
+            with open(_multichip_json_path(doc["round"]), "w") as fh:
                 json.dump(doc, fh, indent=1)
                 fh.write("\n")
             out.pop("artifact_error", None)
-            _archive_multichip_round(doc)
+            _archive_root_round("multichip", doc)
         except Exception as e:
             # never crash the measurement over an unwritable artifact path,
             # but never hide it either — the marker rides into BENCH.json
@@ -1554,16 +1634,6 @@ def _group_collectives(extra, ck, on_acc):
     ck()
 
 
-#: repo-root artifact the treecode group writes (ISSUE 6: the measured
-#: O(N^2) -> O(N log N) crossover for the treecode pair evaluator).
-#: BENCH_TREECODE_PATH redirects it (the bench contract test points it at
-#: a tmp file so a budget-starved smoke run never clobbers the real curve)
-TREECODE_JSON_PATH = os.environ.get(
-    "BENCH_TREECODE_PATH",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                 "TREECODE_r06.json"))
-
-
 def _group_treecode(extra, ck, on_acc):
     """ISSUE 6: wall + pairs/sec for the dense Stokeslet tile vs the
     barycentric treecode (`ops.treecode`) at N in {1k, 4k, 16k, 64k}
@@ -1571,7 +1641,7 @@ def _group_treecode(extra, ck, on_acc):
     role the evaluator serves in the implicit solve. The tree's rate is
     EQUIVALENT dense pairs/sec (N^2 / wall), so tree_vs_direct > 1 means
     the treecode beats the O(N^2) tile outright; the smallest such N is
-    the measured crossover, recorded in TREECODE_r06.json
+    the measured crossover, recorded in TREECODE_<round>.json
     (downscale-flagged on CPU like the MULTICHIP rounds)."""
     import jax.numpy as jnp
 
@@ -1587,17 +1657,15 @@ def _group_treecode(extra, ck, on_acc):
     ck()
 
     def publish():
-        doc = dict(out)
-        doc["generated_by"] = "bench.py --group treecode"
-        doc["backend"] = extra.get("backend")
-        doc["jax_version"] = extra.get("jax_version")
-        doc["device_kind"] = extra.get("device_kind")
-        doc["telemetry_version"] = TELEMETRY_VERSION
+        doc = _stamp_provenance(dict(out), extra,
+                                "bench.py --group treecode")
+        doc["round"] = _round_id("treecode", TREECODE_ROUND)
         try:
-            with open(TREECODE_JSON_PATH, "w") as fh:
+            with open(_treecode_json_path(doc["round"]), "w") as fh:
                 json.dump(doc, fh, indent=1)
                 fh.write("\n")
             out.pop("artifact_error", None)
+            _archive_root_round("treecode", doc)
         except Exception as e:
             # never crash the measurement over an unwritable artifact path,
             # but never hide it either — the marker rides into BENCH.json
@@ -1665,7 +1733,7 @@ def _group_spectral(extra, ck, on_acc):
     must GROW ~linearly with N while the dense tile's stays flat —
     sub-quadratic scaling shows up as that growth, and the smallest N
     with spectral_vs_direct > 1 is the measured crossover
-    (benchmarks/SPECTRAL_r01.json; downscale-flagged on CPU like the
+    (benchmarks/SPECTRAL_rNN.json; downscale-flagged on CPU like the
     treecode round). The dense tile is a FREE-SPACE sum — the comparison
     is wall-per-matvec for the solver slot, not numerical parity."""
     import jax.numpy as jnp
@@ -1929,6 +1997,44 @@ GROUPS = [
     ("scenarios", _group_scenarios, 0.8),
 ]
 
+#: campaign-profiled groups -> the program whose cost baseline the
+#: roofline join apportions device time against (skelly-roofline); the
+#: other groups run many unrelated modules, so a single-program join
+#: would misattribute and they stay unprofiled
+ROOFLINE_PROGRAMS = {
+    "multichip": "step_spmd_d2",
+    "treecode": "stokeslet_tree",
+    "spectral": "stokeslet_spectral",
+    "flight": "step_flight",
+    "ensemble": "ensemble_step",
+    "scenarios": "ensemble_step",
+}
+
+
+def _roofline_summary(profile_dir: str, group: str, extra: dict):
+    """Trimmed per-phase roofline verdicts for the campaign manifest —
+    the full report stays re-derivable from the profile dir via
+    `obs roofline DIR`; a failed join is recorded, never fatal."""
+    try:
+        from skellysim_tpu.obs import roofline as rl
+
+        doc = rl.roofline_report(profile_dir,
+                                 program=ROOFLINE_PROGRAMS.get(group),
+                                 device_kind=extra.get("device_kind"))
+        return {
+            "program": doc.get("program"),
+            "device_kind": doc.get("device_kind"),
+            "rated_as": doc.get("rated_as"),
+            "attributed_frac": doc.get("attributed_frac"),
+            "classified_frac": doc.get("classified_frac"),
+            "phases": [{k: p.get(k) for k in
+                        ("phase", "share", "comm_frac", "verdict",
+                         "achieved_vs_peak")}
+                       for p in doc.get("phases", [])[:12]],
+        }
+    except Exception as e:
+        return {"error": _short_err(e)}
+
 
 # ------------------------------------------------------------ child / parent
 
@@ -1977,6 +2083,29 @@ def _child_main(group: str, out_path: str):
     ck()
 
     fn = next(f for name, f, _ in GROUPS if name == group)
+    prof_dir = os.environ.get("BENCH_PROFILE_DIR")
+
+    def run():
+        if not prof_dir:
+            fn(extra, ck, on_acc)
+            return
+        # campaign mode (skelly-roofline): capture one device trace around
+        # the whole group, then fold the roofline verdicts into the child
+        # payload; profiling failures downgrade to an unprofiled run and
+        # a recorded error — never a lost measurement
+        try:
+            from skellysim_tpu.obs.profile import profile_session
+        except Exception as e:
+            extra[f"roofline_{group}"] = {"error": _short_err(e)}
+            fn(extra, ck, on_acc)
+            return
+        try:
+            with profile_session(prof_dir):
+                fn(extra, ck, on_acc)
+        finally:
+            extra[f"roofline_{group}"] = _roofline_summary(prof_dir, group,
+                                                           extra)
+
     # skelly-scope: record the group through a span into the shared bench
     # trace stream (`obs summarize .bench_trace.jsonl` renders the per-group
     # wall breakdown); never let telemetry failures cost a measurement
@@ -1991,16 +2120,47 @@ def _child_main(group: str, out_path: str):
         with scope:
             with obs_tracer.span("bench_group", group=group,
                                  backend=extra.get("backend")):
-                fn(extra, ck, on_acc)
+                run()
         tracer.close()
     else:
-        fn(extra, ck, on_acc)
+        run()
     extra["group_total_s"] = round(time.monotonic() - _T_START, 1)
     ck()
 
 
-def _parent_main():
+def _campaign_gate():
+    """Arm `obs perf --compare` over the archive dir (subprocess — the
+    parent stays jax-free) and capture rc + the machine report."""
+    gate = {"rc": -1}
+    cmd = [sys.executable, "-m", "skellysim_tpu.obs", "perf", "--compare",
+           BENCH_ARCHIVE_DIR, "--json"]
+    try:
+        p = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
+        gate["rc"] = p.returncode
+        try:
+            gate["report"] = json.loads(p.stdout)
+        except Exception as e:
+            gate["report_error"] = _short_err(e)
+    except Exception as e:
+        gate["error"] = _short_err(e)
+    return gate
+
+
+def _parent_main(campaign: bool = False, groups_filter=None):
     extra = {}
+    if groups_filter:
+        known = {name for name, _, _ in GROUPS}
+        unknown = [g for g in groups_filter if g not in known]
+        if unknown:
+            _emit({"metric": "bench_failed", "value": 0.0, "unit": "",
+                   "vs_baseline": 0.0,
+                   "error": "unknown campaign group(s): "
+                            + ",".join(unknown),
+                   "telemetry_version": TELEMETRY_VERSION})
+            sys.exit(2)
+        run_groups = [g for g in GROUPS if g[0] in set(groups_filter)]
+    else:
+        run_groups = GROUPS
     try:  # fresh span stream per bench run (children append per group)
         os.remove(BENCH_TRACE_PATH)
     except OSError:
@@ -2016,11 +2176,24 @@ def _parent_main():
     _checkpoint(extra)
 
     here = os.path.dirname(os.path.abspath(__file__))
+    round_env, statuses, profile_root = {}, {}, None
+    if campaign:
+        # auto-bump every archived group to its next free round so the
+        # campaign APPENDS history instead of rewriting checked-in rounds
+        for g in ("multichip", "treecode", "spectral", "scenarios",
+                  "compile", "flight"):
+            round_env[f"BENCH_ROUND_{g.upper()}"] = _next_round_id(g)
+        profile_root = os.environ.get(
+            "BENCH_PROFILE_ROOT", os.path.join(here, ".bench_profile"))
+        import shutil
+
+        shutil.rmtree(profile_root, ignore_errors=True)
     backend = probed or "cpu"
-    for i, (name, _, weight) in enumerate(GROUPS):
+    for i, (name, _, weight) in enumerate(run_groups):
         rem = _remaining()
         if rem < 50:
             extra[f"group_{name}"] = {"skipped_budget": int(rem)}
+            statuses[name] = {"status": "skipped_budget", "s": 0.0}
             continue
         if force_cpu and rem > 180:
             # the tunnel is intermittent: one quick re-probe before each
@@ -2040,7 +2213,7 @@ def _parent_main():
                     extra.pop("downscaled", None)
                     extra.pop("downscale_reason", None)
             rem = _remaining()  # a wedged re-probe burned up to 60 s
-        wsum = sum(w for _, _, w in GROUPS[i:])
+        wsum = sum(w for _, _, w in run_groups[i:])
         t_g = max(60.0, min(rem - 15.0, rem * weight / wsum))
         out_path = os.path.join(here, f".bench_{name}.json")
         try:
@@ -2049,6 +2222,9 @@ def _parent_main():
             pass
         env = dict(os.environ)
         env["BENCH_BUDGET_S"] = str(max(40.0, t_g - 15.0))
+        env.update(round_env)
+        if campaign and name in ROOFLINE_PROGRAMS:
+            env["BENCH_PROFILE_DIR"] = os.path.join(profile_root, name)
         if force_cpu:
             env["BENCH_FORCE_CPU"] = "1"
         t0 = time.perf_counter()
@@ -2078,7 +2254,53 @@ def _parent_main():
             info["no_output"] = True
         if rc not in (0,):
             extra[f"group_{name}"] = info
+        statuses[name] = {
+            "status": ("ok" if rc == 0 else
+                       "timeout" if rc == "timeout" else f"error rc={rc}"),
+            "s": info["s"],
+        }
         _checkpoint(extra)
+
+    campaign_ref = None
+    if campaign:
+        for name, _, _ in GROUPS:
+            if name not in statuses:
+                statuses[name] = {"status": "skipped_budget", "s": 0.0,
+                                  "filtered": True}
+        rooflines = {}
+        for name, _, _ in GROUPS:
+            summ = extra.pop(f"roofline_{name}", None)
+            if summ is not None:
+                rooflines[name] = summ
+        gate = _campaign_gate()
+        manifest = {
+            "round": _next_round_id("campaign"),
+            "groups": statuses,
+            "rounds": {k[len("BENCH_ROUND_"):].lower(): v
+                       for k, v in round_env.items()},
+            "rooflines": rooflines,
+            "gate": gate,
+            "downscaled": bool(force_cpu or extra.get("downscaled")),
+        }
+        if manifest["downscaled"]:
+            manifest["downscale_reason"] = extra.get("downscale_reason",
+                                                     _CPU_FALLBACK)
+        # `backend` lives in a parent local (children's values are popped
+        # out of their payloads), so hand the stamp a merged view
+        _stamp_provenance(manifest, {**extra, "backend": backend},
+                          "bench.py --campaign")
+        path = os.path.join(BENCH_ARCHIVE_DIR,
+                            f"CAMPAIGN_{manifest['round']}.json")
+        try:
+            os.makedirs(BENCH_ARCHIVE_DIR, exist_ok=True)
+            with open(path, "w") as fh:
+                json.dump(manifest, fh, indent=1)
+                fh.write("\n")
+        except Exception as e:
+            extra["campaign_artifact_error"] = _short_err(e)
+        campaign_ref = {"manifest": path, "round": manifest["round"],
+                        "gate_rc": gate.get("rc")}
+        extra["campaign"] = campaign_ref
 
     # --- headline ------------------------------------------------------------
     coupled = extra.get("coupled_solve", {})
@@ -2129,8 +2351,74 @@ def _parent_main():
     line["total_s"] = round(time.monotonic() - _T_START, 1)
     line["backend"] = backend
     line["telemetry_version"] = TELEMETRY_VERSION
+    if campaign_ref is not None:
+        line["campaign"] = campaign_ref
     line["extra"] = extra
     _emit(line)
+
+
+#: markers bracketing the generated headline table in docs/performance.md
+HEADLINES_BEGIN = ("<!-- headlines:begin "
+                   "(generated: python bench.py --render-headlines) -->")
+HEADLINES_END = "<!-- headlines:end -->"
+
+
+def _render_headlines(check: bool = False) -> int:
+    """Regenerate the docs/performance.md headline table from the archived
+    rounds (the `obs perf --json` latest view — one row per group per
+    gated headline, provenance column included). ``--check`` exits 1 when
+    the committed table is stale; 2 when the markers or the perf report
+    are missing. Parent-side: jax-free by the same subprocess rule as the
+    campaign gate."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    doc_path = os.path.join(here, "docs", "performance.md")
+    cmd = [sys.executable, "-m", "skellysim_tpu.obs", "perf", "--compare",
+           BENCH_ARCHIVE_DIR, "--json"]
+    try:
+        p = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
+        report = json.loads(p.stdout)
+    except Exception as e:
+        sys.stderr.write("render-headlines: perf report failed: "
+                         f"{_short_err(e)}\n")
+        return 2
+    rows = ["| group | round | headline metric | value | provenance |",
+            "|---|---|---|---|---|"]
+    for group in sorted(report.get("groups", {})):
+        latest = (report["groups"][group] or {}).get("latest") or {}
+        prov = latest.get("backend") or "?"
+        if latest.get("downscaled"):
+            prov += " (downscaled)"
+        rnd = latest.get("round") or "?"
+        heads = latest.get("headlines") or {}
+        # unmeasured metrics (budget-starved rungs archive as null) are
+        # omitted, not rendered as "None" — absence is visible in the JSON
+        measured = {m: v for m, v in heads.items() if v is not None}
+        if not measured:
+            rows.append(f"| {group} | {rnd} | — | — | {prov} |")
+        for metric in sorted(measured):
+            v = measured[metric]
+            val = f"{v:g}" if isinstance(v, (int, float)) else str(v)
+            rows.append(f"| {group} | {rnd} | {metric} | {val} | {prov} |")
+    block = "\n".join([HEADLINES_BEGIN, *rows, HEADLINES_END])
+    try:
+        with open(doc_path) as fh:
+            text = fh.read()
+        i = text.index(HEADLINES_BEGIN)
+        j = text.index(HEADLINES_END) + len(HEADLINES_END)
+    except (OSError, ValueError):
+        sys.stderr.write(f"render-headlines: markers missing in {doc_path}\n")
+        return 2
+    updated = text[:i] + block + text[j:]
+    if updated == text:
+        return 0
+    if check:
+        sys.stderr.write("render-headlines: docs/performance.md headline "
+                         "table is stale — run "
+                         "`python bench.py --render-headlines`\n")
+        return 1
+    with open(doc_path, "w") as fh:
+        fh.write(updated)
+    return 0
 
 
 def main():
@@ -2143,7 +2431,22 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--group", default=None)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--campaign", action="store_true",
+                    help="run every group, profile the roofline groups, "
+                         "auto-bump archive rounds, write one "
+                         "CAMPAIGN_rNN.json manifest, arm the perf gate")
+    ap.add_argument("--campaign-groups", default=None,
+                    help="comma-separated subset of groups for --campaign "
+                         "(CI smoke)")
+    ap.add_argument("--render-headlines", action="store_true",
+                    help="regenerate the docs/performance.md headline "
+                         "table from the archived rounds")
+    ap.add_argument("--check", action="store_true",
+                    help="with --render-headlines: exit 1 if the table is "
+                         "stale instead of rewriting it")
     args = ap.parse_args()
+    if args.render_headlines:
+        sys.exit(_render_headlines(check=args.check))
     _steal_stdout()
     if args.group:
         # child: no stdout contract — results go to --out
@@ -2155,7 +2458,10 @@ if __name__ == "__main__":
             sys.exit(1)
         sys.exit(0)
     try:
-        main()
+        groups_filter = ([s.strip() for s in args.campaign_groups.split(",")
+                          if s.strip()]
+                         if args.campaign_groups else None)
+        _parent_main(campaign=args.campaign, groups_filter=groups_filter)
     except Exception as e:  # absolute backstop: the driver must see valid JSON
         _emit({"metric": "bench_failed", "value": 0.0, "unit": "",
                "vs_baseline": 0.0, "error": _short_err(e),

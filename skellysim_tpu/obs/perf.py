@@ -1,8 +1,8 @@
 """Bench-history regression gate: read the archived rounds, diff the
 ladder (skelly-pulse).
 
-`bench.py` archives round artifacts (``benchmarks/MULTICHIP_r01..r07``,
-root ``TREECODE_r06.json`` …) but until now nothing READ them — a ladder
+`bench.py` archives round artifacts (``benchmarks/MULTICHIP_r01..r08``,
+root ``TREECODE_r07.json`` …) but until now nothing READ them — a ladder
 regression only surfaced if someone eyeballed two JSONs. ``python -m
 skellysim_tpu.obs perf --compare DIR [--gate PCT]`` closes the loop:
 
@@ -23,6 +23,16 @@ fallback — every round so far; see `_mark_downscaled` in bench.py) report
 regressions as WARNINGS and exit 0: toy-scale CPU walls swing ±35%
 run-to-run, and a gate that cries wolf gets deleted. The gate ARMS
 ITSELF on the first real-backend round pair.
+
+Two comparisons per metric (skelly-roofline): the latest-two adjacent
+diff AND the drop vs the BEST parseable round — a slow multi-round drift
+(-15% per round for three rounds) passes every adjacent diff but not the
+vs-best column. Both gate with the same downscale discipline: vs-best is
+warn-only unless BOTH the latest and the best round are real-backend.
+
+``CAMPAIGN_rNN.json`` manifests (bench.py --campaign) live in the same
+dir but are NOT rounds — `scan_rounds` skips them; `validate_campaign` /
+`render_campaign` back the `obs campaign FILE` subcommand instead.
 
 jax-free (json only), cheap enough for every CI tier (<100 ms).
 """
@@ -130,6 +140,8 @@ def scan_rounds(bench_dir: str) -> dict:
         if not m:
             continue
         group = m.group(1).lower()
+        if group == "campaign":
+            continue   # campaign manifests are reports ABOUT rounds
         groups.setdefault(group, []).append(
             Round(group, int(m.group(2)), os.path.join(bench_dir, fname)))
     for rounds in groups.values():
@@ -147,6 +159,43 @@ def compare_rounds(prev: Round, cur: Round, gate_pct: float) -> list:
             continue
         pct = (b - a) / abs(a) * 100.0
         out.append((key, a, b, pct, pct < -gate_pct))
+    return out
+
+
+def best_rounds(parseable: list) -> dict:
+    """{metric: (best value, Round it came from)} over every parseable
+    round — the vs-best column's reference. Higher is better for every
+    gated metric; ties go to the EARLIEST round (a later equal round is
+    "recovered", not "new best")."""
+    best: dict = {}
+    for r in parseable:
+        for key, v in r.gated.items():
+            if key not in best or v > best[key][0]:
+                best[key] = (v, r)
+    return best
+
+
+def vs_best_entries(parseable: list, gate_pct: float) -> list:
+    """[(metric, best value, best Round, cur value, pct_vs_best,
+    regressed_vs_best, soft)] for the LATEST parseable round against the
+    best round per metric. ``soft`` (warn-only) when the latest OR the
+    best round is downscaled — the vs-best gate arms with the same
+    real-backend discipline as the adjacent diff."""
+    if not parseable:
+        return []
+    cur = parseable[-1]
+    best = best_rounds(parseable)
+    out = []
+    for key in sorted(cur.gated):
+        if key not in best:
+            continue
+        bv, br = best[key]
+        if bv <= 0 or br is cur:
+            continue
+        b = cur.gated[key]
+        pct = (b - bv) / abs(bv) * 100.0
+        out.append((key, bv, br, b, pct, pct < -gate_pct,
+                    cur.downscaled or br.downscaled))
     return out
 
 
@@ -178,6 +227,7 @@ def render_report(bench_dir: str, gate_pct: float = 25.0):
                     return h[:-(len(s) + 1)]
             return h
 
+        parseable = [r for r in rounds if r.parseable]
         rows = [("round",) + tuple(_hdr(h) for h in headline) + ("flags",)]
         for r in rounds:
             if not r.parseable:
@@ -188,12 +238,18 @@ def render_report(bench_dir: str, gate_pct: float = 25.0):
                          else f"{r.flat[h]:g}" for h in headline)
             rows.append((r.label,) + vals
                         + ("downscaled" if r.downscaled else "",))
+        if len(parseable) >= 2:
+            # the best-round column: where each headline peaked across the
+            # whole trajectory, so a slow drift is visible at a glance
+            best = best_rounds(parseable)
+            rows.append(("best",) + tuple(
+                f"{best[h][0]:g}@{best[h][1].label}" if h in best else "-"
+                for h in headline) + ("",))
         widths = [max(len(row[i]) for row in rows)
                   for i in range(len(rows[0]))]
         out.extend("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
                    for row in rows)
 
-        parseable = [r for r in rounds if r.parseable]
         if len(parseable) < 2:
             out.append(f"({group}: <2 parseable rounds — nothing to diff)")
             out.append("")
@@ -203,17 +259,39 @@ def render_report(bench_dir: str, gate_pct: float = 25.0):
         out.append(f"diff {prev.label} -> {cur.label} "
                    f"(gate {gate_pct:g}%"
                    + (", downscaled rounds: warn-only)" if soft else ")"))
-        for key, a, b, pct, regressed in compare_rounds(prev, cur,
-                                                        gate_pct):
+        vsb = {e[0]: e for e in vs_best_entries(parseable, gate_pct)}
+        adjacent = compare_rounds(prev, cur, gate_pct)
+        seen = set()
+        for key, a, b, pct, regressed in adjacent:
+            seen.add(key)
+            _, bv, br, _, pct_b, reg_b, soft_b = vsb.get(
+                key, (key, None, None, None, None, False, True))
+            hard = (regressed and not soft) or (reg_b and not soft_b)
+            warn = (not hard) and ((regressed and soft)
+                                   or (reg_b and soft_b))
             mark = ""
-            if regressed:
-                if soft:
-                    mark = "  WARN (downscaled — not gated)"
-                    warnings += 1
-                else:
-                    mark = "  REGRESSION"
-                    failures += 1
-            out.append(f"  {key}: {a:g} -> {b:g} ({pct:+.1f}%){mark}")
+            if hard:
+                mark = "  REGRESSION" + ("" if regressed else " (vs best)")
+                failures += 1
+            elif warn:
+                mark = "  WARN (downscaled — not gated)"
+                warnings += 1
+            tail = (f" | best {bv:g}@{br.label} ({pct_b:+.1f}% vs best)"
+                    if bv is not None else "")
+            out.append(f"  {key}: {a:g} -> {b:g} ({pct:+.1f}%){tail}{mark}")
+        # vs-best regressions on metrics the adjacent diff couldn't see
+        # (absent from the previous round) still gate
+        for key, (_, bv, br, b, pct_b, reg_b, soft_b) in sorted(vsb.items()):
+            if key in seen or not reg_b:
+                continue
+            if soft_b:
+                mark = "  WARN (downscaled — not gated)"
+                warnings += 1
+            else:
+                mark = "  REGRESSION (vs best)"
+                failures += 1
+            out.append(f"  {key}: {b:g} vs best {bv:g}@{br.label} "
+                       f"({pct_b:+.1f}% vs best){mark}")
         out.append("")
     if failures:
         out.append(f"skelly-pulse: {failures} gated regression(s) beyond "
@@ -243,18 +321,158 @@ def report_json(bench_dir: str, gate_pct: float = 25.0):
             "parseable": [r.label for r in parseable],
             "trajectory": {r.label: r.gated for r in parseable},
         }
+        group_failures = group_warnings = 0
+        if parseable:
+            latest = parseable[-1]
+            entry["latest"] = {
+                "round": latest.label,
+                "downscaled": latest.downscaled,
+                "backend": latest.doc.get("backend"),
+                "device_kind": latest.doc.get("device_kind"),
+                "headlines": {h: latest.flat.get(h)
+                              for h in HEADLINES.get(group,
+                                                     sorted(latest.gated)[:4])
+                              },
+            }
+            entry["best"] = {k: {"value": v, "round": r.label}
+                             for k, (v, r) in best_rounds(parseable).items()}
         if len(parseable) >= 2:
             prev, cur = parseable[-2], parseable[-1]
             soft = prev.downscaled or cur.downscaled
-            metrics = [
-                {"metric": k, "prev": a, "cur": b,
-                 "pct": round(pct, 2), "regressed": reg}
-                for k, a, b, pct, reg in compare_rounds(prev, cur,
-                                                        gate_pct)]
+            vsb = {e[0]: e for e in vs_best_entries(parseable, gate_pct)}
+            metrics = []
+            seen = set()
+            for k, a, b, pct, reg in compare_rounds(prev, cur, gate_pct):
+                seen.add(k)
+                m = {"metric": k, "prev": a, "cur": b,
+                     "pct": round(pct, 2), "regressed": reg}
+                if k in vsb:
+                    _, bv, br, _, pct_b, reg_b, soft_b = vsb[k]
+                    m.update({"best": bv, "best_round": br.label,
+                              "pct_vs_best": round(pct_b, 2),
+                              "regressed_vs_best": reg_b,
+                              "vs_best_downscaled": soft_b})
+                else:
+                    reg_b, soft_b = False, True
+                hard = (reg and not soft) or (reg_b and not soft_b)
+                if hard:
+                    group_failures += 1
+                elif (reg and soft) or (reg_b and soft_b):
+                    group_warnings += 1
+                metrics.append(m)
+            for k, (_, bv, br, b, pct_b, reg_b, soft_b) in sorted(
+                    vsb.items()):
+                if k in seen or not reg_b:
+                    continue
+                metrics.append({"metric": k, "cur": b, "best": bv,
+                                "best_round": br.label,
+                                "pct_vs_best": round(pct_b, 2),
+                                "regressed_vs_best": True,
+                                "vs_best_downscaled": soft_b})
+                if soft_b:
+                    group_warnings += 1
+                else:
+                    group_failures += 1
             entry["diff"] = {"from": prev.label, "to": cur.label,
                              "downscaled": soft, "metrics": metrics}
-            if not soft:
-                failures += sum(1 for m in metrics if m["regressed"])
+            failures += group_failures
+        entry["verdict"] = ("FAIL" if group_failures
+                            else "WARN" if group_warnings else "PASS")
         doc["groups"][group] = entry
+    doc["failures"] = failures
     rc = 2 if not doc["groups"] else (1 if failures else 0)
     return doc, rc
+
+
+# ------------------------------------------------------ campaign manifests
+
+#: provenance keys every campaign manifest must carry (the uniform bench
+#: artifact stamp, skelly-roofline)
+CAMPAIGN_PROVENANCE_KEYS = ("backend", "jax_version", "device_kind",
+                            "downscaled", "telemetry_version")
+
+#: statuses the campaign parent records per group
+CAMPAIGN_STATUSES = ("ok", "skipped_budget", "timeout", "error")
+
+
+def load_campaign(path: str) -> dict:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict):
+        raise ValueError("campaign manifest is not a JSON object")
+    return doc
+
+
+def validate_campaign(doc: dict) -> list:
+    """Structural errors in a CAMPAIGN_rNN.json manifest ([] = valid).
+
+    The contract the CI smoke and the round-trip test gate on: a round id,
+    a non-empty per-group status map, the uniform provenance stamp with an
+    EXPLICIT boolean downscale flag, a gate section carrying the perf
+    gate's exit code, and a rooflines map (may be empty — profiling is
+    best-effort, its absence is recorded, not fatal)."""
+    errs = []
+    if not isinstance(doc, dict):
+        return ["manifest is not a JSON object"]
+    rnd = doc.get("round")
+    if not isinstance(rnd, str) or not re.fullmatch(r"r\d{2,}", rnd):
+        errs.append(f"round: want 'rNN', got {rnd!r}")
+    groups = doc.get("groups")
+    if not isinstance(groups, dict) or not groups:
+        errs.append("groups: want a non-empty {name: {status: ...}} map")
+    else:
+        for name, g in groups.items():
+            status = g.get("status") if isinstance(g, dict) else None
+            if not (isinstance(status, str)
+                    and (status in CAMPAIGN_STATUSES
+                         or status.startswith("error"))):
+                errs.append(f"groups.{name}.status: got {status!r}")
+    for key in CAMPAIGN_PROVENANCE_KEYS:
+        if key not in doc:
+            errs.append(f"missing provenance key {key!r}")
+    if not isinstance(doc.get("downscaled"), bool):
+        errs.append("downscaled: want an explicit bool")
+    gate = doc.get("gate")
+    if not isinstance(gate, dict) or not isinstance(gate.get("rc"), int):
+        errs.append("gate: want {rc: int, ...} from `obs perf --json`")
+    if not isinstance(doc.get("rooflines"), dict):
+        errs.append("rooflines: want a {group: summary} map (may be empty)")
+    return errs
+
+
+def render_campaign(doc: dict) -> str:
+    """The `obs campaign FILE` text body (validity is the caller's check)."""
+    out = [f"== campaign {doc.get('round', '?')} "
+           f"({doc.get('generated_by', 'bench.py --campaign')}) =="]
+    out.append(f"backend: {doc.get('backend')}  device_kind: "
+               f"{doc.get('device_kind')}  jax: {doc.get('jax_version')}"
+               + ("  [DOWNSCALED]" if doc.get("downscaled") else ""))
+    rows = [("group", "status", "roofline")]
+    rooflines = doc.get("rooflines") or {}
+    for name, g in sorted((doc.get("groups") or {}).items()):
+        roof = rooflines.get(name)
+        if isinstance(roof, dict) and roof.get("phases"):
+            top = roof["phases"][0]
+            desc = (f"{roof.get('classified_frac', 0):.0%} classified; "
+                    f"top {top.get('phase')}: {top.get('verdict')}")
+        elif isinstance(roof, dict) and roof.get("error"):
+            desc = f"roofline error: {roof['error']}"
+        else:
+            desc = "-"
+        rows.append((name, str((g or {}).get("status", "?")), desc))
+    widths = [max(len(r[i]) for r in rows) for i in range(3)]
+    out.extend("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+               for r in rows)
+    gate = doc.get("gate") or {}
+    rc = gate.get("rc")
+    verdicts = {name: (entry or {}).get("verdict", "?")
+                for name, entry in ((gate.get("report") or {})
+                                    .get("groups") or {}).items()}
+    out.append("gate: rc=" + str(rc)
+               + ("  " + "  ".join(f"{n}={v}" for n, v
+                                   in sorted(verdicts.items()))
+                  if verdicts else ""))
+    if doc.get("downscaled"):
+        out.append("(downscaled campaign: regressions warn, never fail — "
+                   "the gate arms on the first real-backend round)")
+    return "\n".join(out) + "\n"

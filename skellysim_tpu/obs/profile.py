@@ -495,6 +495,25 @@ def profile_json(trace: DeviceTrace) -> dict:
 
 # ---------------------------------------------------------- capture context
 
+def _write_profile_provenance(profile_dir: str) -> None:
+    """Drop a ``provenance.json`` sidecar next to the dump so jax-free
+    consumers (`obs roofline`) know WHICH device the capture ran on —
+    jax is live inside `profile_session`, so this is the one moment the
+    device_kind is knowable without a backend init later."""
+    try:
+        import jax
+
+        from .tracer import provenance
+
+        info = provenance()
+        info["backend"] = jax.default_backend()
+        os.makedirs(profile_dir, exist_ok=True)
+        with open(os.path.join(profile_dir, "provenance.json"), "w") as fh:
+            json.dump(info, fh)
+    except Exception:
+        pass   # a sidecar must never fail the capture it describes
+
+
 @contextlib.contextmanager
 def profile_session(profile_dir: str):
     """Profiler capture tuned for device-time attribution.
@@ -524,13 +543,17 @@ def profile_session(profile_dir: str):
         opts.enable_hlo_proto = True
         sess = xla_client.profiler.ProfilerSession(opts)
     except Exception:
-        with jax.profiler.trace(str(profile_dir)):
-            yield
+        try:
+            with jax.profiler.trace(str(profile_dir)):
+                yield
+        finally:
+            _write_profile_provenance(str(profile_dir))
         return
     try:
         yield
     finally:
         sess.export(sess.stop(), str(profile_dir))
+        _write_profile_provenance(str(profile_dir))
 
 
 # ------------------------------------------------- telemetry-stream bridge

@@ -6,6 +6,7 @@ runs the real entry point (CPU-forced, tiny budget, probe skipped) in a
 subprocess and pins the contract.
 """
 
+import importlib.util
 import json
 import os
 import shutil
@@ -16,6 +17,61 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH_JSON = os.path.join(REPO, "BENCH.json")
+
+
+def _load_bench():
+    """Import bench.py as a module (it lives at the repo root, outside the
+    package). Its import is jax-free by design — the parent-process rule —
+    so loading it in-process is safe."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_provenance_stamp_is_uniform(tmp_path, monkeypatch):
+    """skelly-roofline satellite: EVERY bench artifact writer goes through
+    _stamp_provenance/_archive_round, so any archived round carries the
+    same PROVENANCE_KEYS — with `downscaled` an EXPLICIT bool, false on
+    real rounds rather than merely absent."""
+    bench = _load_bench()
+    assert bench.PROVENANCE_KEYS == ("backend", "jax_version",
+                                     "device_kind", "downscaled",
+                                     "telemetry_version")
+    monkeypatch.setattr(bench, "BENCH_ARCHIVE_DIR", str(tmp_path))
+    extra = {"backend": "tpu", "jax_version": "9.9", "device_kind": "TPU v5p"}
+
+    bench._archive_round("SPECTRAL", "r42", {"x": 1}, extra)
+    with open(tmp_path / "SPECTRAL_r42.json") as fh:
+        doc = json.load(fh)
+    for key in bench.PROVENANCE_KEYS:
+        assert key in doc, key
+    assert doc["downscaled"] is False          # explicit, not absent
+    assert doc["round"] == "r42"
+    assert doc["backend"] == "tpu"
+    assert doc["telemetry_version"] == bench.TELEMETRY_VERSION
+
+    # a downscaled section keeps its flag (bool-coerced, not clobbered)
+    bench._archive_round("SPECTRAL", "r43", {"downscaled": True}, extra)
+    with open(tmp_path / "SPECTRAL_r43.json") as fh:
+        assert json.load(fh)["downscaled"] is True
+
+    # campaign round override: BENCH_ROUND_<GROUP> wins over the constant
+    monkeypatch.setenv("BENCH_ROUND_SPECTRAL", "r77")
+    bench._archive_round("SPECTRAL", "r42", {}, extra)
+    assert (tmp_path / "SPECTRAL_r77.json").exists()
+
+
+def test_next_round_id_appends_never_overwrites(tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "BENCH_ARCHIVE_DIR", str(tmp_path))
+    assert bench._next_round_id("widget") == "r01"
+    (tmp_path / "WIDGET_r03.json").write_text("{}")
+    (tmp_path / "WIDGET_r01.json").write_text("{}")
+    assert bench._next_round_id("widget") == "r04"
+    # the repo root is scanned too (root-artifact groups like treecode)
+    assert int(bench._next_round_id("multichip")[1:]) >= 8
 
 
 @pytest.mark.slow
